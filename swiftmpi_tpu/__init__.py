@@ -1,0 +1,24 @@
+"""swiftmpi_tpu — a TPU-native distributed parameter-server framework.
+
+A from-scratch re-design of the capabilities of logicxin/SwiftMPI (a C++
+MPI+ZeroMQ asynchronous parameter server; see SURVEY.md) for TPU hardware:
+
+* the *cluster* is a ``jax.sharding.Mesh`` instead of MPI ranks + sockets
+  (``swiftmpi_tpu.cluster``);
+* the *parameter server* is a row-sharded dense table in HBM instead of a
+  ``dense_hash_map`` server process (``swiftmpi_tpu.parameter``);
+* the *transfer layer*'s pull/push RPCs are XLA collectives over ICI —
+  ``all_to_all`` + ``segment_sum`` for sparse rows, ``psum`` for dense
+  gradients — selected via ``transfer=tpu`` (``swiftmpi_tpu.transfer``);
+* the *apps* (logistic regression, word2vec, sent2vec) keep the reference's
+  gather → pull → compute → push loop structure, but each step is a single
+  jitted SPMD program (``swiftmpi_tpu.models``, ``swiftmpi_tpu.apps``).
+
+Layer map mirrors SURVEY.md §1: utils → cluster (mesh) → transfer →
+parameter → models/apps, plus ops (device kernels), parallel (collectives /
+context parallelism), data (input pipeline), io (checkpointing).
+"""
+
+__version__ = "0.1.0"
+
+from swiftmpi_tpu import utils  # noqa: F401

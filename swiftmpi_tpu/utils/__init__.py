@@ -1,0 +1,28 @@
+"""Runtime primitives: config, CLI flags, hashing, RNG, buffers, timing.
+
+TPU-native equivalent of the reference utils layer
+(`/root/reference/src/utils/all.h`).  Components with no meaning off the
+socket/pthread substrate (SpinLock/RWLock, AsynExec thread pools,
+StateBarrier, ZMQ/MPI wrappers, local-IP discovery) intentionally have no
+counterpart: SPMD program order is the barrier, XLA is the thread pool, the
+mesh is the cluster (see swiftmpi_tpu.cluster).
+"""
+
+from swiftmpi_tpu.utils.config import (ConfigParser, ConfigError, Item,
+                                       global_config, reset_global_config)
+from swiftmpi_tpu.utils.cmdline import CMDLine
+from swiftmpi_tpu.utils.hashing import (get_hash_code, get_hash_code_np,
+                                        bkdr_hash, bkdr_hash_batch)
+from swiftmpi_tpu.utils.rng import Random, global_random, reset_global_random
+from swiftmpi_tpu.utils.buffer import BinaryBuffer, TextBuffer
+from swiftmpi_tpu.utils.timers import (Timer, Error, Throughput, Metrics,
+                                       global_metrics)
+from swiftmpi_tpu.utils.logger import get_logger
+
+__all__ = [
+    "ConfigParser", "ConfigError", "Item", "global_config",
+    "reset_global_config", "CMDLine", "get_hash_code", "get_hash_code_np",
+    "bkdr_hash", "bkdr_hash_batch", "Random", "global_random",
+    "reset_global_random", "BinaryBuffer", "TextBuffer", "Timer", "Error",
+    "Throughput", "Metrics", "global_metrics", "get_logger",
+]

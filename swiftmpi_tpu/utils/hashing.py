@@ -1,0 +1,70 @@
+"""Key hashing, bit-compatible with the reference routing functions.
+
+Two hashes matter for parity because they decide which shard owns a key:
+
+* ``get_hash_code`` — the 64-bit MurmurHash3 finalizer (public-domain
+  avalanche constants), used by the reference for shard routing
+  (`/root/reference/src/utils/HashFunction.h:16-24`, applied at
+  sparsetable.h:143 and, via ``hash_fn``, hashfrag.h:51-55).
+* ``bkdr_hash`` — the seed-13131 polynomial string hash used to map words to
+  integer keys in the async word2vec variant
+  (`/root/reference/src/utils/string.h:130-137`).
+
+Both are provided as scalars and as numpy-vectorized batch versions (the
+batch versions are what the data pipeline uses; hashing happens host-side —
+on-device arrays are indexed by dense slot ids, never by raw keys).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+_M1 = np.uint64(0xFF51AFD7ED558CCD)
+_M2 = np.uint64(0xC4CEB9FE1A85EC53)
+_SHIFT = np.uint64(33)
+_MASK64 = (1 << 64) - 1
+
+
+def get_hash_code(x: int) -> int:
+    """Scalar murmur64 finalizer; matches reference HashFunction.h:16-24."""
+    x &= _MASK64
+    x ^= x >> 33
+    x = (x * 0xFF51AFD7ED558CCD) & _MASK64
+    x ^= x >> 33
+    x = (x * 0xC4CEB9FE1A85EC53) & _MASK64
+    x ^= x >> 33
+    return x
+
+
+def get_hash_code_np(keys: np.ndarray) -> np.ndarray:
+    """Vectorized murmur64 finalizer over a uint64 array."""
+    x = np.asarray(keys, dtype=np.uint64).copy()
+    with np.errstate(over="ignore"):
+        x ^= x >> _SHIFT
+        x *= _M1
+        x ^= x >> _SHIFT
+        x *= _M2
+        x ^= x >> _SHIFT
+    return x
+
+
+def bkdr_hash(s: str, seed: int = 13131, bits: int = 32) -> int:
+    """Polynomial string hash; matches reference string.h:130-137.
+
+    The reference instantiates ``BKDRHash<T>`` with the app key type:
+    ``unsigned int`` by default, ``size_t`` for async word2vec keys.
+    ``bits`` selects the wrap width (32 or 64).
+    """
+    mask = (1 << bits) - 1
+    h = 0
+    for ch in s.encode("utf-8"):
+        h = (h * seed + ch) & mask
+    return h
+
+
+def bkdr_hash_batch(words, seed: int = 13131, bits: int = 32) -> np.ndarray:
+    """BKDR over a list of strings (host data pipeline)."""
+    out = np.empty(len(words), dtype=np.uint64)
+    for i, w in enumerate(words):
+        out[i] = bkdr_hash(w, seed, bits)
+    return out

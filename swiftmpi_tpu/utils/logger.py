@@ -1,0 +1,32 @@
+"""Logging setup.
+
+The reference uses glog ``LOG/RAW_LOG`` everywhere (SURVEY.md §5); here a
+stdlib logger with a glog-like single-line format plays that role.  Hot paths
+should use ``log.debug`` (compiled out by level, the moral equivalent of the
+reference's ``NDEBUG``-gated ``DLOG``).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FORMAT = "%(levelname).1s%(asctime)s %(name)s] %(message)s"
+_DATEFMT = "%m%d %H:%M:%S"
+
+_configured = False
+
+
+def get_logger(name: str = "swiftmpi_tpu") -> logging.Logger:
+    global _configured
+    if not _configured:
+        level = os.environ.get("SWIFTMPI_TPU_LOGLEVEL", "INFO").upper()
+        handler = logging.StreamHandler(sys.stderr)
+        handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+        root = logging.getLogger("swiftmpi_tpu")
+        root.addHandler(handler)
+        root.setLevel(level)
+        root.propagate = False
+        _configured = True
+    return logging.getLogger(name)

@@ -1,0 +1,102 @@
+"""Timing + metrics accumulation.
+
+``Timer`` mirrors the reference chrono stopwatch
+(`/root/reference/src/utils/Timer.h:14-44`) including ``timeout()``; the rest
+is the metrics system the reference lacks (SURVEY.md §5 "Metrics: No"):
+``Error`` reproduces the loss accumulator used for per-iteration training
+error (reference word2vec.h:442-457), and ``Meter``/``Throughput`` provide
+the words/sec style counters the benchmarks report.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict
+
+
+class Timer:
+    def __init__(self, time_limit_s: float = 0.0):
+        self._start = time.monotonic()
+        self._limit = time_limit_s
+
+    def restart(self) -> None:
+        self._start = time.monotonic()
+
+    def elapsed(self) -> float:
+        return time.monotonic() - self._start
+
+    def timeout(self) -> bool:
+        return self._limit > 0 and self.elapsed() > self._limit
+
+
+class Error:
+    """Running-mean loss accumulator (reference word2vec.h:442-457)."""
+
+    def __init__(self):
+        self._sum = 0.0
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def accu(self, value: float, n: int = 1) -> None:
+        with self._lock:
+            self._sum += float(value)
+            self._count += n
+
+    def norm(self) -> float:
+        with self._lock:
+            return self._sum / self._count if self._count else 0.0
+
+    def reset(self) -> None:
+        with self._lock:
+            self._sum = 0.0
+            self._count = 0
+
+
+class Throughput:
+    """Cumulative items/sec meter since construction or last reset()."""
+
+    def __init__(self):
+        self._items = 0
+        self._timer = Timer()
+
+    def record(self, n: int) -> None:
+        self._items += n
+
+    def rate(self) -> float:
+        dt = self._timer.elapsed()
+        return self._items / dt if dt > 0 else 0.0
+
+    def reset(self) -> None:
+        self._items = 0
+        self._timer.restart()
+
+
+class Metrics:
+    """Named scalar registry; the framework-wide metrics sink."""
+
+    def __init__(self):
+        self._values: Dict[str, float] = {}
+        self._lock = threading.Lock()
+
+    def set(self, name: str, value: float) -> None:
+        with self._lock:
+            self._values[name] = float(value)
+
+    def incr(self, name: str, delta: float = 1.0) -> None:
+        with self._lock:
+            self._values[name] = self._values.get(name, 0.0) + delta
+
+    def get(self, name: str, default: float = 0.0) -> float:
+        return self._values.get(name, default)
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._values)
+
+
+_GLOBAL_METRICS = Metrics()
+
+
+def global_metrics() -> Metrics:
+    return _GLOBAL_METRICS

@@ -1,0 +1,47 @@
+"""Test harness: force JAX onto a virtual 8-device CPU platform.
+
+The standard JAX fake-multi-device trick (SURVEY.md §4): all sharding /
+collective tests run on ``--xla_force_host_platform_device_count=8`` CPU
+devices, so the full multi-chip code path executes without TPU hardware.
+
+This container's sitecustomize registers an `axon` TPU PJRT plugin and
+force-sets ``jax_platforms="axon,cpu"`` at interpreter start, so we both set
+the env vars (for any subprocesses) and override jax.config here (for this
+process).  Must run before any backend is initialized — conftest import time
+is early enough.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable axon sitecustomize hook
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+from swiftmpi_tpu.utils import reset_global_config, reset_global_random
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    """Each test starts with fresh config/RNG singletons."""
+    reset_global_config()
+    reset_global_random()
+    yield
+    reset_global_config()
+    reset_global_random()
+
+
+@pytest.fixture
+def devices8():
+    devs = jax.devices()
+    if len(devs) < 8:
+        pytest.skip("needs 8 virtual devices")
+    return devs[:8]
