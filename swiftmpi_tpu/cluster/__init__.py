@@ -1,0 +1,28 @@
+"""Cluster layer: device mesh topology + key routing.
+
+TPU-native equivalent of `/root/reference/src/cluster/` — see mesh.py for
+the role→axis mapping and hashfrag.py for key→shard routing.  The Cluster
+orchestrator itself (bring-up/finalize around a training run) lives in
+cluster.py and composes mesh + hashfrag + parameter tables.
+"""
+
+from swiftmpi_tpu.cluster.mesh import (DATA_AXIS, MODEL_AXIS, SHARD_AXIS,
+                                       MeshSpec, batch_sharded, build_mesh,
+                                       mesh_info, ps_mesh, replicated,
+                                       row_sharded)
+from swiftmpi_tpu.cluster.hashfrag import HashFrag
+
+__all__ = [
+    "DATA_AXIS", "MODEL_AXIS", "SHARD_AXIS", "MeshSpec", "batch_sharded",
+    "build_mesh", "mesh_info", "ps_mesh", "replicated", "row_sharded",
+    "HashFrag", "Cluster",
+]
+
+
+def __getattr__(name):
+    # Cluster pulls in parameter/transfer; import lazily to keep the
+    # mesh/hashfrag primitives dependency-light.
+    if name == "Cluster":
+        from swiftmpi_tpu.cluster.cluster import Cluster
+        return Cluster
+    raise AttributeError(name)

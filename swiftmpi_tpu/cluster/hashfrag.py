@@ -1,0 +1,87 @@
+"""Key → shard routing table.
+
+Bit-compatible re-implementation of the reference's ``BasicHashFrag``
+(`/root/reference/src/cluster/hashfrag.h:15-119`): a key is hashed with the
+murmur64 finalizer, mapped to one of ``frag_num`` fragments, and fragments
+are assigned to shards in contiguous blocks.  The indirection (key → frag →
+shard) exists so re-sharding can move fragments without rehashing keys —
+worth keeping even though, like the reference ("without Replication, Fault
+Tolerance and Repair", hashfrag.h:13), fragment migration is not implemented
+in v1.
+
+Differences by design:
+  * shard ids are 0-based mesh-axis indices (the reference uses 1-based
+    server node ids because id 0 was a vestigial master: hashfrag.h:44-49,
+    ServerWorkerRoute.h:19-32).  ``to_node_id`` preserves the reference's
+    1-based numbering for wire/dump parity.
+  * routing is vectorized over numpy key arrays — this runs in the host data
+    pipeline; on device, rows are addressed by dense slot id, never by key.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from swiftmpi_tpu.utils.buffer import BinaryBuffer
+from swiftmpi_tpu.utils.hashing import get_hash_code_np
+
+
+class HashFrag:
+    def __init__(self, num_shards: int, num_frags: Optional[int] = None):
+        if num_shards <= 0:
+            raise ValueError("num_shards must be positive")
+        self.num_shards = int(num_shards)
+        self.num_frags = int(num_frags if num_frags else max(
+            1000, 100 * num_shards))
+        if self.num_frags < self.num_shards:
+            raise ValueError("num_frags must be >= num_shards")
+        # Contiguous block assignment, matching hashfrag.h:41-49:
+        # frag i -> clamp(i // (num_frags // num_shards), 0, num_shards-1).
+        per = self.num_frags // self.num_shards
+        table = np.minimum(np.arange(self.num_frags) // per,
+                           self.num_shards - 1)
+        self._map_table = table.astype(np.int32)
+
+    # -- routing ----------------------------------------------------------
+    def to_shard_id(self, keys) -> np.ndarray:
+        """Vectorized key → 0-based shard id (hashfrag.h:51-55)."""
+        keys = np.asarray(keys, dtype=np.uint64)
+        frag = (get_hash_code_np(keys) % np.uint64(self.num_frags)).astype(
+            np.int64)
+        return self._map_table[frag]
+
+    def to_node_id(self, keys) -> np.ndarray:
+        """Reference-compatible 1-based server node id."""
+        return self.to_shard_id(keys) + 1
+
+    @property
+    def map_table(self) -> np.ndarray:
+        return self._map_table
+
+    # -- (de)serialization (hashfrag.h:58-88) ------------------------------
+    def serialize(self, bb: BinaryBuffer) -> BinaryBuffer:
+        bb.put_int32(self.num_shards)
+        bb.put_int32(self.num_frags)
+        bb.put_array(self._map_table)
+        return bb
+
+    @classmethod
+    def deserialize(cls, bb: BinaryBuffer) -> "HashFrag":
+        num_shards = bb.get_int32()
+        num_frags = bb.get_int32()
+        obj = cls.__new__(cls)
+        obj.num_shards = num_shards
+        obj.num_frags = num_frags
+        obj._map_table = bb.get_array(num_frags, np.int32).copy()
+        return obj
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, HashFrag)
+                and self.num_shards == other.num_shards
+                and self.num_frags == other.num_frags
+                and np.array_equal(self._map_table, other._map_table))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"HashFrag(shards={self.num_shards}, frags={self.num_frags})"

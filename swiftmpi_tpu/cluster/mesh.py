@@ -1,0 +1,137 @@
+"""The cluster IS the mesh.
+
+TPU-native replacement for the reference cluster bring-up
+(`/root/reference/src/cluster/cluster.h:27-110`): where the reference
+exchanges IPs/ports over MPI_Allgather and wires N×M ZeroMQ sockets, here a
+``jax.sharding.Mesh`` names the device topology and XLA compiles the
+collectives onto ICI/DCN.  There is nothing to bootstrap: device discovery,
+addressing and barriers are the runtime's job, and SPMD program order
+replaces every ``MPI_Barrier`` / ``StateBarrier`` in the reference.
+
+Roles map onto axes rather than ranks:
+
+* ``data``  axis — the "workers": each slice holds a shard of the minibatch
+  (reference: per-rank data files, SURVEY.md §2.7).
+* ``model`` axis — the "servers": the sparse parameter table is row-sharded
+  over it (reference: hashfrag over server ranks 1..N, cluster/hashfrag.h).
+
+The reference's ``cluster.to_split_worker_server=0`` default (every rank is
+both worker and server, cluster/cluster.h:65-71) corresponds to the 1-D
+``shard`` mesh where both the batch and the table shard over the same axis —
+the layout the explicit ``transfer=tpu`` all_to_all backend uses.
+
+Multi-host: ``build_mesh(..., hybrid=True)`` places the leading axis across
+process (DCN) boundaries via ``mesh_utils.create_hybrid_device_mesh`` so
+collectives on inner axes ride ICI and only the outer axis crosses DCN.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.experimental import mesh_utils
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SHARD_AXIS = "shard"
+
+
+@dataclass(frozen=True)
+class MeshSpec:
+    """Named axis sizes; -1 for at most one axis means "the rest".
+
+    Equivalent of the reference's ``[cluster]`` config section
+    (cluster/cluster.h:13-25): ``server_num`` becomes the ``model`` axis
+    size, worker parallelism the ``data`` axis size.
+    """
+
+    axes: Tuple[Tuple[str, int], ...] = ((DATA_AXIS, -1), (MODEL_AXIS, 1))
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, int]) -> "MeshSpec":
+        return cls(tuple(d.items()))
+
+    def resolve(self, n_devices: int) -> Tuple[Tuple[str, int], ...]:
+        sizes = dict(self.axes)
+        wild = [a for a, s in sizes.items() if s == -1]
+        if len(wild) > 1:
+            raise ValueError(f"at most one -1 axis, got {wild}")
+        fixed = int(np.prod([s for s in sizes.values() if s != -1]))
+        if wild:
+            if n_devices % fixed:
+                raise ValueError(
+                    f"{n_devices} devices not divisible by fixed axes {fixed}")
+            sizes[wild[0]] = n_devices // fixed
+        total = int(np.prod(list(sizes.values())))
+        if total != n_devices:
+            raise ValueError(
+                f"mesh {sizes} needs {total} devices, have {n_devices}")
+        return tuple(sizes.items())
+
+
+def build_mesh(spec: Optional[MeshSpec] = None,
+               devices: Optional[Sequence[jax.Device]] = None,
+               hybrid: bool = False) -> Mesh:
+    """Construct the device mesh that plays the reference's cluster role."""
+    devices = list(jax.devices() if devices is None else devices)
+    spec = spec or MeshSpec()
+    axes = spec.resolve(len(devices))
+    names = tuple(a for a, _ in axes)
+    shape = tuple(s for _, s in axes)
+    if hybrid and jax.process_count() > 1:
+        # Split the leading axis across hosts (DCN); its per-host remainder
+        # and all other axes stay within a slice (ICI).
+        n_proc = jax.process_count()
+        if shape[0] % n_proc:
+            raise ValueError(
+                f"leading axis {names[0]}={shape[0]} must be a multiple of "
+                f"process count {n_proc} for a hybrid mesh")
+        per_slice = (shape[0] // n_proc,) + shape[1:]
+        dcn = (n_proc,) + (1,) * (len(shape) - 1)
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=per_slice, dcn_mesh_shape=dcn, devices=devices)
+        return Mesh(dev_array.reshape(shape), names)
+    dev_array = np.asarray(devices).reshape(shape)
+    return Mesh(dev_array, names)
+
+
+def ps_mesh(n: Optional[int] = None,
+            devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    """1-D ``shard`` mesh: every device is both worker and server, the
+    reference's default deployment (cluster/cluster.h:65-71)."""
+    devices = list(jax.devices() if devices is None else devices)
+    if n is not None:
+        devices = devices[:n]
+    return Mesh(np.asarray(devices), (SHARD_AXIS,))
+
+
+def mesh_info(mesh: Mesh) -> Dict[str, object]:
+    """Topology introspection (the reference logs rank/IP tables;
+    we report device kinds, axis layout and host spread)."""
+    devs = mesh.devices.ravel().tolist()
+    return {
+        "axis_names": list(mesh.axis_names),
+        "axis_sizes": [int(s) for s in mesh.devices.shape],
+        "n_devices": len(devs),
+        "device_kind": devs[0].device_kind,
+        "platform": devs[0].platform,
+        "n_processes": len({d.process_index for d in devs}),
+        "multi_host": len({d.process_index for d in devs}) > 1,
+    }
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def row_sharded(mesh: Mesh, axis: str = MODEL_AXIS) -> NamedSharding:
+    """Sharding for a parameter table: rows split over the server axis."""
+    return NamedSharding(mesh, PartitionSpec(axis))
+
+
+def batch_sharded(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis))

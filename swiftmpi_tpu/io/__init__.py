@@ -1,0 +1,8 @@
+"""Checkpoint I/O: reference-compatible text dumps + binary resume."""
+
+from swiftmpi_tpu.io.checkpoint import (default_formatter, default_parser,
+                                        dump_table_text, load_checkpoint,
+                                        load_table_text, save_checkpoint)
+
+__all__ = ["default_formatter", "default_parser", "dump_table_text",
+           "load_checkpoint", "load_table_text", "save_checkpoint"]

@@ -1,0 +1,170 @@
+"""Checkpoint out / load for sparse tables.
+
+Two formats:
+
+* **Text** — line-per-key ``key\\t<value>`` dumps, the reference's only
+  checkpoint format (`/root/reference/src/parameter/sparsetable.h:119-132`,
+  written at ``finalize``; value layout is app-defined via ``operator<<``,
+  e.g. word2vec writes ``v... \\t h...`` — word2vec.h:100-110).  ``load``
+  supports the reference's ownership filter (``ClusterServer::load`` keeps
+  only rows the local server owns, server.h:49-62) via ``shard_filter``.
+* **Binary (npz)** — full-fidelity mid-training checkpoints including
+  optimizer state and the key index, which the reference cannot do (its
+  dump drops h2sum/v2sum — SURVEY.md §5 "Checkpoint/resume: partial").
+
+Formatters/parsers turn a ``{field: row}`` dict into the app's text value
+and back; models provide reference-compatible ones.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional
+
+import numpy as np
+
+from swiftmpi_tpu.parameter.sparse_table import SparseTable
+
+Formatter = Callable[[Dict[str, np.ndarray]], str]
+Parser = Callable[[str], Dict[str, np.ndarray]]
+
+
+def default_formatter(fields) -> Formatter:
+    """Space-joined values per field, tab between fields, in given order."""
+    def fmt(row: Dict[str, np.ndarray]) -> str:
+        return "\t".join(
+            " ".join(repr(float(x)) for x in np.ravel(row[f]))
+            for f in fields)
+    return fmt
+
+
+def default_parser(fields) -> Parser:
+    def parse(text: str) -> Dict[str, np.ndarray]:
+        parts = text.split("\t")
+        return {f: np.array([float(x) for x in p.split()], np.float32)
+                for f, p in zip(fields, parts)}
+    return parse
+
+
+# -- text (reference-compatible) ------------------------------------------
+
+def dump_table_text(table: SparseTable, path: str,
+                    formatter: Optional[Formatter] = None) -> int:
+    """Write ``key\\tvalue`` lines for every occupied row; returns count."""
+    formatter = formatter or default_formatter(table.access.pull_fields)
+    rows = {f: np.asarray(table.state[f]) for f in table.access.fields}
+    n = 0
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w") as f:
+        for key, slot in table.key_index.items():
+            row = {name: arr[slot] for name, arr in rows.items()}
+            f.write(f"{key}\t{formatter(row)}\n")
+            n += 1
+    return n
+
+
+def load_table_text(table: SparseTable, path: str,
+                    parser: Optional[Parser] = None,
+                    shard_filter: Optional[int] = None) -> int:
+    """Stream ``key\\tvalue`` lines into the table, creating slots lazily;
+    with ``shard_filter`` keep only keys owned by that shard (the reference
+    per-server load filter, server.h:49-62).  Returns rows loaded."""
+    parser = parser or default_parser(table.access.pull_fields)
+    keys: list = []
+    rests: list = []
+    with open(path) as f:
+        for line in f:
+            line = line.rstrip("\n")
+            if not line:
+                continue
+            key_s, _, rest = line.partition("\t")
+            keys.append(int(key_s))
+            rests.append(rest)
+    if not keys:
+        return 0
+    key_arr = np.asarray(keys, np.uint64)
+    if shard_filter is not None:
+        keep = table.key_index.shard_of(key_arr) == shard_filter
+        key_arr = key_arr[keep]
+        rests = [r for r, k in zip(rests, keep) if k]
+        if not len(key_arr):
+            return 0
+    all_slots = table.key_index.lookup(key_arr)
+    updates: Dict[str, list] = {f: [] for f in table.access.fields}
+    for rest in rests:
+        for fname, value in parser(rest).items():
+            updates[fname].append(np.asarray(value, np.float32))
+    n = len(key_arr)
+    slots = all_slots.tolist()
+    idx = np.asarray(slots, np.int32)
+    state = dict(table.state)
+    for fname, vals in updates.items():
+        if not vals:
+            continue
+        block = np.stack(vals).reshape(len(slots), -1)
+        arr = np.asarray(state[fname]).copy()
+        arr[idx] = block
+        state[fname] = _replace(table, fname, arr)
+    table.state = state
+    return n
+
+
+def _replace(table: SparseTable, fname: str, arr: np.ndarray):
+    import jax
+    sharding = table.row_sharding()
+    if sharding is None:
+        return jax.numpy.asarray(arr)
+    return jax.device_put(arr, sharding)
+
+
+# -- binary (full fidelity, mid-training) ----------------------------------
+
+def save_checkpoint(table: SparseTable, path: str,
+                    extra: Optional[Dict[str, np.ndarray]] = None) -> None:
+    """npz with all fields (incl. optimizer state), the key index, and any
+    extra arrays (e.g. step counters) — resume-exact, unlike the reference
+    text dump which drops h2sum/v2sum (word2vec.h:100-110)."""
+    keys = np.fromiter(table.key_index.keys(), dtype=np.uint64,
+                       count=len(table.key_index))
+    slots = np.fromiter((table.key_index.slot(int(k)) for k in keys),
+                        dtype=np.int64, count=len(keys))
+    payload = {f"field__{f}": np.asarray(v) for f, v in table.state.items()}
+    payload["keys"] = keys
+    payload["slots"] = slots
+    payload["num_shards"] = np.int64(table.key_index.num_shards)
+    payload["capacity_per_shard"] = np.int64(
+        table.key_index.capacity_per_shard)
+    for k, v in (extra or {}).items():
+        payload[f"extra__{k}"] = np.asarray(v)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    np.savez(path, **payload)
+
+
+def load_checkpoint(table: SparseTable, path: str) -> Dict[str, np.ndarray]:
+    """Restore table state + key index from ``save_checkpoint`` output;
+    returns the ``extra`` arrays."""
+    with np.load(path if path.endswith(".npz") else path + ".npz") as z:
+        if int(z["num_shards"]) != table.key_index.num_shards:
+            raise ValueError(
+                f"checkpoint has {int(z['num_shards'])} shards, table has "
+                f"{table.key_index.num_shards}")
+        if int(z["capacity_per_shard"]) != table.key_index.capacity_per_shard:
+            raise ValueError("capacity_per_shard mismatch")
+        state = {}
+        for name in table.access.fields:
+            state[name] = _replace(table, name, z[f"field__{name}"])
+        table.state = state
+        ki = table.key_index
+        ki._slot_of.clear()
+        ki._next_local[:] = 0
+        for lst in ki._keys_by_shard:
+            lst.clear()
+        per = ki.capacity_per_shard
+        for key, slot in zip(z["keys"].tolist(), z["slots"].tolist()):
+            shard = slot // per
+            ki._slot_of[int(key)] = int(slot)
+            ki._keys_by_shard[shard].append(int(key))
+            ki._next_local[shard] = max(ki._next_local[shard],
+                                        slot % per + 1)
+        return {k[len("extra__"):]: z[k] for k in z.files
+                if k.startswith("extra__")}

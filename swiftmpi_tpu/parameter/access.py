@@ -1,0 +1,161 @@
+"""Pluggable pull/push access methods (server-side op plugins).
+
+TPU-native equivalent of the reference's ``PullAccessMethod`` /
+``PushAccessMethod`` plugin pair (`/root/reference/src/parameter/
+accessmethod.h:7-35`): an ``AccessMethod`` bundles
+
+* the table schema it needs (parameter fields + optimizer-state fields),
+* the initial-value distribution for lazily created rows
+  (``init_param``, accessmethod.h:14-16),
+* which fields a ``pull`` returns to workers (``get_pull_value`` — e.g.
+  word2vec pulls h,v but not the AdaGrad sums, word2vec.h:160-165),
+* the pure update rule ``apply_push`` applied to pushed gradients
+  (``apply_push_value``).
+
+Where the reference mutates one row behind a pointer, here ``apply_push`` is
+a pure, vectorized function over ``(n, d)`` row batches, traceable under
+``jit`` and identical per-row math.
+
+Sign convention: like the reference apps, gradients are pushed in the
+*ascent* direction and the update **adds** (word2vec.h:177-185,
+lr.cpp:68-75).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Callable, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Initializer = Callable[[jax.Array, Tuple[int, ...]], jax.Array]
+
+
+def zeros_init(key: jax.Array, shape: Tuple[int, ...]) -> jax.Array:
+    return jnp.zeros(shape, jnp.float32)
+
+
+def uniform01_init(key: jax.Array, shape: Tuple[int, ...]) -> jax.Array:
+    """U(0,1) — the reference LR weight init draws ``gen_float()``
+    (lr.cpp:48-50)."""
+    return jax.random.uniform(key, shape, jnp.float32)
+
+
+def vec_rand_init(key: jax.Array, shape: Tuple[int, ...]) -> jax.Array:
+    """(U(0,1) - 0.5) / dim — the reference ``Vec::randInit`` embedding
+    init (vec1.h:229-232)."""
+    dim = shape[-1]
+    return (jax.random.uniform(key, shape, jnp.float32) - 0.5) / dim
+
+
+@dataclass(frozen=True)
+class FieldSpec:
+    dim: int
+    init: Initializer = zeros_init
+    dtype: jnp.dtype = jnp.float32
+
+
+class AccessMethod:
+    """Base: schema + init + pull view + push rule."""
+
+    #: name -> FieldSpec; the full server-side row (params + optimizer state)
+    fields: Dict[str, FieldSpec] = {}
+    #: subset of ``fields`` a pull returns (worker-visible view)
+    pull_fields: Tuple[str, ...] = ()
+    #: gradient entries a push must provide
+    grad_fields: Tuple[str, ...] = ()
+
+    def apply_push(self, params: Dict[str, jax.Array],
+                   grads: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
+        """Pure row-batch update: (fields, grads) -> new fields."""
+        raise NotImplementedError
+
+
+@dataclass
+class AdaGradRule:
+    """One (param, accumulator, grad) triple updated AdaGrad-style."""
+    param: str
+    accum: str
+    grad: str
+
+
+class AdaGradAccess(AccessMethod):
+    """Server-side AdaGrad, the reference's only optimizer.
+
+    Per element (word2vec.h:177-185 / lr.cpp:68-75, fudge_factor 1e-6):
+        accum += g^2
+        param += lr * g / sqrt(accum + fudge)      # accum already updated
+    """
+
+    def __init__(self, learning_rate: float,
+                 rules: Tuple[AdaGradRule, ...],
+                 fields: Dict[str, FieldSpec],
+                 pull_fields: Tuple[str, ...],
+                 fudge_factor: float = 1e-6):
+        self.learning_rate = float(learning_rate)
+        self.rules = tuple(rules)
+        self.fields = dict(fields)
+        self.pull_fields = tuple(pull_fields)
+        self.grad_fields = tuple(r.grad for r in self.rules)
+        self.fudge_factor = float(fudge_factor)
+        for r in self.rules:
+            if r.param not in self.fields or r.accum not in self.fields:
+                raise ValueError(f"rule {r} references unknown field")
+
+    def apply_push(self, params, grads):
+        out = dict(params)
+        for r in self.rules:
+            g = grads[r.grad].astype(jnp.float32)
+            accum = params[r.accum] + jnp.square(g)
+            out[r.accum] = accum
+            out[r.param] = params[r.param] + (
+                self.learning_rate * g
+                * jax.lax.rsqrt(accum + self.fudge_factor))
+        return out
+
+
+def lr_access(learning_rate: float) -> AdaGradAccess:
+    """Logistic-regression row: scalar weight + grad²-sum
+    (reference LRParam, lr.cpp:14-22,60-81)."""
+    return AdaGradAccess(
+        learning_rate,
+        rules=(AdaGradRule("val", "grad2sum", "val"),),
+        fields={"val": FieldSpec(1, uniform01_init),
+                "grad2sum": FieldSpec(1, zeros_init)},
+        pull_fields=("val",),
+    )
+
+
+def w2v_access(learning_rate: float, len_vec: int) -> AdaGradAccess:
+    """word2vec row: h,v embeddings + per-element AdaGrad sums
+    (reference WParam, word2vec.h:32-46,167-191)."""
+    return AdaGradAccess(
+        learning_rate,
+        rules=(AdaGradRule("h", "h2sum", "h"),
+               AdaGradRule("v", "v2sum", "v")),
+        fields={"h": FieldSpec(len_vec, vec_rand_init),
+                "v": FieldSpec(len_vec, vec_rand_init),
+                "h2sum": FieldSpec(len_vec, zeros_init),
+                "v2sum": FieldSpec(len_vec, zeros_init)},
+        pull_fields=("h", "v"),
+    )
+
+
+class SGDAccess(AccessMethod):
+    """Plain additive SGD (no accumulator) — not in the reference, but the
+    natural second access method and the cheapest push path."""
+
+    def __init__(self, learning_rate: float, fields: Dict[str, FieldSpec],
+                 pull_fields: Tuple[str, ...],
+                 grad_fields: Tuple[str, ...]):
+        self.learning_rate = float(learning_rate)
+        self.fields = dict(fields)
+        self.pull_fields = tuple(pull_fields)
+        self.grad_fields = tuple(grad_fields)
+
+    def apply_push(self, params, grads):
+        out = dict(params)
+        for name in self.grad_fields:
+            out[name] = params[name] + self.learning_rate * grads[name]
+        return out

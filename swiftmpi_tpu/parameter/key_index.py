@@ -1,0 +1,113 @@
+"""Host-side key → dense-slot index for the sharded parameter table.
+
+The reference stores parameters in a ``dense_hash_map<key, value>`` per
+server shard (`/root/reference/src/parameter/sparsetable.h:17-149`) and
+creates rows lazily on first pull (accessmethod.h:63-70).  XLA wants static
+shapes and integer indexing, so the TPU design splits that hash map in two:
+
+* this **KeyIndex** (host side): an open vocabulary mapping arbitrary uint64
+  keys to dense slots, assigned lazily on first touch — the moral equivalent
+  of ``dense_hash_map`` insertion.  Routing is shard-aware: a key's shard is
+  decided by the same murmur-based HashFrag rule as the reference
+  (hashfrag.h:51-55), and its slot lands in that shard's contiguous slot
+  range, so row ``slot`` of the device-side table lives on the device that
+  "owns" the key.
+* the device-side **SparseTable** (sparse_table.py): dense ``(capacity, d)``
+  arrays indexed by slot, row-sharded over the mesh.
+
+Slot layout: ``slot = shard_id * capacity_per_shard + local_slot``.  With
+``num_shards`` equal to the mesh's table-axis size, shard *i*'s range maps
+exactly onto device *i*'s row slice.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Iterable, List, Optional
+
+import numpy as np
+
+from swiftmpi_tpu.cluster.hashfrag import HashFrag
+
+
+class CapacityError(RuntimeError):
+    """A shard ran out of slots; raise rather than silently evict."""
+
+
+class KeyIndex:
+    def __init__(self, num_shards: int, capacity_per_shard: int,
+                 hashfrag: Optional[HashFrag] = None):
+        self.num_shards = int(num_shards)
+        self.capacity_per_shard = int(capacity_per_shard)
+        self.hashfrag = hashfrag or HashFrag(num_shards)
+        if self.hashfrag.num_shards != self.num_shards:
+            raise ValueError("hashfrag shard count mismatch")
+        self._slot_of: Dict[int, int] = {}
+        self._next_local = np.zeros(self.num_shards, dtype=np.int64)
+        self._keys_by_shard: List[List[int]] = [
+            [] for _ in range(self.num_shards)]
+
+    # -- core -------------------------------------------------------------
+    def lookup(self, keys, create: bool = True) -> np.ndarray:
+        """Map keys → slots; unknown keys get fresh slots in their owning
+        shard when ``create`` (lazy init, reference accessmethod.h:63-70),
+        else -1.
+        """
+        keys = np.asarray(keys, dtype=np.uint64)
+        out = np.empty(keys.shape, dtype=np.int32)
+        flat = keys.ravel()
+        out_flat = out.ravel()
+        misses: List[int] = []
+        miss_pos: List[int] = []
+        for i, k in enumerate(flat.tolist()):
+            slot = self._slot_of.get(k)
+            if slot is None:
+                misses.append(k)
+                miss_pos.append(i)
+                out_flat[i] = -1
+            else:
+                out_flat[i] = slot
+        if misses and create:
+            # de-duplicate while keeping first-touch order
+            uniq = list(dict.fromkeys(misses))
+            shards = self.hashfrag.to_shard_id(
+                np.asarray(uniq, dtype=np.uint64))
+            for k, s in zip(uniq, shards.tolist()):
+                local = int(self._next_local[s])
+                if local >= self.capacity_per_shard:
+                    raise CapacityError(
+                        f"shard {s} full ({self.capacity_per_shard} slots); "
+                        f"raise capacity_per_shard")
+                self._next_local[s] = local + 1
+                self._slot_of[k] = s * self.capacity_per_shard + local
+                self._keys_by_shard[s].append(k)
+            for i in miss_pos:
+                out_flat[i] = self._slot_of[int(flat[i])]
+        return out
+
+    def shard_of(self, keys) -> np.ndarray:
+        return self.hashfrag.to_shard_id(keys)
+
+    # -- introspection ----------------------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.num_shards * self.capacity_per_shard
+
+    def __len__(self) -> int:
+        return len(self._slot_of)
+
+    def __contains__(self, key: int) -> bool:
+        return int(key) in self._slot_of
+
+    def slot(self, key: int) -> int:
+        return self._slot_of[int(key)]
+
+    def keys(self) -> Iterable[int]:
+        return self._slot_of.keys()
+
+    def items(self) -> Iterable:
+        """(key, slot) pairs in insertion order per shard."""
+        return self._slot_of.items()
+
+    def shard_fill(self) -> np.ndarray:
+        """Occupied slots per shard (load-balance introspection)."""
+        return self._next_local.copy()

@@ -1,0 +1,109 @@
+"""Row-sharded dense parameter table in HBM.
+
+TPU-native equivalent of the reference server store
+(`/root/reference/src/parameter/sparsetable.h:17-149`): instead of
+``shard_num`` dense_hash_maps behind RWLocks in a server process, the table
+is a pytree of dense ``(capacity, dim)`` arrays living sharded across device
+HBM, indexed by the dense slots a host-side KeyIndex assigns.  The
+reference's two-level routing (key → server via hashfrag, key → shard via
+murmur % shard_num) collapses into the KeyIndex slot layout: shard *i* owns
+slot range ``[i*cap, (i+1)*cap)``, which is exactly device *i*'s row slice
+under a ``PartitionSpec(axis)`` sharding.
+
+Lazy row init (accessmethod.h:63-70: create + ``init_param`` on first pull)
+becomes eager whole-capacity initialization with the same per-row
+distribution: untouched rows are never observed, so eager-random ≡
+lazy-random in all observable behavior, and the device never round-trips to
+the host to materialize a row.
+
+The table *state* is a plain ``{field: jax.Array}`` dict — a pytree that
+training steps close over, donate, and return updated; the ``SparseTable``
+object is the host-side handle (spec, mesh placement, key index).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from swiftmpi_tpu.cluster.mesh import MODEL_AXIS
+from swiftmpi_tpu.parameter.access import AccessMethod
+from swiftmpi_tpu.parameter.key_index import KeyIndex
+
+TableState = Dict[str, jax.Array]
+
+
+class SparseTable:
+    def __init__(self, access: AccessMethod, key_index: KeyIndex,
+                 mesh: Optional[Mesh] = None, axis: str = MODEL_AXIS,
+                 seed: int = 0):
+        self.access = access
+        self.key_index = key_index
+        self.mesh = mesh
+        self.axis = axis
+        self.seed = int(seed)
+        if mesh is not None:
+            axis_size = mesh.shape[axis]
+            if key_index.num_shards % axis_size:
+                raise ValueError(
+                    f"num_shards={key_index.num_shards} must be a multiple "
+                    f"of mesh axis {axis!r} size {axis_size}")
+        self.state: TableState = self._init_state()
+
+    # -- construction -----------------------------------------------------
+    def row_sharding(self) -> Optional[NamedSharding]:
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, PartitionSpec(self.axis))
+
+    def _init_state(self) -> TableState:
+        cap = self.key_index.capacity
+        fields = self.access.fields
+
+        def init_all(key):
+            out = {}
+            for name, fs in sorted(fields.items()):
+                key, sub = jax.random.split(key)
+                out[name] = fs.init(sub, (cap, fs.dim)).astype(fs.dtype)
+            return out
+
+        sharding = self.row_sharding()
+        if sharding is None:
+            return jax.jit(init_all)(jax.random.key(self.seed))
+        shardings = {name: sharding for name in fields}
+        return jax.jit(init_all, out_shardings=shardings)(
+            jax.random.key(self.seed))
+
+    # -- device-level row access ------------------------------------------
+    def gather(self, slots) -> TableState:
+        """Rows for ``slots`` across pull-visible fields (device op)."""
+        slots = jnp.asarray(slots)
+        return {f: jnp.take(self.state[f], slots, axis=0)
+                for f in self.access.pull_fields}
+
+    def gather_all_fields(self, slots) -> TableState:
+        slots = jnp.asarray(slots)
+        return {f: jnp.take(self.state[f], slots, axis=0)
+                for f in self.access.fields}
+
+    # -- host-level introspection -----------------------------------------
+    @property
+    def capacity(self) -> int:
+        return self.key_index.capacity
+
+    @property
+    def num_rows(self) -> int:
+        """Occupied rows (reference SparseTable::size, sparsetable.h:135)."""
+        return len(self.key_index)
+
+    def rows_as_numpy(self) -> Dict[str, np.ndarray]:
+        return {f: np.asarray(v) for f, v in self.state.items()}
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return (f"SparseTable(fields={list(self.access.fields)}, "
+                f"capacity={self.capacity}, rows={self.num_rows}, "
+                f"sharded={self.mesh is not None})")

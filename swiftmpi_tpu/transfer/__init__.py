@@ -1,0 +1,9 @@
+"""Transfer layer: the pull/push data plane over XLA collectives.
+
+TPU-native equivalent of `/root/reference/src/transfer/` +
+`/root/reference/src/parameter/global_{pull,push}_access.h` — see api.py.
+"""
+
+from swiftmpi_tpu.transfer.api import Transfer, get_transfer
+
+__all__ = ["Transfer", "get_transfer"]

@@ -1,0 +1,88 @@
+"""Transfer layer: the pull/push data plane, with backend selection.
+
+This is the TPU-native replacement for the reference's entire RPC stack —
+``Transfer``/``Listener``/``Route`` over ZeroMQ plus the
+``GlobalPullAccess::pull_with_barrier`` / ``GlobalPushAccess::
+push_with_barrier`` clients (`/root/reference/src/transfer/transfer.h:86-241`,
+`/root/reference/src/parameter/global_pull_access.h:28-43`,
+`global_push_access.h:26-43`).  Per the BASELINE north star, the interface
+survives and the wire disappears: a backend is selected by the ``transfer``
+config key and turns pull/push into XLA collectives.
+
+Backends:
+
+* ``xla``   — gather/scatter with sharding constraints; XLA chooses the
+              collectives.  Works under any mesh (or none).  Default.
+* ``tpu``   — explicit SPMD routing via ``shard_map``: keys are bucketed by
+              owning shard, ``all_to_all`` ships requests over ICI, owners
+              gather/apply locally, ``all_to_all`` ships rows back.  The
+              literal TPU translation of the reference pull/push RPC
+              (SURVEY.md §3.2-3.3) on a 1-D ``shard`` mesh.
+* ``local`` — numpy golden model of the same semantics, for tests.
+
+Shared semantics (all backends, property-tested against each other):
+
+* ``pull(state, slots) -> rows``: per-position row gather of the access
+  method's pull-visible fields; ``slot == -1`` padding yields zero rows.
+* ``push(state, slots, grads) -> state'``: duplicate slots' gradients are
+  **summed**, then the access method's update is applied **once** per
+  unique row.  ``slot == -1`` contributions are dropped.
+
+The reference instead applies one sequential AdaGrad step per *worker* per
+key (server.h:159-176) — order-dependent and racy (SURVEY.md §3.3).  The
+sum-then-apply-once rule is the deliberate synchronous-SPMD semantic; the
+async flavor is recovered at the model layer by taking several local steps
+between pushes.
+
+Within-worker mean normalization (the reference's ``grad /= count`` at
+serialization, word2vec.h:120-132) stays the caller's job via
+``LocalParamCache.normalized_grads`` or the models' count scaling.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import jax
+
+from swiftmpi_tpu.parameter.access import AccessMethod
+from swiftmpi_tpu.utils.config import ConfigParser
+
+TableState = Dict[str, jax.Array]
+
+
+class Transfer:
+    """Backend interface: pure device-level pull/push."""
+
+    name: str = "?"
+
+    def pull(self, state: TableState, slots, access: AccessMethod
+             ) -> TableState:
+        raise NotImplementedError
+
+    def push(self, state: TableState, slots, grads: TableState,
+             access: AccessMethod) -> TableState:
+        raise NotImplementedError
+
+
+def get_transfer(name: Optional[str] = None,
+                 config: Optional[ConfigParser] = None,
+                 **kwargs) -> Transfer:
+    """Resolve a backend by name or by the ``[cluster] transfer`` config key
+    (the BASELINE.json ``transfer=tpu`` flag)."""
+    if name is None:
+        if config is not None and config.has("cluster", "transfer"):
+            name = config.get("cluster", "transfer").to_string()
+        else:
+            name = "xla"
+    if name == "xla":
+        from swiftmpi_tpu.transfer.xla import XlaTransfer
+        return XlaTransfer(**kwargs)
+    if name == "tpu":
+        from swiftmpi_tpu.transfer.tpu import TpuTransfer
+        return TpuTransfer(**kwargs)
+    if name == "local":
+        from swiftmpi_tpu.transfer.local import LocalTransfer
+        return LocalTransfer(**kwargs)
+    raise ValueError(f"unknown transfer backend {name!r} "
+                     "(expected xla|tpu|local)")

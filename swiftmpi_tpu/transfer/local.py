@@ -1,0 +1,49 @@
+"""``local`` transfer backend: numpy golden model.
+
+Single-process, loop-free-of-collectives reference implementation of the
+transfer semantics in api.py, used to property-test the ``xla`` and ``tpu``
+backends against each other.  Mirrors the role of the reference's
+single-rank ``mpirun -np 1`` deployment as the implicit test story
+(SURVEY.md §4) — except here it is an actual oracle, not a smoke run.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from swiftmpi_tpu.parameter.access import AccessMethod
+from swiftmpi_tpu.transfer.api import Transfer
+
+
+class LocalTransfer(Transfer):
+    name = "local"
+
+    def pull(self, state, slots, access):
+        slots = np.asarray(slots, np.int64)
+        valid = slots >= 0
+        out = {}
+        for f in access.pull_fields:
+            arr = np.asarray(state[f])
+            rows = arr[np.where(valid, slots, 0)]
+            rows[~valid] = 0
+            out[f] = rows
+        return out
+
+    def push(self, state, slots, grads, access):
+        slots = np.asarray(slots, np.int64)
+        valid = slots >= 0
+        uniq = np.unique(slots[valid])
+        combined = {}
+        for f in access.grad_fields:
+            g = np.asarray(grads[f], np.float32)
+            width = g.shape[1]
+            acc = np.zeros((len(uniq), width), np.float32)
+            pos = np.searchsorted(uniq, slots[valid])
+            np.add.at(acc, pos, g[valid])
+            combined[f] = acc
+        current = {f: np.asarray(state[f])[uniq] for f in access.fields}
+        updated = access.apply_push(current, combined)
+        out = {f: np.asarray(state[f]).copy() for f in state}
+        for f in access.fields:
+            out[f][uniq] = np.asarray(updated[f])
+        return out

@@ -1,0 +1,153 @@
+"""``tpu`` transfer backend: explicit SPMD routing via shard_map.
+
+The literal TPU-native rendering of the reference pull/push RPC
+(SURVEY.md §3.2-3.3): on a 1-D ``shard`` mesh every device plays both roles
+— worker (holds a batch slice) and server (holds a table shard) — exactly
+like every reference MPI rank hosting both endpoints
+(`/root/reference/src/cluster/cluster.h:65-71`).  One pull is:
+
+  1. bucket my local slot requests by owning shard   (arrange_local_vals,
+     global_pull_access.h:46-60)
+  2. ``all_to_all`` request buckets over ICI          (Transfer::send +
+     main_loop recv, transfer.h:86-192)
+  3. owners gather rows from their local shard slice  (PullAccessAgent,
+     accessmethod.h:63-70)
+  4. ``all_to_all`` rows back, unpermute to request order
+     (response callbacks + StateBarrier, global_pull_access.h:80-101)
+
+and the barrier is implicit in program order.  Push routes (slot, grad)
+pairs the same way; owners segment-sum what they receive and apply the
+access method once per row (see api.py for the sum-vs-sequential semantic
+note).  All shapes are static: request buckets are fixed-capacity
+``(n_shards, C)`` with ``-1`` padding routed to out-of-bounds scatter drops.
+
+Requires: table row-sharded and batch sharded over the same mesh axis, and
+``KeyIndex.num_shards`` == axis size so slot ranges align with device rows.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from swiftmpi_tpu.cluster.mesh import SHARD_AXIS
+from swiftmpi_tpu.parameter.access import AccessMethod
+from swiftmpi_tpu.transfer.api import TableState, Transfer
+
+
+def _bucketize(slots_l: jax.Array, n: int, cap_per_shard: int, C: int):
+    """Group local slot requests by owner shard into an (n, C) matrix.
+
+    Returns (req, order, so, idx_in_bucket) where ``req[o, j]`` is the
+    owner-local row id of my j-th request to shard o (-1 padding), and the
+    rest reconstructs request order on the way back.
+    """
+    B = slots_l.shape[0]
+    valid = slots_l >= 0
+    owner = jnp.where(valid, slots_l // cap_per_shard, n)  # n == "invalid"
+    order = jnp.argsort(owner)
+    so = owner[order]                       # sorted owners, invalid last
+    local_row = jnp.where(valid, slots_l % cap_per_shard, 0)[order]
+    # position within each owner group: arange - group start
+    group_start = jnp.searchsorted(so, jnp.arange(n + 1))
+    idx_in_bucket = jnp.arange(B) - group_start[jnp.clip(so, 0, n)]
+    in_bounds = (so < n) & (idx_in_bucket < C)
+    row_idx = jnp.where(in_bounds, so, n)          # OOB row -> dropped
+    col_idx = jnp.where(in_bounds, idx_in_bucket, 0)
+    req = jnp.full((n, C), -1, jnp.int32).at[row_idx, col_idx].set(
+        local_row.astype(jnp.int32), mode="drop")
+    return req, order, so, idx_in_bucket
+
+
+class TpuTransfer(Transfer):
+    name = "tpu"
+
+    def __init__(self, mesh: Mesh, axis: str = SHARD_AXIS,
+                 bucket_capacity: Optional[int] = None):
+        """``bucket_capacity``: per-destination request slots; defaults to
+        the full local batch (no overflow possible).  Smaller values cut
+        all_to_all volume ~proportionally but drop overflow requests —
+        only safe when keys are known to spread (reference demo configs
+        rely on the same spread via frag_num >> server_num)."""
+        self.mesh = mesh
+        self.axis = axis
+        self.n = int(mesh.shape[axis])
+        self.bucket_capacity = bucket_capacity
+
+    # -- pull --------------------------------------------------------------
+    def pull(self, state, slots, access):
+        capacity = next(iter(state.values())).shape[0]
+        cap_per_shard = capacity // self.n
+        state_specs = {f: P(self.axis) for f in state}
+        pull_specs = {f: P(self.axis) for f in access.pull_fields}
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(state_specs, P(self.axis)),
+                 out_specs=pull_specs, check_vma=False)
+        def _pull(state_l, slots_l):
+            B = slots_l.shape[0]
+            C = self.bucket_capacity or B
+            req, order, so, idx = _bucketize(
+                slots_l, self.n, cap_per_shard, C)
+            got = jax.lax.all_to_all(req, self.axis, 0, 0, tiled=True)
+            ok = got >= 0
+            safe = jnp.where(ok, got, 0)
+            out = {}
+            for f in access.pull_fields:
+                rows = jnp.take(state_l[f], safe.reshape(-1), axis=0)
+                rows = rows.reshape(self.n, C, -1) * ok[..., None]
+                resp = jax.lax.all_to_all(rows, self.axis, 0, 0, tiled=True)
+                vals = resp[jnp.clip(so, 0, self.n - 1),
+                            jnp.clip(idx, 0, C - 1)]
+                vals = vals * ((so < self.n) & (idx < C))[:, None]
+                out[f] = jnp.zeros((B, vals.shape[1]),
+                                   vals.dtype).at[order].set(vals)
+            return out
+
+        return _pull(state, jnp.asarray(slots, jnp.int32))
+
+    # -- push --------------------------------------------------------------
+    def push(self, state, slots, grads, access):
+        capacity = next(iter(state.values())).shape[0]
+        cap_per_shard = capacity // self.n
+        state_specs = {f: P(self.axis) for f in state}
+        grad_specs = {f: P(self.axis) for f in access.grad_fields}
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(state_specs, P(self.axis), grad_specs),
+                 out_specs=state_specs, check_vma=False)
+        def _push(state_l, slots_l, grads_l):
+            B = slots_l.shape[0]
+            C = self.bucket_capacity or B
+            req, order, so, idx = _bucketize(
+                slots_l, self.n, cap_per_shard, C)
+            got = jax.lax.all_to_all(req, self.axis, 0, 0, tiled=True)
+            ok = got >= 0
+            # received (slot, grad) pairs -> dense per-shard grad sums;
+            # untouched rows get exact zero and the access rule is a no-op.
+            safe_rows = jnp.where(ok, got, cap_per_shard).reshape(-1)
+            dense = {}
+            for f in access.grad_fields:
+                g = jnp.asarray(grads_l[f])
+                width = g.shape[1]
+                # forward my buckets' grads in the same (n, C) layout
+                bucket = jnp.zeros((self.n, C, width), g.dtype)
+                row_idx = jnp.where((so < self.n) & (idx < C), so, self.n)
+                col_idx = jnp.clip(idx, 0, C - 1)
+                bucket = bucket.at[row_idx, col_idx].set(
+                    g[order], mode="drop")
+                recv = jax.lax.all_to_all(bucket, self.axis, 0, 0,
+                                          tiled=True)
+                acc = jnp.zeros((cap_per_shard, width), g.dtype)
+                dense[f] = acc.at[safe_rows].add(
+                    recv.reshape(-1, width), mode="drop")
+            new_fields = access.apply_push(state_l, dense)
+            out = dict(state_l)
+            out.update(new_fields)
+            return out
+
+        return _push(state, jnp.asarray(slots, jnp.int32), grads)

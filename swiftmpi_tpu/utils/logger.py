@@ -29,4 +29,9 @@ def get_logger(name: str = "swiftmpi_tpu") -> logging.Logger:
         root.setLevel(level)
         root.propagate = False
         _configured = True
+    # Names outside the package hierarchy are adopted under it so they get
+    # the configured handler/level instead of logging's WARNING-only
+    # lastResort fallback.
+    if name != "swiftmpi_tpu" and not name.startswith("swiftmpi_tpu."):
+        name = f"swiftmpi_tpu.{name}"
     return logging.getLogger(name)

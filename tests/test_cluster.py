@@ -1,0 +1,89 @@
+"""Tests for the cluster layer: mesh construction and hashfrag routing."""
+
+import jax
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.cluster import (DATA_AXIS, MODEL_AXIS, SHARD_AXIS, HashFrag,
+                                  MeshSpec, build_mesh, mesh_info, ps_mesh)
+from swiftmpi_tpu.utils import BinaryBuffer, get_hash_code
+
+
+# -- mesh -----------------------------------------------------------------
+
+def test_build_mesh_default_spec(devices8):
+    mesh = build_mesh()
+    assert mesh.axis_names == (DATA_AXIS, MODEL_AXIS)
+    assert mesh.devices.shape == (8, 1)
+
+
+def test_build_mesh_2d(devices8):
+    mesh = build_mesh(MeshSpec.from_dict({"data": 2, "model": 4}))
+    assert mesh.devices.shape == (2, 4)
+    info = mesh_info(mesh)
+    assert info["n_devices"] == 8
+    assert info["platform"] == "cpu"
+    assert not info["multi_host"]
+
+
+def test_build_mesh_wildcard(devices8):
+    mesh = build_mesh(MeshSpec.from_dict({"data": -1, "model": 2}))
+    assert mesh.devices.shape == (4, 2)
+
+
+def test_build_mesh_bad_specs(devices8):
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec.from_dict({"data": -1, "model": -1}))
+    with pytest.raises(ValueError):
+        build_mesh(MeshSpec.from_dict({"data": 3, "model": 2}))
+
+
+def test_ps_mesh(devices8):
+    mesh = ps_mesh()
+    assert mesh.axis_names == (SHARD_AXIS,)
+    assert mesh.devices.shape == (8,)
+    assert ps_mesh(4).devices.shape == (4,)
+
+
+# -- hashfrag -------------------------------------------------------------
+
+def test_hashfrag_block_assignment_matches_reference_rule():
+    # frag i -> i // (num_frags // num_shards), clamped (hashfrag.h:41-49)
+    hf = HashFrag(num_shards=3, num_frags=10)
+    # per = 3; frags 0-2 -> 0, 3-5 -> 1, 6-8 -> 2, 9 -> clamp -> 2
+    expected = [0, 0, 0, 1, 1, 1, 2, 2, 2, 2]
+    assert hf.map_table.tolist() == expected
+
+
+def test_hashfrag_routing_uses_murmur():
+    hf = HashFrag(num_shards=4, num_frags=1000)
+    keys = np.array([0, 1, 42, 2**40], dtype=np.uint64)
+    shards = hf.to_shard_id(keys)
+    for k, s in zip(keys.tolist(), shards.tolist()):
+        frag = get_hash_code(int(k)) % 1000
+        assert hf.map_table[frag] == s
+    assert (hf.to_node_id(keys) == shards + 1).all()
+
+
+def test_hashfrag_routing_is_balanced():
+    hf = HashFrag(num_shards=8, num_frags=8000)
+    keys = np.arange(100_000, dtype=np.uint64)
+    counts = np.bincount(hf.to_shard_id(keys), minlength=8)
+    # murmur avalanche should spread uniformly within a few percent
+    assert counts.min() > 0.9 * counts.mean()
+    assert counts.max() < 1.1 * counts.mean()
+
+
+def test_hashfrag_serialize_roundtrip():
+    hf = HashFrag(num_shards=5, num_frags=123)
+    bb = BinaryBuffer()
+    hf.serialize(bb)
+    hf2 = HashFrag.deserialize(bb)
+    assert hf == hf2
+
+
+def test_hashfrag_validation():
+    with pytest.raises(ValueError):
+        HashFrag(num_shards=0)
+    with pytest.raises(ValueError):
+        HashFrag(num_shards=10, num_frags=5)

@@ -1,0 +1,140 @@
+"""Tests for the parameter layer: KeyIndex, access methods, SparseTable, cache."""
+
+import jax
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.cluster import SHARD_AXIS, ps_mesh
+from swiftmpi_tpu.parameter import (CapacityError, KeyIndex, LocalParamCache,
+                                    SparseTable, lr_access, w2v_access)
+
+
+# -- KeyIndex -------------------------------------------------------------
+
+def test_key_index_lazy_assignment_and_stability():
+    ki = KeyIndex(num_shards=4, capacity_per_shard=8)
+    keys = np.array([10, 20, 10, 30], dtype=np.uint64)
+    slots = ki.lookup(keys)
+    assert slots[0] == slots[2]  # same key, same slot
+    assert len(set(slots.tolist())) == 3
+    assert len(ki) == 3
+    # second lookup does not move anything
+    assert np.array_equal(ki.lookup(keys), slots)
+
+
+def test_key_index_slot_in_owning_shard_range():
+    ki = KeyIndex(num_shards=4, capacity_per_shard=8)
+    keys = np.arange(20, dtype=np.uint64)
+    slots = ki.lookup(keys)
+    shards = ki.shard_of(keys)
+    assert np.array_equal(slots // 8, shards)
+
+
+def test_key_index_no_create():
+    ki = KeyIndex(num_shards=2, capacity_per_shard=4)
+    assert ki.lookup([7], create=False)[0] == -1
+    assert len(ki) == 0
+    ki.lookup([7])
+    assert ki.lookup([7], create=False)[0] >= 0
+
+
+def test_key_index_capacity_error():
+    ki = KeyIndex(num_shards=1, capacity_per_shard=2)
+    ki.lookup([1, 2])
+    with pytest.raises(CapacityError):
+        ki.lookup([3])
+
+
+# -- access methods -------------------------------------------------------
+
+def test_adagrad_matches_reference_math():
+    # Reference WPushAccessMethod (word2vec.h:177-185):
+    #   h2sum += g^2 ; h += lr * g / sqrt(h2sum + 1e-6)
+    access = w2v_access(learning_rate=0.7, len_vec=3)
+    params = {
+        "h": np.array([[1.0, 2.0, 3.0]], np.float32),
+        "h2sum": np.array([[0.5, 0.5, 0.5]], np.float32),
+        "v": np.zeros((1, 3), np.float32),
+        "v2sum": np.zeros((1, 3), np.float32),
+    }
+    g = np.array([[0.1, -0.2, 0.3]], np.float32)
+    out = access.apply_push(params, {"h": g, "v": np.zeros((1, 3), np.float32)})
+    h2sum = 0.5 + g**2
+    expected_h = params["h"] + 0.7 * g / np.sqrt(h2sum + 1e-6)
+    np.testing.assert_allclose(np.asarray(out["h2sum"]), h2sum, rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(out["h"]), expected_h, rtol=1e-6)
+    # v got zero grad: exact no-op
+    np.testing.assert_array_equal(np.asarray(out["v"]), params["v"])
+    np.testing.assert_array_equal(np.asarray(out["v2sum"]), params["v2sum"])
+
+
+def test_lr_access_scalar_row():
+    access = lr_access(learning_rate=0.05)
+    params = {"val": np.array([[0.3]], np.float32),
+              "grad2sum": np.array([[0.0]], np.float32)}
+    out = access.apply_push(params, {"val": np.array([[2.0]], np.float32)})
+    assert np.asarray(out["grad2sum"])[0, 0] == pytest.approx(4.0)
+    assert np.asarray(out["val"])[0, 0] == pytest.approx(
+        0.3 + 0.05 * 2.0 / np.sqrt(4.0 + 1e-6))
+
+
+# -- SparseTable ----------------------------------------------------------
+
+def test_sparse_table_init_distributions():
+    access = w2v_access(learning_rate=0.1, len_vec=16)
+    ki = KeyIndex(num_shards=2, capacity_per_shard=64)
+    table = SparseTable(access, ki)
+    h = np.asarray(table.state["h"])
+    # Vec::randInit: (U(0,1)-0.5)/dim  (vec1.h:229-232)
+    assert abs(h).max() <= 0.5 / 16 + 1e-6
+    assert h.std() > 0  # actually random
+    np.testing.assert_array_equal(np.asarray(table.state["h2sum"]), 0)
+
+
+def test_sparse_table_sharded_placement(devices8):
+    mesh = ps_mesh()
+    access = lr_access(0.05)
+    ki = KeyIndex(num_shards=8, capacity_per_shard=4)
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS)
+    sharding = table.state["val"].sharding
+    assert sharding.spec == jax.sharding.PartitionSpec(SHARD_AXIS)
+    assert table.capacity == 32
+
+
+def test_sparse_table_shard_count_must_divide():
+    access = lr_access(0.05)
+    ki = KeyIndex(num_shards=3, capacity_per_shard=4)
+    with pytest.raises(ValueError):
+        SparseTable(access, ki, mesh=ps_mesh(), axis=SHARD_AXIS)
+
+
+def test_sparse_table_gather():
+    access = lr_access(0.05)
+    ki = KeyIndex(num_shards=2, capacity_per_shard=8)
+    table = SparseTable(access, ki)
+    slots = ki.lookup(np.array([5, 6, 5], dtype=np.uint64))
+    rows = table.gather(slots)
+    assert rows["val"].shape == (3, 1)
+    np.testing.assert_array_equal(np.asarray(rows["val"][0]),
+                                  np.asarray(rows["val"][2]))
+
+
+# -- LocalParamCache ------------------------------------------------------
+
+def test_cache_accumulate_and_normalize():
+    cache = LocalParamCache({"v": 2}, {"v": 2})
+    cache.init_keys([100, 200])
+    p = cache.positions([100, 200, 100])
+    cache.accumulate("v", p, np.array([[1, 1], [2, 2], [3, 3]], np.float32))
+    # key 100 got two contributions -> mean; key 200 one
+    norm = cache.normalized_grads()
+    np.testing.assert_allclose(norm["v"][cache.position(100)], [2.0, 2.0])
+    np.testing.assert_allclose(norm["v"][cache.position(200)], [2.0, 2.0])
+    cache.reset_grads()
+    assert cache.grads["v"].sum() == 0
+
+
+def test_cache_dedups_keys():
+    cache = LocalParamCache({"v": 1})
+    cache.init_keys([1, 2, 1, 3])
+    assert len(cache) == 3
