@@ -1,0 +1,1 @@
+"""App CLIs, flag-compatible with the reference mains."""

@@ -1,0 +1,8 @@
+"""Host-side input pipelines: libSVM (LR) and text corpora (word2vec)."""
+
+from swiftmpi_tpu.data.libsvm import (LibSVMBatch, iter_minibatches,
+                                      load_file, make_batch, parse_line,
+                                      synthetic_dataset)
+
+__all__ = ["LibSVMBatch", "iter_minibatches", "load_file", "make_batch",
+           "parse_line", "synthetic_dataset"]

@@ -154,17 +154,6 @@ def load_checkpoint(table: SparseTable, path: str) -> Dict[str, np.ndarray]:
         for name in table.access.fields:
             state[name] = _replace(table, name, z[f"field__{name}"])
         table.state = state
-        ki = table.key_index
-        ki._slot_of.clear()
-        ki._next_local[:] = 0
-        for lst in ki._keys_by_shard:
-            lst.clear()
-        per = ki.capacity_per_shard
-        for key, slot in zip(z["keys"].tolist(), z["slots"].tolist()):
-            shard = slot // per
-            ki._slot_of[int(key)] = int(slot)
-            ki._keys_by_shard[shard].append(int(key))
-            ki._next_local[shard] = max(ki._next_local[shard],
-                                        slot % per + 1)
+        table.key_index.restore(z["keys"], z["slots"])
         return {k[len("extra__"):]: z[k] for k in z.files
                 if k.startswith("extra__")}

@@ -1,0 +1,9 @@
+"""Model families: the reference's three apps, TPU-native.
+
+logistic (lr.cpp), word2vec sync+async (word2vec.h / word2vec_global.h),
+sent2vec (sent2vec.cpp).
+"""
+
+from swiftmpi_tpu.models.logistic import LogisticRegression
+
+__all__ = ["LogisticRegression"]

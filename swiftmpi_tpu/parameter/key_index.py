@@ -111,3 +111,21 @@ class KeyIndex:
     def shard_fill(self) -> np.ndarray:
         """Occupied slots per shard (load-balance introspection)."""
         return self._next_local.copy()
+
+    # -- checkpoint restore ------------------------------------------------
+    def restore(self, keys, slots) -> None:
+        """Rebuild the index from saved (key, slot) pairs, preserving the
+        ``slot = shard * capacity_per_shard + local`` layout invariant."""
+        self._slot_of.clear()
+        self._next_local[:] = 0
+        for lst in self._keys_by_shard:
+            lst.clear()
+        per = self.capacity_per_shard
+        for key, slot in zip(np.asarray(keys, np.uint64).tolist(),
+                             np.asarray(slots, np.int64).tolist()):
+            shard, local = divmod(int(slot), per)
+            if not (0 <= shard < self.num_shards):
+                raise ValueError(f"slot {slot} outside table layout")
+            self._slot_of[int(key)] = int(slot)
+            self._keys_by_shard[shard].append(int(key))
+            self._next_local[shard] = max(self._next_local[shard], local + 1)

@@ -41,14 +41,11 @@ serialization, word2vec.h:120-132) stays the caller's job via
 
 from __future__ import annotations
 
-from typing import Dict, Optional
-
-import jax
+from typing import Optional
 
 from swiftmpi_tpu.parameter.access import AccessMethod
+from swiftmpi_tpu.parameter.sparse_table import TableState
 from swiftmpi_tpu.utils.config import ConfigParser
-
-TableState = Dict[str, jax.Array]
 
 
 class Transfer:

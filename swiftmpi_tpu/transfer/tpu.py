@@ -35,8 +35,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
 from swiftmpi_tpu.cluster.mesh import SHARD_AXIS
-from swiftmpi_tpu.parameter.access import AccessMethod
-from swiftmpi_tpu.transfer.api import TableState, Transfer
+from swiftmpi_tpu.transfer.api import Transfer
 
 
 def _bucketize(slots_l: jax.Array, n: int, cap_per_shard: int, C: int):
@@ -77,9 +76,31 @@ class TpuTransfer(Transfer):
         self.axis = axis
         self.n = int(mesh.shape[axis])
         self.bucket_capacity = bucket_capacity
+        # jitted shard_map closures, keyed by static shape signature —
+        # without this every pull/push call would re-trace and recompile.
+        self._pull_cache: Dict = {}
+        self._push_cache: Dict = {}
+
+    def _signature(self, state, slots, grads=None):
+        sig = (tuple(sorted((f, v.shape, str(v.dtype))
+                            for f, v in state.items())),
+               tuple(slots.shape))
+        if grads is not None:
+            sig += (tuple(sorted((f, tuple(v.shape))
+                                 for f, v in grads.items())),)
+        return sig
 
     # -- pull --------------------------------------------------------------
     def pull(self, state, slots, access):
+        slots = jnp.asarray(slots, jnp.int32)
+        sig = self._signature(state, slots)
+        fn = self._pull_cache.get(sig)
+        if fn is None:
+            fn = self._pull_cache.setdefault(
+                sig, jax.jit(self._build_pull(state, access)))
+        return fn(state, slots)
+
+    def _build_pull(self, state, access):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
         state_specs = {f: P(self.axis) for f in state}
@@ -108,10 +129,19 @@ class TpuTransfer(Transfer):
                                    vals.dtype).at[order].set(vals)
             return out
 
-        return _pull(state, jnp.asarray(slots, jnp.int32))
+        return _pull
 
     # -- push --------------------------------------------------------------
     def push(self, state, slots, grads, access):
+        slots = jnp.asarray(slots, jnp.int32)
+        sig = self._signature(state, slots, grads)
+        fn = self._push_cache.get(sig)
+        if fn is None:
+            fn = self._push_cache.setdefault(
+                sig, jax.jit(self._build_push(state, access)))
+        return fn(state, slots, grads)
+
+    def _build_push(self, state, access):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
         state_specs = {f: P(self.axis) for f in state}
@@ -150,4 +180,4 @@ class TpuTransfer(Transfer):
             out.update(new_fields)
             return out
 
-        return _push(state, jnp.asarray(slots, jnp.int32), grads)
+        return _push

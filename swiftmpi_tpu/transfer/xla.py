@@ -24,8 +24,7 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
-from swiftmpi_tpu.parameter.access import AccessMethod
-from swiftmpi_tpu.transfer.api import TableState, Transfer
+from swiftmpi_tpu.transfer.api import Transfer
 
 
 def _masked_gather(arr: jax.Array, slots: jax.Array,
@@ -77,6 +76,8 @@ class XlaTransfer(Transfer):
     def _push_sparse(self, state, slots, grads, access):
         capacity = next(iter(state.values())).shape[0]
         B = slots.shape[0]
+        if B == 0:
+            return dict(state)
         valid = slots >= 0
         # Sort so duplicates are adjacent; padding (-1 -> capacity) sorts
         # last and is dropped by OOB scatter below.

@@ -1,0 +1,102 @@
+"""End-to-end logistic regression: parsing, convergence, predict, checkpoint."""
+
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.data import (iter_minibatches, make_batch, parse_line,
+                               synthetic_dataset)
+from swiftmpi_tpu.models import LogisticRegression
+from swiftmpi_tpu.utils import ConfigParser
+
+
+# -- parsing --------------------------------------------------------------
+
+def test_parse_line_libsvm():
+    y, feats = parse_line("1 3:1 11:0.5 14:2")
+    assert y == 1.0 and feats == [(3, 1.0), (11, 0.5), (14, 2.0)]
+    y, _ = parse_line("-1 5:1")
+    assert y == 0.0  # svm2fm label conversion
+    assert parse_line("# comment") is None
+    assert parse_line("   ") is None
+    y, feats = parse_line("1 2:3 # trailing")
+    assert feats == [(2, 3.0)]
+
+
+def test_make_batch_padding():
+    data = [(1.0, [(1, 1.0)]), (0.0, [(2, 1.0), (3, 2.0)])]
+    b = make_batch(data)
+    assert b.feat_ids.shape == (2, 2)
+    assert b.mask.tolist() == [[True, False], [True, True]]
+    assert sorted(b.unique_keys().tolist()) == [1, 2, 3]
+
+
+def test_iter_minibatches_pads_tail_to_static_shape():
+    data = synthetic_dataset(10, dim=20, nnz=3)
+    batches = list(iter_minibatches(data, 4))
+    assert [len(b) for b in batches] == [4, 4, 4]  # tail padded
+    assert batches[-1].mask[-2:].sum() == 0
+
+
+# -- training -------------------------------------------------------------
+
+def make_model(**cfg_overrides):
+    cfg = ConfigParser().update({
+        "cluster": {"server_num": 2, "transfer": "xla"},
+        "worker": {"minibatch": 50},
+        "server": {"initial_learning_rate": 0.5, "frag_num": 200},
+        **cfg_overrides,
+    })
+    return LogisticRegression(config=cfg, capacity_per_shard=2048)
+
+
+def test_lr_converges_on_separable_data(devices8):
+    data = synthetic_dataset(400, dim=50, nnz=5, seed=3)
+    model = make_model()
+    losses = model.train(data, niters=6)
+    assert losses[-1] < losses[0] * 0.5, losses
+    assert model.error_rate(data) < 0.15
+
+
+def test_lr_predict_range_and_shape(devices8):
+    data = synthetic_dataset(60, dim=30, nnz=4, seed=1)
+    model = make_model()
+    model.train(data, niters=2)
+    scores = model.predict(data)
+    assert scores.shape == (60,)
+    assert (scores >= 0).all() and (scores <= 1).all()
+
+
+def test_lr_checkpoint_roundtrip(tmp_path, devices8):
+    data = synthetic_dataset(100, dim=30, nnz=4, seed=2)
+    model = make_model()
+    model.train(data, niters=2)
+    path = str(tmp_path / "weights.txt")
+    n = model.save(path)
+    assert n == len(model.table.key_index)
+    # reference format: "key\tweight"
+    line = open(path).readline().strip().split("\t")
+    assert len(line) == 2
+    float(line[1])
+
+    model2 = make_model()
+    model2.load(path)
+    np.testing.assert_allclose(model.predict(data), model2.predict(data),
+                               rtol=1e-5, atol=1e-6)
+
+
+def test_lr_cli(tmp_path, devices8):
+    from swiftmpi_tpu.apps.lr_main import main
+    data = synthetic_dataset(80, dim=20, nnz=4, seed=5)
+    train_file = tmp_path / "train.svm"
+    with open(train_file, "w") as f:
+        for y, feats in data:
+            f.write(f"{int(y)} " + " ".join(
+                f"{k}:{v:.4f}" for k, v in feats) + "\n")
+    weights = str(tmp_path / "w.txt")
+    assert main(["lr", "-mode", "train", "-dataset", str(train_file),
+                 "-niters", "2", "-output", weights]) == 0
+    assert len(open(weights).readlines()) > 0
+    preds = str(tmp_path / "p.txt")
+    assert main(["lr", "-mode", "predict", "-dataset", str(train_file),
+                 "-param", weights, "-output", preds]) == 0
+    assert len(open(preds).readlines()) == 80

@@ -95,6 +95,31 @@ def test_pull_padding_returns_zero_rows(w2v_setup):
                 np.asarray(rows[f])[slots < 0], 0)
 
 
+def test_push_empty_batch_is_noop(devices8):
+    access = lr_access(0.05)
+    table, ki = make_table(access)
+    grads = {"val": np.zeros((0, 1), np.float32)}
+    out = XlaTransfer().push(table.state, np.zeros(0, np.int32), grads,
+                             access)
+    for f in access.fields:
+        np.testing.assert_array_equal(np.asarray(table.state[f]),
+                                      np.asarray(out[f]))
+
+
+def test_tpu_backend_caches_compiled_fns(devices8):
+    mesh = ps_mesh()
+    access = lr_access(0.05)
+    table, ki = make_table(access, mesh=mesh)
+    slots = ki.lookup(np.arange(16, dtype=np.uint64))
+    t = TpuTransfer(mesh)
+    t.pull(table.state, slots, access)
+    assert len(t._pull_cache) == 1
+    t.pull(table.state, slots, access)
+    assert len(t._pull_cache) == 1  # same signature -> same compiled fn
+    t.pull(table.state, slots[:8], access)
+    assert len(t._pull_cache) == 2  # new batch shape -> new entry
+
+
 def test_push_all_padding_is_noop(devices8):
     mesh = ps_mesh()
     access = lr_access(0.05)
