@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Headline benchmark: word2vec CBOW+NS training throughput on TPU.
+
+Reproduces the BASELINE.md primary metric (word2vec text8 words/sec +
+epoch wall-clock) at the reference demo.conf hyperparameters
+(len_vec=100, window=4, negative=20 — /root/reference/src/apps/word2vec/
+demo.conf) on a text8-scale synthetic corpus (the real text8 is not in the
+zero-egress image; vocab size and Zipf shape match).
+
+``vs_baseline`` is measured, not assumed: the same fused training step is
+timed on the host CPU backend in this process as the stand-in for the
+reference's CPU cluster (the reference publishes no numbers — BASELINE.md;
+its 8-rank OpenMPI deployment is husked onto one host here, and the JAX CPU
+backend is itself multithreaded).
+
+Prints ONE json line:
+  {"metric": ..., "value": N, "unit": "words/s", "vs_baseline": R}
+"""
+
+import json
+import sys
+import time
+
+import numpy as np
+
+sys.path.insert(0, "/root/repo")
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from swiftmpi_tpu.data.text import CBOWBatcher, build_vocab, synthetic_corpus  # noqa: E402
+from swiftmpi_tpu.models.word2vec import Word2Vec  # noqa: E402
+from swiftmpi_tpu.utils import ConfigParser  # noqa: E402
+
+# reference text8 run shape (demo.conf) scaled to a quick, stable benchmark
+VOCAB = 30_000
+SENTENCES = 600
+SENT_LEN = 500
+BATCH = 4096
+WARMUP_STEPS = 3
+TIMED_STEPS = 30
+CPU_TIMED_STEPS = 6
+
+
+def build(device):
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
+                     "sample": 1e-4, "learning_rate": 0.05},
+        "server": {"initial_learning_rate": 0.7, "frag_num": 1000},
+        "worker": {"minibatch": 5000},
+    })
+    with jax.default_device(device):
+        from swiftmpi_tpu.cluster.cluster import Cluster
+        model = Word2Vec(
+            config=cfg, cluster=Cluster(cfg, devices=[device]).initialize())
+        corpus = synthetic_corpus(SENTENCES, VOCAB, SENT_LEN, seed=11)
+        model.build(corpus)
+        step = model._build_step()
+        batcher = CBOWBatcher(corpus, model.vocab, model.window,
+                              model.sample, seed=5)
+        batches = []
+        for b in batcher.epoch(BATCH):
+            batches.append(b)
+            if len(batches) >= 8:
+                break
+        return model, step, batches
+
+
+def run(device, timed_steps):
+    model, step, batches = build(device)
+    with jax.default_device(device):
+        state = {f: jax.device_put(v, device)
+                 for f, v in model.table.state.items()}
+        sov = jax.device_put(model._slot_of_vocab, device)
+        ap = jax.device_put(model._alias_prob, device)
+        ai = jax.device_put(model._alias_idx, device)
+        key = jax.random.key(0)
+        dev_batches = [
+            (jax.device_put(jnp.asarray(b.centers), device),
+             jax.device_put(jnp.asarray(b.contexts), device),
+             jax.device_put(jnp.asarray(b.ctx_mask), device),
+             b.n_words) for b in batches]
+
+        def one(state, key, i):
+            c, x, m, _ = dev_batches[i % len(dev_batches)]
+            key, sub = jax.random.split(key)
+            state, es, ec = step(state, sov, ap, ai, c, x, m, sub)
+            return state, key, es
+
+        for i in range(WARMUP_STEPS):
+            state, key, es = one(state, key, i)
+        jax.block_until_ready(state)
+        words = 0
+        t0 = time.perf_counter()
+        for i in range(timed_steps):
+            state, key, es = one(state, key, i)
+            words += dev_batches[i % len(dev_batches)][3]
+        jax.block_until_ready(state)
+        dt = time.perf_counter() - t0
+    return words / dt, float(es)
+
+
+def main():
+    devs = jax.devices()
+    tpu_dev = devs[0]
+    cpu_dev = jax.devices("cpu")[0]
+    tpu_wps, _ = run(tpu_dev, TIMED_STEPS)
+    cpu_wps, _ = run(cpu_dev, CPU_TIMED_STEPS)
+    print(json.dumps({
+        "metric": "word2vec_cbow_ns_words_per_sec",
+        "value": round(tpu_wps, 1),
+        "unit": "words/s",
+        "vs_baseline": round(tpu_wps / cpu_wps, 2),
+        "detail": {
+            "device": str(tpu_dev),
+            "cpu_baseline_words_per_sec": round(cpu_wps, 1),
+            "config": "len_vec=100 window=4 negative=20 batch=4096",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

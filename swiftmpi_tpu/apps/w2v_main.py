@@ -1,0 +1,57 @@
+"""word2vec CLI, flag-compatible with the reference mains.
+
+Reference: ``/root/reference/src/apps/word2vec/w2v.cpp`` and
+``w2v_local.cpp`` (identical CLIs: ``-config <conf> -data <corpus>
+-niters N -output <path>``).  The two reference binaries differ in variant
+(async/global with BKDR string keys vs sync with integer keys); here one
+CLI takes ``-variant async|sync`` (default sync) which selects the
+tokenizer and the local-steps staleness mode.
+"""
+
+from __future__ import annotations
+
+import sys
+
+from swiftmpi_tpu.data.text import load_corpus
+from swiftmpi_tpu.models.word2vec import Word2Vec
+from swiftmpi_tpu.utils import CMDLine, global_config
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger("apps.w2v")
+
+
+def main(argv=None) -> int:
+    cmd = CMDLine(argv)
+    cmd.registerParameter("help", "this screen")
+    cmd.registerParameter("config", "path of config file")
+    cmd.registerParameter("data", "path of dataset")
+    cmd.registerParameter("niters", "number of iterations")
+    cmd.registerParameter("output", "path to output the embeddings")
+    cmd.registerParameter("variant", "sync (int keys) | async (hashed keys)")
+    if cmd.hasParameter("help") or not cmd.hasParameter("data"):
+        cmd.print_help()
+        return 0
+
+    if cmd.hasParameter("config"):
+        global_config().load_conf(cmd.getValue("config")).parse()
+    variant = cmd.getValue("variant", "sync")
+    if variant not in ("sync", "async"):
+        log.error("unknown -variant %r (expected sync|async)", variant)
+        return 1
+    if variant == "async":
+        global_config().set("word2vec", "local_steps", 4)
+    mode = "bkdr" if variant == "async" else "int"
+
+    model = Word2Vec()
+    corpus = load_corpus(cmd.getValue("data"), mode=mode,
+                         min_sentence_length=model.min_sentence_length)
+    losses = model.train(corpus, niters=int(cmd.getValue("niters", "1")))
+    log.info("final error: %.5f", losses[-1])
+    if cmd.hasParameter("output"):
+        n = model.save(cmd.getValue("output"))
+        log.info("wrote %d embeddings -> %s", n, cmd.getValue("output"))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,194 @@
+"""Text corpus pipeline for word2vec: vocab, subsampling, CBOW batches.
+
+Host-side equivalent of the reference gather/scan machinery:
+
+* vocab + frequency build — the async variant's one global ``gather_keys``
+  pass (`/root/reference/src/apps/word2vec/word2vec_global.h:385-444`).
+* key derivation — both reference conventions: ``int`` (tokens are already
+  integer ids, ``hash_fn2``/atoi, word2vec.h:206) and ``bkdr`` (string
+  hash, word2vec_global.h:205-207).
+* CBOW window extraction with the per-position random shrink ``b = rand %
+  window`` giving effective half-window ``window - b`` (word2vec.h:555,
+  567-576), subsampling by the reference keep-rule, and
+  ``min_sentence_length`` filtering (word2vec.h:212-224).
+
+Output batches are static-shape: ``centers (B,)``, ``contexts (B, 2W)`` +
+mask, all as *vocab indices* (0..V-1); the model maps vocab index → table
+slot on device.  Batch assembly is numpy; the C++ native loader is a
+drop-in replacement for `iter_cbow_batches` (swiftmpi_tpu.data.native).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from swiftmpi_tpu.ops.sampling import subsample_keep_prob
+from swiftmpi_tpu.utils.hashing import bkdr_hash
+
+
+@dataclass
+class Vocab:
+    keys: np.ndarray     # (V,) uint64 external key per vocab index
+    counts: np.ndarray   # (V,) int64 corpus frequency
+    index: Dict[int, int]  # key -> vocab index
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @property
+    def total_words(self) -> int:
+        return int(self.counts.sum())
+
+
+def tokenize(line: str, mode: str = "int") -> List[int]:
+    """Words -> integer keys: ``int`` = atoi (sync variant), ``bkdr`` =
+    string hash (async variant)."""
+    words = line.split()
+    if mode == "int":
+        out = []
+        for w in words:
+            try:
+                out.append(int(w))
+            except ValueError:
+                out.append(bkdr_hash(w))
+        return out
+    if mode == "bkdr":
+        return [bkdr_hash(w) for w in words]
+    raise ValueError(f"unknown tokenize mode {mode!r}")
+
+
+def build_vocab(sentences: Sequence[Sequence[int]],
+                min_count: int = 1) -> Vocab:
+    counts: Dict[int, int] = {}
+    for sent in sentences:
+        for k in sent:
+            counts[k] = counts.get(k, 0) + 1
+    items = [(k, c) for k, c in counts.items() if c >= min_count]
+    items.sort(key=lambda kc: (-kc[1], kc[0]))  # frequent-first, stable
+    keys = np.array([k for k, _ in items], np.uint64)
+    cnts = np.array([c for _, c in items], np.int64)
+    return Vocab(keys, cnts, {int(k): i for i, (k, _) in enumerate(items)})
+
+
+def load_corpus(path: str, mode: str = "int",
+                min_sentence_length: int = 1,
+                max_sentence_length: int = 1000) -> List[List[int]]:
+    """Sentences as key lists; one line = one sentence, except single-line
+    corpora (text8) which are chopped into ``max_sentence_length`` chunks
+    (the reference reads text8 line-wise too — its LineFileReader returns
+    the one giant line; chunking bounds the window scan the same way the
+    reference's 1000-word sentence cap does in original word2vec)."""
+    sentences = []
+    with open(path) as f:
+        for line in f:
+            toks = tokenize(line, mode)
+            for i in range(0, len(toks), max_sentence_length):
+                chunk = toks[i:i + max_sentence_length]
+                if len(chunk) >= min_sentence_length:
+                    sentences.append(chunk)
+    return sentences
+
+
+@dataclass
+class CBOWBatch:
+    centers: np.ndarray   # (B,) int32 vocab indices
+    contexts: np.ndarray  # (B, 2W) int32 vocab indices; 0 at padding
+    ctx_mask: np.ndarray  # (B, 2W) bool
+    n_words: int          # real (unpadded) center count
+
+    def __len__(self) -> int:
+        return len(self.centers)
+
+
+class CBOWBatcher:
+    """Streams fixed-size CBOW batches over a corpus."""
+
+    def __init__(self, sentences: Sequence[Sequence[int]], vocab: Vocab,
+                 window: int, sample: float = -1.0, seed: int = 2008):
+        self.vocab = vocab
+        self.window = int(window)
+        self.sample = float(sample)
+        self.rng = np.random.default_rng(seed)
+        self.keep_prob = subsample_keep_prob(vocab.counts, sample)
+        # pre-map sentences to vocab indices, dropping OOV
+        self._sents: List[np.ndarray] = []
+        for sent in sentences:
+            idx = [vocab.index[k] for k in sent if k in vocab.index]
+            if idx:
+                self._sents.append(np.asarray(idx, np.int32))
+
+    def epoch(self, batch_size: int) -> Iterator[CBOWBatch]:
+        """One pass over the corpus in a fresh random sentence order.
+
+        Subsampling follows the reference exactly: ``to_sample`` gates only
+        the *center* position (word2vec.h:561-562 ``continue``); dropped
+        words still appear in their neighbors' context windows.
+        """
+        W = self.window
+        centers: List[int] = []
+        ctxs: List[np.ndarray] = []
+        masks: List[np.ndarray] = []
+
+        def flush(n_real):
+            c = np.asarray(centers[:batch_size], np.int32)
+            x = np.stack(ctxs[:batch_size])
+            m = np.stack(masks[:batch_size])
+            del centers[:batch_size], ctxs[:batch_size], masks[:batch_size]
+            return CBOWBatch(c, x, m, n_real)
+
+        for si in self.rng.permutation(len(self._sents)):
+            sent = self._sents[si]
+            L = len(sent)
+            # per-position random shrink b in [0, W)  (word2vec.h:555)
+            bs = self.rng.integers(0, W, size=L)
+            if self.sample >= 0:
+                center_keep = (self.rng.random(L)
+                               < self.keep_prob[sent])
+            else:
+                center_keep = np.ones(L, bool)
+            for pos in range(L):
+                if not center_keep[pos]:
+                    continue
+                half = W - int(bs[pos])
+                lo, hi = max(0, pos - half), min(L, pos + half + 1)
+                ctx = np.concatenate([sent[lo:pos], sent[pos + 1:hi]])
+                if len(ctx) == 0:
+                    continue
+                row = np.zeros(2 * W, np.int32)
+                row[:len(ctx)] = ctx
+                m = np.zeros(2 * W, bool)
+                m[:len(ctx)] = True
+                centers.append(int(sent[pos]))
+                ctxs.append(row)
+                masks.append(m)
+                if len(centers) == batch_size:
+                    yield flush(batch_size)
+        if centers:
+            n_real = len(centers)
+            # pad tail to the static batch shape with masked rows
+            while len(centers) < batch_size:
+                centers.append(0)
+                ctxs.append(np.zeros(2 * W, np.int32))
+                masks.append(np.zeros(2 * W, bool))
+            yield flush(n_real)
+
+
+def synthetic_corpus(n_sentences: int, vocab_size: int, length: int = 20,
+                     seed: int = 0, zipf: float = 1.2) -> List[List[int]]:
+    """Zipf-distributed token streams with local correlation (neighbors
+    share a topic), so embeddings have signal to learn."""
+    rng = np.random.default_rng(seed)
+    ranks = np.arange(1, vocab_size + 1, dtype=np.float64)
+    p = ranks ** (-zipf)
+    p /= p.sum()
+    out = []
+    for _ in range(n_sentences):
+        topic = rng.integers(0, 5)
+        base = rng.choice(vocab_size, size=length, p=p)
+        # topic words interleaved -> co-occurrence structure
+        base[::3] = (topic * 7 + base[::3] // 5) % vocab_size
+        out.append([int(x) + 1 for x in base])  # keys are 1-based ints
+    return out

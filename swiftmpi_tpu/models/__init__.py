@@ -5,5 +5,6 @@ sent2vec (sent2vec.cpp).
 """
 
 from swiftmpi_tpu.models.logistic import LogisticRegression
+from swiftmpi_tpu.models.word2vec import Word2Vec
 
-__all__ = ["LogisticRegression"]
+__all__ = ["LogisticRegression", "Word2Vec"]

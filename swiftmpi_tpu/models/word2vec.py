@@ -1,0 +1,289 @@
+"""word2vec (CBOW + negative sampling) on the TPU parameter server.
+
+Re-design of the reference word2vec apps — sync variant
+(`/root/reference/src/apps/word2vec/word2vec.h`, used by w2v_local.cpp) and
+async/global variant (`word2vec_global.h`, used by w2v.cpp) — as a single
+model with a fused SPMD training step.
+
+Reference hot loop (word2vec.h:550-615), per center word:
+    b = rand % window;  context = +-(window-b) neighbors
+    neu1 = sum of context input vectors v              (CBOW, raw sum)
+    for target in {center (label 1), K negatives (label 0)}:
+        skip negative if target == center
+        f = neu1 . h_target
+        g = (label - sigmoid_clipped(f)) * alpha       (ExpTable clip)
+        error += 10000 * g^2                           (word2vec.h:593)
+        h_grad[target] += g * neu1 ; neu1e += g * h_target
+    v_grad[context_j] += neu1e  for each context word
+
+Here the whole minibatch of that loop is one jitted step: padded
+``(B, 2W)`` context matrices, ``(B, K)`` negatives drawn on device from the
+alias-method unigram^0.75 sampler, gradients mean-normalized per key (the
+reference's ``grad /= count`` at push serialization, word2vec.h:120-132),
+pushed once through the transfer layer onto the row-sharded table with
+server-side AdaGrad (word2vec.h:177-185).
+
+Variant mapping (SURVEY.md §2.7): the reference's sync variant is this step
+verbatim; its async/global variant (per-thread unsynchronized pull/push,
+stale gradients, word2vec_global.h:577-651) maps to ``local_steps > 1`` —
+gradients are computed against a table snapshot refreshed only every
+``local_steps`` batches while pushes land immediately, reproducing
+bounded-staleness async SGD without abandoning SPMD.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from swiftmpi_tpu.cluster.cluster import Cluster
+from swiftmpi_tpu.data.text import (CBOWBatcher, Vocab, build_vocab,
+                                    load_corpus)
+from swiftmpi_tpu.io.checkpoint import dump_table_text, load_table_text
+from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
+from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
+from swiftmpi_tpu.parameter import w2v_access
+from swiftmpi_tpu.utils.config import ConfigParser, global_config
+from swiftmpi_tpu.utils.logger import get_logger
+from swiftmpi_tpu.utils.timers import Throughput
+
+log = get_logger(__name__)
+
+
+def w2v_formatter(row: Dict[str, np.ndarray]) -> str:
+    """Reference WParam operator<< layout: v-vector TAB h-vector
+    (word2vec.h:100-110)."""
+    v = " ".join(repr(float(x)) for x in row["v"])
+    h = " ".join(repr(float(x)) for x in row["h"])
+    return f"{v}\t{h}"
+
+
+def w2v_parser(text: str) -> Dict[str, np.ndarray]:
+    v_s, _, h_s = text.partition("\t")
+    return {"v": np.array([float(x) for x in v_s.split()], np.float32),
+            "h": np.array([float(x) for x in h_s.split()], np.float32)}
+
+
+class Word2Vec:
+    def __init__(self, config: Optional[ConfigParser] = None,
+                 cluster: Optional[Cluster] = None,
+                 capacity_per_shard: Optional[int] = None, seed: int = 0):
+        self.config = config if config is not None else global_config()
+        g = self.config.get_or
+        self.len_vec = g("word2vec", "len_vec", 100).to_int32()
+        self.window = g("word2vec", "window", 4).to_int32()
+        self.negative = g("word2vec", "negative", 20).to_int32()
+        self.sample = g("word2vec", "sample", -1.0).to_float()
+        self.alpha = g("word2vec", "learning_rate", 0.05).to_float()
+        self.min_sentence_length = g(
+            "word2vec", "min_sentence_length", 1).to_int32()
+        self.minibatch = g("worker", "minibatch", 5000).to_int32()
+        self.local_steps = g("word2vec", "local_steps", 1).to_int32()
+        server_lr = g("server", "initial_learning_rate", 0.7).to_float()
+
+        self.cluster = cluster or Cluster(self.config).initialize()
+        self.access = w2v_access(server_lr, self.len_vec)
+        self._capacity_per_shard = capacity_per_shard
+        self.table = None
+        self.transfer = self.cluster.transfer
+        self.vocab: Optional[Vocab] = None
+        self._step = None
+        self._key = jax.random.key(seed ^ 0x5EED)
+
+    # -- vocab / table bring-up (word2vec_global.h:385-444) ----------------
+    def build(self, sentences) -> "Word2Vec":
+        self.vocab = build_vocab(sentences)
+        V = len(self.vocab)
+        if V == 0:
+            raise ValueError(
+                "empty vocabulary — no sentence survived loading; check the "
+                "corpus and [word2vec] min_sentence_length")
+        if self.table is None:
+            cap = self._capacity_per_shard or max(
+                64, int(V * 1.3 / self.cluster.n_servers) + 1)
+            self.table = self.cluster.create_table(
+                "w2v", self.access, cap)
+        slots = self.table.key_index.lookup(self.vocab.keys)
+        self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
+        prob, alias = build_unigram_alias(self.vocab.counts)
+        self._alias_prob = jnp.asarray(prob)
+        self._alias_idx = jnp.asarray(alias)
+        log.info("vocab: %d words, %d tokens; table capacity %d",
+                 V, self.vocab.total_words, self.table.capacity)
+        return self
+
+    # -- the fused step ----------------------------------------------------
+    def _build_step(self):
+        """Sync step: grads against current state + immediate push."""
+        grads_fn = self._build_grads()
+        apply_fn = self._build_apply()
+
+        @jax.jit
+        def step(state, slot_of_vocab, alias_prob, alias_idx,
+                 centers, contexts, ctx_mask, key):
+            slots, grads, es, ec = grads_fn(
+                state, slot_of_vocab, alias_prob, alias_idx,
+                centers, contexts, ctx_mask, key)
+            return apply_fn(state, slots, grads), es, ec
+
+        return step
+
+    def _build_grads(self):
+        """Gradient phase of the step: pull rows, CBOW-NS math, per-key
+        mean normalization — no push.  Split out so the async
+        (``local_steps``) mode can compute grads against a *stale* state
+        snapshot while pushes land on the live state."""
+        access = self.access
+        transfer = self.transfer
+        capacity = self.table.capacity
+        K = self.negative
+        alpha = self.alpha
+        d = self.len_vec
+
+        def grads_fn(state, slot_of_vocab, alias_prob, alias_idx,
+                     centers, contexts, ctx_mask, key):
+            B, W2 = contexts.shape
+            negs = sample_alias(key, alias_prob, alias_idx, (B, K))
+            targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
+            t_slots = slot_of_vocab[targets_v]            # (B, K+1)
+            ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
+            row_valid = ctx_mask.any(axis=1)
+            # negative == center is skipped (word2vec.h:584-586)
+            t_valid = jnp.concatenate(
+                [jnp.ones((B, 1), bool), negs != centers[:, None]], axis=1)
+            t_valid = t_valid & row_valid[:, None]
+            t_slots = jnp.where(t_valid, t_slots, -1)
+
+            pulled = transfer.pull(
+                state,
+                jnp.concatenate([t_slots.reshape(-1),
+                                 ctx_slots.reshape(-1)]),
+                access)
+            h_t = pulled["h"][:B * (K + 1)].reshape(B, K + 1, d)
+            v_ctx = pulled["v"][B * (K + 1):].reshape(B, W2, d)
+
+            neu1 = jnp.sum(v_ctx * ctx_mask[..., None], axis=1)   # (B, d)
+            f = jnp.einsum("bd,bkd->bk", neu1, h_t)
+            labels = jnp.concatenate(
+                [jnp.ones((B, 1)), jnp.zeros((B, K))], axis=1)
+            g = (labels - sigmoid_clipped(f)) * alpha
+            g = jnp.where(t_valid, g, 0.0)                        # (B, K+1)
+
+            h_contrib = g[..., None] * neu1[:, None, :]           # (B,K+1,d)
+            neu1e = jnp.einsum("bk,bkd->bd", g, h_t)              # (B, d)
+            v_contrib = jnp.where(ctx_mask[..., None],
+                                  neu1e[:, None, :], 0.0)         # (B,2W,d)
+
+            # per-key mean normalization, separate h/v counts
+            # (WLocalGrad h_count/v_count, word2vec.h:62-84,120-132)
+            def mean_scale(slots_flat):
+                safe = jnp.where(slots_flat >= 0, slots_flat, capacity)
+                counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
+                    1.0, mode="drop")
+                return 1.0 / jnp.maximum(
+                    counts[jnp.clip(slots_flat, 0, capacity - 1)], 1.0)
+
+            tf = t_slots.reshape(-1)
+            cf = ctx_slots.reshape(-1)
+            h_flat = h_contrib.reshape(-1, d) * mean_scale(tf)[:, None]
+            v_flat = v_contrib.reshape(-1, d) * mean_scale(cf)[:, None]
+
+            all_slots = jnp.concatenate([tf, cf])
+            zeros_h = jnp.zeros_like(v_flat)
+            zeros_v = jnp.zeros_like(h_flat)
+            grads = {"h": jnp.concatenate([h_flat, zeros_h]),
+                     "v": jnp.concatenate([zeros_v, v_flat])}
+
+            err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
+            err_cnt = t_valid.sum()
+            return all_slots, grads, err_sum, err_cnt
+
+        return grads_fn
+
+    def _build_apply(self):
+        access = self.access
+        transfer = self.transfer
+
+        def apply_fn(state, slots, grads):
+            return transfer.push(state, slots, grads, access)
+
+        return apply_fn
+
+    # -- training (word2vec.h:475-547) -------------------------------------
+    def train(self, data, niters: int = 1,
+              batch_size: Optional[int] = None) -> List[float]:
+        """``data``: corpus path or list of key-list sentences.  Returns
+        per-iteration mean error (reference Error::norm per train_iter,
+        word2vec.h:491)."""
+        if isinstance(data, str):
+            data = load_corpus(data, min_sentence_length=max(
+                self.min_sentence_length, 1))
+        if self.vocab is None:
+            self.build(data)
+        sync = self.local_steps <= 1
+        if self._step is None:
+            if sync:
+                self._step = self._build_step()
+            else:
+                self._step = (jax.jit(self._build_grads()),
+                              jax.jit(self._build_apply()))
+        batch_size = batch_size or max(
+            256, self.minibatch // (2 * self.window))
+        batcher = CBOWBatcher(data, self.vocab, self.window, self.sample)
+        state = self.table.state
+        frozen = state   # stale snapshot for the async mode
+        losses = []
+        meter = Throughput()
+        step_i = 0
+        for it in range(niters):
+            err_sum, err_cnt = 0.0, 0
+            for batch in batcher.epoch(batch_size):
+                self._key, sub = jax.random.split(self._key)
+                args = (self._slot_of_vocab, self._alias_prob,
+                        self._alias_idx, jnp.asarray(batch.centers),
+                        jnp.asarray(batch.contexts),
+                        jnp.asarray(batch.ctx_mask), sub)
+                if sync:
+                    state, es, ec = self._step(state, *args)
+                else:
+                    # async/global variant semantics (word2vec_global.h:
+                    # 577-651): grads computed against a stale snapshot,
+                    # pushes land immediately; snapshot refreshes every
+                    # local_steps batches => bounded staleness.
+                    grads_fn, apply_fn = self._step
+                    slots, grads, es, ec = grads_fn(frozen, *args)
+                    state = apply_fn(state, slots, grads)
+                    step_i += 1
+                    if step_i % self.local_steps == 0:
+                        frozen = state
+                err_sum += float(es)
+                err_cnt += int(ec)
+                meter.record(batch.n_words)
+            loss = err_sum / max(err_cnt, 1)
+            losses.append(loss)
+            log.info("iter %d: error %.5f  (%.0f words/s)",
+                     it, loss, meter.rate())
+        self.table.state = state
+        return losses
+
+    # -- embeddings out/in (word2vec.h:100-117; cluster.h:41-54) -----------
+    def save(self, path: str) -> int:
+        return dump_table_text(self.table, path, formatter=w2v_formatter)
+
+    def load(self, path: str) -> int:
+        if self.table is None:
+            if self._capacity_per_shard is None:
+                raise RuntimeError("set capacity_per_shard before load()")
+            self.table = self.cluster.create_table(
+                "w2v", self.access, self._capacity_per_shard)
+        return load_table_text(self.table, path, parser=w2v_parser)
+
+    def embedding(self, key: int) -> Optional[np.ndarray]:
+        """Input-side (v) vector for an external key, or None."""
+        if key not in self.table.key_index:
+            return None
+        slot = self.table.key_index.slot(key)
+        return np.asarray(self.table.state["v"][slot])  # one-row transfer

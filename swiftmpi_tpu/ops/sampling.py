@@ -1,0 +1,78 @@
+"""Negative sampling and subsampling for word2vec.
+
+The reference materializes a 10^8-entry unigram^0.75 table and draws
+negatives by LCG index (`/root/reference/src/apps/word2vec/word2vec.h:
+398-425,577-589`; regenerated **per minibatch** in the sync variant, once
+globally in the async variant).  On TPU that table would be 400MB of HBM
+serving random scalar reads; the alias method gives draws from the *exact*
+same categorical distribution in O(1) with two vocab-sized arrays — so the
+device samples (B, K) negatives per step with ``jax.random`` and no host
+round-trip.  (Distribution equality, not stream equality: the reference's
+table is itself only a 1e8-bucket discretization — SURVEY.md §7 hard
+part (c).)
+
+Subsampling follows the reference rule (word2vec.h:621-630): keep word w
+with probability ``min(1, sqrt(sample/freq_w))`` where ``freq_w`` is the
+in-corpus frequency.  Like the reference (word2vec.h:561-562), the gate
+applies only to *center* positions — subsampled words still appear in
+their neighbors' context windows; the batcher enforces this.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def build_unigram_alias(counts: np.ndarray, power: float = 0.75
+                        ) -> Tuple[np.ndarray, np.ndarray]:
+    """Walker alias tables for the unigram^power distribution.
+
+    Returns (prob, alias): float32 (V,) acceptance thresholds and int32 (V,)
+    alias targets.  Sampling: draw bucket j ~ U[0,V), accept j if
+    u < prob[j] else take alias[j].
+    """
+    counts = np.asarray(counts, np.float64)
+    if counts.ndim != 1 or len(counts) == 0:
+        raise ValueError("counts must be a non-empty 1-D array")
+    w = counts ** power
+    p = w / w.sum() * len(w)  # mean 1
+    prob = np.ones(len(w), np.float64)
+    alias = np.arange(len(w), dtype=np.int32)
+    small = [i for i, x in enumerate(p) if x < 1.0]
+    large = [i for i, x in enumerate(p) if x >= 1.0]
+    while small and large:
+        s, l = small.pop(), large.pop()
+        prob[s] = p[s]
+        alias[s] = l
+        p[l] = p[l] - (1.0 - p[s])
+        (small if p[l] < 1.0 else large).append(l)
+    for i in small + large:
+        prob[i] = 1.0
+    return prob.astype(np.float32), alias
+
+
+def sample_alias(key: jax.Array, prob: jax.Array, alias: jax.Array,
+                 shape: Tuple[int, ...]) -> jax.Array:
+    """Device-side categorical draws from alias tables."""
+    k1, k2 = jax.random.split(key)
+    V = prob.shape[0]
+    j = jax.random.randint(k1, shape, 0, V)
+    u = jax.random.uniform(k2, shape)
+    return jnp.where(u < prob[j], j, alias[j]).astype(jnp.int32)
+
+
+def subsample_keep_prob(counts: np.ndarray, sample: float) -> np.ndarray:
+    """P(keep) per word (reference to_sample, word2vec.h:621-630):
+    ran = 1 - sqrt(sample/freq); keep iff uniform > ran
+    => P(keep) = min(1, sqrt(sample/freq)).  sample < 0 disables."""
+    counts = np.asarray(counts, np.float64)
+    if sample < 0:
+        return np.ones(len(counts), np.float32)
+    freq = counts / max(counts.sum(), 1.0)
+    with np.errstate(divide="ignore"):
+        keep = np.sqrt(sample / np.where(freq > 0, freq, 1.0))
+    return np.minimum(keep, 1.0).astype(np.float32)

@@ -1,0 +1,29 @@
+"""Clipped sigmoid matching the reference ExpTable semantics.
+
+The reference precomputes sigmoid on [-MAX_EXP, MAX_EXP] (1000 buckets,
+MAX_EXP=6) and hard-clips outside (`/root/reference/src/apps/word2vec/
+word2vec.h:237-267,591-598`):
+
+    f >  MAX_EXP  ->  g = (label - 1) * alpha
+    f < -MAX_EXP  ->  g = (label - 0) * alpha
+    else          ->  g = (label - sigmoid(f)) * alpha
+
+``sigmoid_clipped`` reproduces exactly that branch structure with the exact
+sigmoid in place of the table lookup (the table is a discretization whose
+max error is ~1e-3; XLA computes the exact value at the same cost — the
+clip, which *does* change gradients materially, is preserved).
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+MAX_EXP = 6.0
+
+
+def sigmoid_clipped(f: jnp.ndarray) -> jnp.ndarray:
+    """sigma(f) with the reference's saturation to exactly 0/1 beyond
+    +/-MAX_EXP."""
+    s = 1.0 / (1.0 + jnp.exp(-jnp.clip(f, -MAX_EXP, MAX_EXP)))
+    s = jnp.where(f > MAX_EXP, 1.0, s)
+    return jnp.where(f < -MAX_EXP, 0.0, s)

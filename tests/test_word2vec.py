@@ -1,0 +1,219 @@
+"""word2vec tests: sampling ops, batcher, fused step training, checkpoints."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.data.text import (CBOWBatcher, build_vocab, load_corpus,
+                                    synthetic_corpus, tokenize)
+from swiftmpi_tpu.models.word2vec import Word2Vec
+from swiftmpi_tpu.ops import (MAX_EXP, build_unigram_alias, sample_alias,
+                              sigmoid_clipped, subsample_keep_prob)
+from swiftmpi_tpu.utils import ConfigParser
+
+
+# -- ops ------------------------------------------------------------------
+
+def test_alias_sampler_matches_unigram_075():
+    counts = np.array([100, 10, 1, 50], np.float64)
+    prob, alias = build_unigram_alias(counts)
+    draws = sample_alias(jax.random.key(0), jnp.asarray(prob),
+                         jnp.asarray(alias), (200_000,))
+    freq = np.bincount(np.asarray(draws), minlength=4) / 200_000
+    expect = counts ** 0.75
+    expect /= expect.sum()
+    np.testing.assert_allclose(freq, expect, atol=0.01)
+
+
+def test_subsample_keep_prob_rule():
+    counts = np.array([1000, 10], np.float64)
+    keep = subsample_keep_prob(counts, sample=0.01)
+    # freq = [1000/1010, 10/1010]; keep = min(1, sqrt(sample/freq))
+    np.testing.assert_allclose(
+        keep, np.minimum(1, np.sqrt(0.01 / (counts / counts.sum()))),
+        rtol=1e-6)
+    np.testing.assert_array_equal(subsample_keep_prob(counts, -1), 1)
+
+
+def test_sigmoid_clipped_saturation():
+    f = jnp.array([-10.0, -MAX_EXP - 1e-3, 0.0, MAX_EXP + 1e-3, 10.0])
+    s = np.asarray(sigmoid_clipped(f))
+    assert s[0] == 0.0 and s[1] == 0.0
+    assert s[2] == pytest.approx(0.5)
+    assert s[3] == 1.0 and s[4] == 1.0
+
+
+# -- data -----------------------------------------------------------------
+
+def test_tokenize_modes():
+    assert tokenize("1 2 30", "int") == [1, 2, 30]
+    h = tokenize("hello world", "bkdr")
+    assert len(h) == 2 and all(isinstance(x, int) for x in h)
+    assert tokenize("hello", "int") == tokenize("hello", "bkdr")  # fallback
+
+
+def test_build_vocab_orders_by_frequency():
+    v = build_vocab([[1, 1, 2], [1, 3, 3]])
+    assert v.keys[0] == 1 and v.counts[0] == 3
+    assert v.total_words == 6
+    assert v.index[1] == 0
+
+
+def test_load_corpus_chunks_single_line(tmp_path):
+    p = tmp_path / "text8ish.txt"
+    p.write_text(" ".join(str(i % 7) for i in range(100)))
+    sents = load_corpus(str(p), max_sentence_length=30)
+    assert [len(s) for s in sents] == [30, 30, 30, 10]
+
+
+def test_cbow_batcher_shapes_and_window():
+    corpus = synthetic_corpus(20, vocab_size=50, length=15, seed=1)
+    vocab = build_vocab(corpus)
+    b = CBOWBatcher(corpus, vocab, window=3, seed=7)
+    batches = list(b.epoch(32))
+    assert all(bt.centers.shape == (32,) for bt in batches)
+    assert all(bt.contexts.shape == (32, 6) for bt in batches)
+    for bt in batches:
+        # masked rows only in the padded tail
+        assert bt.ctx_mask[:bt.n_words].any(axis=1).all()
+        # context never contains more than 2W valid entries (trivially) and
+        # padding is zero
+        assert (bt.contexts[~bt.ctx_mask] == 0).all()
+
+
+def test_cbow_batcher_epoch_is_deterministic_given_seed():
+    corpus = synthetic_corpus(5, vocab_size=20, length=10)
+    vocab = build_vocab(corpus)
+    a = list(CBOWBatcher(corpus, vocab, 2, seed=3).epoch(16))
+    b = list(CBOWBatcher(corpus, vocab, 2, seed=3).epoch(16))
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x.centers, y.centers)
+        np.testing.assert_array_equal(x.contexts, y.contexts)
+
+
+# -- model ----------------------------------------------------------------
+
+def make_model(**overrides):
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla"},
+        "word2vec": {"len_vec": 16, "window": 2, "negative": 5,
+                     "sample": -1, "learning_rate": 0.05,
+                     "min_sentence_length": 2},
+        "server": {"initial_learning_rate": 0.3},
+        "worker": {"minibatch": 512},
+    })
+    for sec, kv in overrides.items():
+        for k, v in kv.items():
+            cfg.set(sec, k, v)
+    return Word2Vec(config=cfg)
+
+
+def test_w2v_trains_and_loss_decreases(devices8):
+    corpus = synthetic_corpus(60, vocab_size=100, length=18, seed=2)
+    model = make_model()
+    losses = model.train(corpus, niters=5, batch_size=128)
+    assert len(losses) == 5
+    assert losses[-1] < losses[0], losses
+
+
+def test_w2v_checkpoint_roundtrip(tmp_path, devices8):
+    corpus = synthetic_corpus(20, vocab_size=40, length=12, seed=4)
+    model = make_model()
+    model.train(corpus, niters=1, batch_size=64)
+    path = str(tmp_path / "emb.txt")
+    n = model.save(path)
+    assert n == len(model.table.key_index)
+    # reference layout: key \t v-vector \t h-vector
+    parts = open(path).readline().rstrip("\n").split("\t")
+    assert len(parts) == 3
+    assert len(parts[1].split()) == 16 and len(parts[2].split()) == 16
+
+    model2 = make_model()
+    model2._capacity_per_shard = model.table.key_index.capacity_per_shard
+    model2.load(path)
+    k = int(model.vocab.keys[0])
+    np.testing.assert_allclose(model.embedding(k), model2.embedding(k),
+                               rtol=1e-6)
+
+
+def test_w2v_embeddings_capture_cooccurrence(devices8):
+    # Words that co-occur should end up closer than random pairs.
+    rng = np.random.default_rng(0)
+    # build corpus of sentences drawn from 2 disjoint topic vocabularies
+    topic_a = list(range(1, 21))
+    topic_b = list(range(21, 41))
+    corpus = []
+    for i in range(120):
+        words = rng.choice(topic_a if i % 2 == 0 else topic_b, size=12)
+        corpus.append([int(w) for w in words])
+    model = make_model()
+    model.train(corpus, niters=8, batch_size=128)
+
+    def vec(k):
+        v = model.embedding(k)
+        return v / (np.linalg.norm(v) + 1e-9)
+
+    within = np.mean([vec(topic_a[i]) @ vec(topic_a[j])
+                      for i in range(5) for j in range(5) if i != j])
+    across = np.mean([vec(topic_a[i]) @ vec(topic_b[j])
+                      for i in range(5) for j in range(5)])
+    assert within > across, (within, across)
+
+
+def test_w2v_async_local_steps_trains(devices8):
+    corpus = synthetic_corpus(40, vocab_size=60, length=14, seed=8)
+    model = make_model(word2vec={"local_steps": 3})
+    losses = model.train(corpus, niters=4, batch_size=64)
+    assert losses[-1] < losses[0], losses
+
+
+def test_subsampling_keeps_dropped_words_in_contexts():
+    # Reference word2vec.h:561: to_sample gates only the center position;
+    # a heavily-subsampled frequent word must still appear as context.
+    rng = np.random.default_rng(1)
+    corpus = []
+    for _ in range(10):
+        sent = rng.integers(2, 12, size=10).tolist()
+        interleaved = []
+        for w in sent:  # word 1 between every pair -> ~50% of tokens
+            interleaved += [1, int(w)]
+        corpus.append(interleaved)
+    vocab = build_vocab(corpus)
+    # keep(word1) ~ 0.14, keep(others) = 1 at sample=0.01
+    b = CBOWBatcher(corpus, vocab, window=2, sample=0.01, seed=0)
+    batches = list(b.epoch(64))
+    freq_idx = vocab.index[1]
+    centers = np.concatenate([bt.centers[:bt.n_words] for bt in batches])
+    ctx = np.concatenate(
+        [bt.contexts[bt.ctx_mask].ravel() for bt in batches])
+    # word 1's context share stays at its raw corpus share (~0.5) while
+    # its center share is pushed well below it by the subsample gate —
+    # under the wrong (sentence-filtering) semantics both would drop.
+    center_frac = (centers == freq_idx).mean()
+    ctx_frac = (ctx == freq_idx).mean()
+    assert ctx_frac > 0.4, ctx_frac
+    assert center_frac < ctx_frac - 0.1, (center_frac, ctx_frac)
+
+
+def test_w2v_cli_rejects_bad_variant(tmp_path):
+    from swiftmpi_tpu.apps.w2v_main import main
+    data = tmp_path / "d.txt"
+    data.write_text("1 2 3\n")
+    assert main(["w2v", "-data", str(data), "-variant", "asnyc"]) == 1
+
+
+def test_w2v_cli(tmp_path, devices8):
+    from swiftmpi_tpu.apps.w2v_main import main
+    corpus = synthetic_corpus(20, vocab_size=30, length=10, seed=6)
+    data = tmp_path / "corpus.txt"
+    with open(data, "w") as f:
+        for sent in corpus:
+            f.write(" ".join(map(str, sent)) + "\n")
+    conf = tmp_path / "w2v.conf"
+    conf.write_text("[word2vec]\nlen_vec: 8\nwindow: 2\nnegative: 3\n"
+                    "min_sentence_length: 2\n[worker]\nminibatch: 128\n")
+    out = str(tmp_path / "emb.txt")
+    assert main(["w2v", "-config", str(conf), "-data", str(data),
+                 "-niters", "1", "-output", out]) == 0
+    assert len(open(out).readlines()) == 30
