@@ -6,5 +6,7 @@ sent2vec (sent2vec.cpp).
 
 from swiftmpi_tpu.models.logistic import LogisticRegression
 from swiftmpi_tpu.models.word2vec import Word2Vec
+from swiftmpi_tpu.models.sent2vec import Sent2Vec, build_word_model_from_dump
 
-__all__ = ["LogisticRegression", "Word2Vec"]
+__all__ = ["LogisticRegression", "Word2Vec", "Sent2Vec",
+           "build_word_model_from_dump"]

@@ -214,10 +214,17 @@ class Word2Vec:
 
     # -- training (word2vec.h:475-547) -------------------------------------
     def train(self, data, niters: int = 1,
-              batch_size: Optional[int] = None) -> List[float]:
+              batch_size: Optional[int] = None,
+              checkpoint_path: Optional[str] = None,
+              checkpoint_every: int = 1) -> List[float]:
         """``data``: corpus path or list of key-list sentences.  Returns
         per-iteration mean error (reference Error::norm per train_iter,
-        word2vec.h:491)."""
+        word2vec.h:491).
+
+        ``checkpoint_path``: mid-training full-fidelity checkpoints
+        (optimizer state included) every ``checkpoint_every`` iterations —
+        a capability the reference lacks (SURVEY.md §5: checkpoint-out only
+        at exit, optimizer state dropped).  Resume with ``resume()``."""
         if isinstance(data, str):
             data = load_corpus(data, min_sentence_length=max(
                 self.min_sentence_length, 1))
@@ -266,8 +273,29 @@ class Word2Vec:
             losses.append(loss)
             log.info("iter %d: error %.5f  (%.0f words/s)",
                      it, loss, meter.rate())
+            if checkpoint_path and (it + 1) % checkpoint_every == 0:
+                self.table.state = state
+                from swiftmpi_tpu.io.checkpoint import save_checkpoint
+                save_checkpoint(self.table, checkpoint_path,
+                                extra={"iter": np.int64(it + 1)})
+                log.info("checkpoint @ iter %d -> %s", it + 1,
+                         checkpoint_path)
         self.table.state = state
         return losses
+
+    def resume(self, checkpoint_path: str) -> int:
+        """Restore a mid-training checkpoint; returns the iteration it was
+        taken at.  The cached vocab->slot map is rebuilt against the
+        restored key index so continued training touches the right rows
+        even if the checkpoint's slot assignment differs from build()'s."""
+        from swiftmpi_tpu.io.checkpoint import load_checkpoint
+        if self.table is None:
+            raise RuntimeError("build() or load() the model before resume()")
+        extra = load_checkpoint(self.table, checkpoint_path)
+        if self.vocab is not None:
+            slots = self.table.key_index.lookup(self.vocab.keys)
+            self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
+        return int(extra.get("iter", 0))
 
     # -- embeddings out/in (word2vec.h:100-117; cluster.h:41-54) -----------
     def save(self, path: str) -> int:

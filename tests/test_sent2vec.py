@@ -1,0 +1,109 @@
+"""sent2vec tests: frozen-word inference, output format, CLI."""
+
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.data.text import synthetic_corpus
+from swiftmpi_tpu.models import Sent2Vec, Word2Vec
+from swiftmpi_tpu.utils import ConfigParser, bkdr_hash
+
+
+def trained_word_model(devices8=None):
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla"},
+        "word2vec": {"len_vec": 12, "window": 2, "negative": 4,
+                     "sample": -1, "learning_rate": 0.1,
+                     "min_sentence_length": 2},
+        "server": {"initial_learning_rate": 0.3},
+        "worker": {"minibatch": 256},
+    })
+    corpus = synthetic_corpus(30, vocab_size=50, length=12, seed=3)
+    model = Word2Vec(config=cfg)
+    model.train(corpus, niters=2, batch_size=64)
+    return model, corpus
+
+
+def test_sent2vec_infers_vectors(devices8):
+    wm, corpus = trained_word_model()
+    s2v = Sent2Vec(wm)
+    lines = [" ".join(map(str, s)) for s in corpus[:10]]
+    results = s2v.infer_sentences(lines, niters=5)
+    assert len(results) == 10
+    sid, vec = results[0]
+    assert sid == bkdr_hash(lines[0])
+    assert vec.shape == (12,)
+    assert np.isfinite(vec).all()
+    # iterated further than init scale (|init| <= 0.5/12)
+    assert np.abs(vec).max() > 0.5 / 12
+
+
+def test_sent2vec_word_table_is_frozen(devices8):
+    wm, corpus = trained_word_model()
+    before = {f: np.asarray(v).copy() for f, v in wm.table.state.items()}
+    s2v = Sent2Vec(wm)
+    s2v.infer_sentences([" ".join(map(str, corpus[0]))], niters=3)
+    for f, v in wm.table.state.items():
+        np.testing.assert_array_equal(before[f], np.asarray(v))
+
+
+def test_sent2vec_deterministic_given_seed(devices8):
+    wm, corpus = trained_word_model()
+    lines = [" ".join(map(str, s)) for s in corpus[:4]]
+    a = Sent2Vec(wm, seed=1).infer_sentences(lines, niters=3)
+    b = Sent2Vec(wm, seed=1).infer_sentences(lines, niters=3)
+    for (sa, va), (sb, vb) in zip(a, b):
+        assert sa == sb
+        np.testing.assert_array_equal(va, vb)
+
+
+def test_w2v_midtrain_checkpoint_and_resume(tmp_path, devices8):
+    from swiftmpi_tpu.data.text import synthetic_corpus
+    corpus = synthetic_corpus(20, vocab_size=40, length=10, seed=5)
+    wm, _ = trained_word_model()
+    ckpt = str(tmp_path / "mid")
+    cfgd = wm.config
+    m = Word2Vec(config=cfgd)
+    m.train(corpus, niters=3, batch_size=64, checkpoint_path=ckpt,
+            checkpoint_every=1)
+    state_after = {f: np.asarray(v).copy() for f, v in m.table.state.items()}
+
+    m2 = Word2Vec(config=cfgd)
+    m2.build(corpus)
+    it = m2.resume(ckpt)
+    assert it == 3
+    for f in m.table.state:  # optimizer state (h2sum/v2sum) included
+        np.testing.assert_array_equal(state_after[f],
+                                      np.asarray(m2.table.state[f]))
+
+
+def test_profiler_step_timer():
+    from swiftmpi_tpu.utils.profiler import StepTimer, annotate
+    import jax.numpy as jnp
+    t = StepTimer()
+    with annotate("test-span"):
+        t.start()
+        x = jnp.ones((8, 8)) @ jnp.ones((8, 8))
+        dt = t.stop(x)
+    assert dt > 0 and t.mean > 0 and t.p50 > 0
+
+
+def test_sent2vec_cli(tmp_path, devices8):
+    from swiftmpi_tpu.apps.sent2vec_main import main
+    wm, corpus = trained_word_model()
+    dump = str(tmp_path / "words.txt")
+    wm.save(dump)
+    data = tmp_path / "sents.txt"
+    with open(data, "w") as f:
+        for s in corpus[:6]:
+            f.write(" ".join(map(str, s)) + "\n")
+    conf = tmp_path / "s2v.conf"
+    conf.write_text("[word2vec]\nlen_vec: 12\nwindow: 2\nnegative: 4\n"
+                    "[worker]\nminibatch: 64\n")
+    out = str(tmp_path / "vecs.txt")
+    assert main(["s2v", "-config", str(conf), "-data", str(data),
+                 "-niters", "3", "-wordvec", dump, "-output", out]) == 0
+    lines = open(out).read().strip().split("\n")
+    assert len(lines) == 6
+    sid, _, vec = lines[0].partition("\t")
+    int(sid)
+    assert len(vec.split()) == 12
