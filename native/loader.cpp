@@ -1,0 +1,286 @@
+// Native data loader for swiftmpi_tpu: tokenization, vocab counting, and
+// CBOW batch assembly.
+//
+// TPU-native equivalent of the reference's C++ host-side input machinery —
+// LineFileReader + split + multithreaded gather_keys scans
+// (/root/reference/src/utils/string.h:91-120, src/utils/file.h:14-33,
+// src/apps/word2vec/word2vec.h:323-377) — feeding the device input pipeline
+// instead of a ZMQ parameter server.  Exposed as a C ABI for ctypes; the
+// Python fallback (swiftmpi_tpu/data/text.py) implements identical
+// semantics:
+//   * key modes: 0 = atoi with BKDR fallback (sync variant, hash_fn2),
+//                1 = BKDR-13131 over uint32 (async variant, hash_fn)
+//   * vocab ordered by (count desc, key asc) — matches data/text.py
+//   * CBOW windows with per-position random shrink b in [0, W)
+//     (word2vec.h:555) and center-only subsampling (word2vec.h:561)
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC loader.cpp -o libsmtpu_loader.so
+
+#include <algorithm>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <random>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+inline uint64_t bkdr32(const char* s, size_t n) {
+  uint32_t h = 0;
+  for (size_t i = 0; i < n; i++) h = h * 13131u + (unsigned char)s[i];
+  return (uint64_t)h;
+}
+
+inline uint64_t token_key(const char* s, size_t n, int mode) {
+  if (mode == 0) {
+    // atoi semantics with BKDR fallback for non-numeric tokens
+    char* end = nullptr;
+    std::string tmp(s, n);
+    long long v = strtoll(tmp.c_str(), &end, 10);
+    if (end && *end == '\0' && end != tmp.c_str()) return (uint64_t)v;
+    return bkdr32(s, n);
+  }
+  return bkdr32(s, n);
+}
+
+struct Corpus {
+  std::vector<int32_t> tokens;    // vocab indices, flattened
+  std::vector<int64_t> offsets;   // sentence i = tokens[offsets[i]..offsets[i+1])
+};
+
+}  // namespace
+
+extern "C" {
+
+struct SmtpuVocab {
+  std::vector<uint64_t> keys;
+  std::vector<int64_t> counts;
+  std::unordered_map<uint64_t, int32_t> index;
+};
+
+struct SmtpuCorpus {
+  Corpus c;
+};
+
+// ---- vocab ----------------------------------------------------------------
+
+// Counts apply the same sentence filtering as smtpu_corpus_map (length-
+// filtered chunks), so vocab and corpus — and the python pipeline, which
+// filters in load_corpus before build_vocab — stay consistent.
+SmtpuVocab* smtpu_vocab_build(const char* path, int mode, int64_t min_count,
+                              int64_t min_sentence_length,
+                              int64_t max_sentence_length) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  std::unordered_map<uint64_t, int64_t> counts;
+  std::vector<uint64_t> sent;
+  char* line = nullptr;
+  size_t cap = 0;
+  ssize_t len;
+  auto count_chunks = [&]() {
+    for (size_t i = 0; i < sent.size(); i += (size_t)max_sentence_length) {
+      size_t n = std::min((size_t)max_sentence_length, sent.size() - i);
+      if ((int64_t)n < min_sentence_length) continue;
+      for (size_t j = i; j < i + n; j++) counts[sent[j]]++;
+    }
+    sent.clear();
+  };
+  while ((len = getline(&line, &cap, f)) != -1) {
+    char* p = line;
+    char* end = line + len;
+    sent.clear();
+    while (p < end) {
+      while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+        p++;
+      char* start = p;
+      while (p < end && *p != ' ' && *p != '\t' && *p != '\n' && *p != '\r')
+        p++;
+      if (p > start) sent.push_back(token_key(start, p - start, mode));
+    }
+    count_chunks();
+  }
+  free(line);
+  fclose(f);
+
+  auto* v = new SmtpuVocab();
+  std::vector<std::pair<uint64_t, int64_t>> items;
+  items.reserve(counts.size());
+  for (auto& kv : counts)
+    if (kv.second >= min_count) items.push_back(kv);
+  std::sort(items.begin(), items.end(), [](const auto& a, const auto& b) {
+    return a.second != b.second ? a.second > b.second : a.first < b.first;
+  });
+  v->keys.reserve(items.size());
+  v->counts.reserve(items.size());
+  for (size_t i = 0; i < items.size(); i++) {
+    v->keys.push_back(items[i].first);
+    v->counts.push_back(items[i].second);
+    v->index.emplace(items[i].first, (int32_t)i);
+  }
+  return v;
+}
+
+int64_t smtpu_vocab_size(const SmtpuVocab* v) { return (int64_t)v->keys.size(); }
+
+void smtpu_vocab_copy(const SmtpuVocab* v, uint64_t* keys, int64_t* counts) {
+  memcpy(keys, v->keys.data(), v->keys.size() * sizeof(uint64_t));
+  memcpy(counts, v->counts.data(), v->counts.size() * sizeof(int64_t));
+}
+
+void smtpu_vocab_free(SmtpuVocab* v) { delete v; }
+
+// ---- corpus mapping -------------------------------------------------------
+
+SmtpuCorpus* smtpu_corpus_map(const char* path, int mode,
+                              const SmtpuVocab* v,
+                              int64_t min_sentence_length,
+                              int64_t max_sentence_length) {
+  FILE* f = fopen(path, "rb");
+  if (!f) return nullptr;
+  auto* out = new SmtpuCorpus();
+  out->c.offsets.push_back(0);
+  std::vector<int32_t> sent;
+  char* line = nullptr;
+  size_t cap = 0;
+  ssize_t len;
+  auto flush_chunks = [&](std::vector<int32_t>& s) {
+    for (size_t i = 0; i < s.size(); i += (size_t)max_sentence_length) {
+      size_t n = std::min((size_t)max_sentence_length, s.size() - i);
+      if ((int64_t)n < min_sentence_length) continue;
+      out->c.tokens.insert(out->c.tokens.end(), s.begin() + i,
+                           s.begin() + i + n);
+      out->c.offsets.push_back((int64_t)out->c.tokens.size());
+    }
+    s.clear();
+  };
+  while ((len = getline(&line, &cap, f)) != -1) {
+    char* p = line;
+    char* end = line + len;
+    sent.clear();
+    while (p < end) {
+      while (p < end && (*p == ' ' || *p == '\t' || *p == '\n' || *p == '\r'))
+        p++;
+      char* start = p;
+      while (p < end && *p != ' ' && *p != '\t' && *p != '\n' && *p != '\r')
+        p++;
+      if (p > start) {
+        auto it = v->index.find(token_key(start, p - start, mode));
+        if (it != v->index.end()) sent.push_back(it->second);
+      }
+    }
+    flush_chunks(sent);
+  }
+  free(line);
+  fclose(f);
+  return out;
+}
+
+int64_t smtpu_corpus_n_sentences(const SmtpuCorpus* c) {
+  return (int64_t)c->c.offsets.size() - 1;
+}
+int64_t smtpu_corpus_n_tokens(const SmtpuCorpus* c) {
+  return (int64_t)c->c.tokens.size();
+}
+void smtpu_corpus_copy(const SmtpuCorpus* c, int32_t* tokens,
+                       int64_t* offsets) {
+  memcpy(tokens, c->c.tokens.data(), c->c.tokens.size() * sizeof(int32_t));
+  memcpy(offsets, c->c.offsets.data(),
+         c->c.offsets.size() * sizeof(int64_t));
+}
+void smtpu_corpus_free(SmtpuCorpus* c) { delete c; }
+
+// ---- CBOW batcher ---------------------------------------------------------
+
+struct SmtpuBatcher {
+  const int32_t* tokens;   // borrowed (numpy-owned) buffers
+  const int64_t* offsets;
+  int64_t n_sents;
+  int window;
+  const float* keep_prob;  // per vocab index; nullptr = no subsampling
+  std::mt19937_64 rng;
+  std::vector<int64_t> order;   // sentence permutation for this epoch
+  int64_t sent_i;               // position in `order`
+  int64_t pos_i;                // position within current sentence
+};
+
+SmtpuBatcher* smtpu_batcher_new(const int32_t* tokens, const int64_t* offsets,
+                                int64_t n_sents, int window,
+                                const float* keep_prob, uint64_t seed) {
+  auto* b = new SmtpuBatcher();
+  b->tokens = tokens;
+  b->offsets = offsets;
+  b->n_sents = n_sents;
+  b->window = window;
+  b->keep_prob = keep_prob;
+  b->rng.seed(seed);
+  b->order.resize(n_sents);
+  for (int64_t i = 0; i < n_sents; i++) b->order[i] = i;
+  std::shuffle(b->order.begin(), b->order.end(), b->rng);
+  b->sent_i = 0;
+  b->pos_i = 0;
+  return b;
+}
+
+void smtpu_batcher_reset(SmtpuBatcher* b, uint64_t seed) {
+  b->rng.seed(seed);
+  std::shuffle(b->order.begin(), b->order.end(), b->rng);
+  b->sent_i = 0;
+  b->pos_i = 0;
+}
+
+// Fill up to batch_size examples; contexts/mask are (batch_size, 2*window).
+// Returns the number of examples produced; 0 means the epoch is exhausted.
+int64_t smtpu_batcher_next(SmtpuBatcher* b, int64_t batch_size,
+                           int32_t* centers, int32_t* contexts,
+                           uint8_t* mask) {
+  const int W = b->window;
+  const int W2 = 2 * W;
+  std::uniform_real_distribution<float> unif(0.0f, 1.0f);
+  int64_t filled = 0;
+  memset(contexts, 0, (size_t)batch_size * W2 * sizeof(int32_t));
+  memset(mask, 0, (size_t)batch_size * W2);
+  while (filled < batch_size && b->sent_i < b->n_sents) {
+    int64_t s = b->order[b->sent_i];
+    const int32_t* sent = b->tokens + b->offsets[s];
+    int64_t L = b->offsets[s + 1] - b->offsets[s];
+    for (; b->pos_i < L && filled < batch_size; b->pos_i++) {
+      int64_t pos = b->pos_i;
+      // center-only subsample gate (word2vec.h:561)
+      if (b->keep_prob &&
+          unif(b->rng) >= b->keep_prob[sent[pos]])
+        continue;
+      int bshrink = (int)(b->rng() % (uint64_t)W);   // word2vec.h:555
+      int half = W - bshrink;
+      int64_t lo = pos - half < 0 ? 0 : pos - half;
+      int64_t hi = pos + half + 1 > L ? L : pos + half + 1;
+      int n_ctx = 0;
+      int32_t* ctx_row = contexts + filled * W2;
+      uint8_t* m_row = mask + filled * W2;
+      for (int64_t c = lo; c < hi; c++) {
+        if (c == pos) continue;
+        ctx_row[n_ctx] = sent[c];
+        m_row[n_ctx] = 1;
+        n_ctx++;
+      }
+      if (n_ctx == 0) {
+        memset(ctx_row, 0, W2 * sizeof(int32_t));
+        memset(m_row, 0, W2);
+        continue;
+      }
+      centers[filled] = sent[pos];
+      filled++;
+    }
+    if (b->pos_i >= L) {
+      b->sent_i++;
+      b->pos_i = 0;
+    }
+  }
+  return filled;
+}
+
+void smtpu_batcher_free(SmtpuBatcher* b) { delete b; }
+
+}  // extern "C"

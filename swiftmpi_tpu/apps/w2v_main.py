@@ -43,9 +43,22 @@ def main(argv=None) -> int:
     mode = "bkdr" if variant == "async" else "int"
 
     model = Word2Vec()
-    corpus = load_corpus(cmd.getValue("data"), mode=mode,
-                         min_sentence_length=model.min_sentence_length)
-    losses = model.train(corpus, niters=int(cmd.getValue("niters", "1")))
+    niters = int(cmd.getValue("niters", "1"))
+    from swiftmpi_tpu.data import native
+    if native.available():
+        # C++ fast path end to end: vocab, corpus mapping, and batch
+        # assembly never touch the python tokenizer.
+        vocab_c, tokens, offsets = native.load_corpus_native(
+            cmd.getValue("data"), mode=mode,
+            min_sentence_length=max(model.min_sentence_length, 1))
+        batcher = native.NativeCBOWBatcher(
+            tokens, offsets, vocab_c, model.window, model.sample)
+        log.info("using native C++ loader")
+        losses = model.train(niters=niters, batcher=batcher)
+    else:
+        corpus = load_corpus(cmd.getValue("data"), mode=mode,
+                             min_sentence_length=model.min_sentence_length)
+        losses = model.train(corpus, niters=niters)
     log.info("final error: %.5f", losses[-1])
     if cmd.hasParameter("output"):
         n = model.save(cmd.getValue("output"))

@@ -1,0 +1,185 @@
+"""ctypes binding to the native C++ data loader (native/loader.cpp).
+
+Drop-in fast path for the host input pipeline: vocab build, corpus
+mapping, and CBOW batch assembly run in C++ (the reference's own host-side
+machinery is C++ — LineFileReader/split/gather_keys).  Falls back to the
+pure-Python pipeline (data/text.py) when the shared library cannot be
+built; call ``available()`` to check.
+
+The .so is built on demand with g++ from the repo's ``native/`` directory
+and cached next to the source.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from swiftmpi_tpu.data.text import CBOWBatch, Vocab
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))), "native")
+_SO_PATH = os.path.join(_NATIVE_DIR, "libsmtpu_loader.so")
+
+_lib = None
+_lib_lock = threading.Lock()
+_build_failed = False
+
+
+def _load_lib():
+    global _lib, _build_failed
+    with _lib_lock:
+        if _lib is not None or _build_failed:
+            return _lib
+        if not os.path.exists(_SO_PATH):
+            src = os.path.join(_NATIVE_DIR, "loader.cpp")
+            if not os.path.exists(src):
+                _build_failed = True
+                return None
+            try:
+                subprocess.run(
+                    ["g++", "-O3", "-std=c++17", "-Wall", "-shared",
+                     "-fPIC", src, "-o", _SO_PATH],
+                    check=True, capture_output=True, timeout=120)
+            except (subprocess.SubprocessError, FileNotFoundError) as e:
+                log.warning("native loader build failed (%s); "
+                            "using python pipeline", e)
+                _build_failed = True
+                return None
+        lib = ctypes.CDLL(_SO_PATH)
+        c = ctypes
+        lib.smtpu_vocab_build.restype = c.c_void_p
+        lib.smtpu_vocab_build.argtypes = [c.c_char_p, c.c_int, c.c_int64,
+                                          c.c_int64, c.c_int64]
+        lib.smtpu_vocab_size.restype = c.c_int64
+        lib.smtpu_vocab_size.argtypes = [c.c_void_p]
+        lib.smtpu_vocab_copy.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+        lib.smtpu_vocab_free.argtypes = [c.c_void_p]
+        lib.smtpu_corpus_map.restype = c.c_void_p
+        lib.smtpu_corpus_map.argtypes = [c.c_char_p, c.c_int, c.c_void_p,
+                                         c.c_int64, c.c_int64]
+        lib.smtpu_corpus_n_sentences.restype = c.c_int64
+        lib.smtpu_corpus_n_sentences.argtypes = [c.c_void_p]
+        lib.smtpu_corpus_n_tokens.restype = c.c_int64
+        lib.smtpu_corpus_n_tokens.argtypes = [c.c_void_p]
+        lib.smtpu_corpus_copy.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+        lib.smtpu_corpus_free.argtypes = [c.c_void_p]
+        lib.smtpu_batcher_new.restype = c.c_void_p
+        lib.smtpu_batcher_new.argtypes = [c.c_void_p, c.c_void_p, c.c_int64,
+                                          c.c_int, c.c_void_p, c.c_uint64]
+        lib.smtpu_batcher_reset.argtypes = [c.c_void_p, c.c_uint64]
+        lib.smtpu_batcher_next.restype = c.c_int64
+        lib.smtpu_batcher_next.argtypes = [c.c_void_p, c.c_int64, c.c_void_p,
+                                           c.c_void_p, c.c_void_p]
+        lib.smtpu_batcher_free.argtypes = [c.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def available() -> bool:
+    return _load_lib() is not None
+
+
+_MODE = {"int": 0, "bkdr": 1}
+
+
+def load_corpus_native(path: str, mode: str = "int", min_count: int = 1,
+                       min_sentence_length: int = 1,
+                       max_sentence_length: int = 1000):
+    """One C++ pass for vocab + one for corpus mapping.
+
+    Returns (vocab, tokens, offsets): ``tokens`` int32 vocab indices
+    flattened, ``offsets`` int64 sentence boundaries.
+    """
+    lib = _load_lib()
+    if lib is None:
+        raise RuntimeError("native loader unavailable")
+    vp = lib.smtpu_vocab_build(path.encode(), _MODE[mode], min_count,
+                               min_sentence_length, max_sentence_length)
+    if not vp:
+        raise FileNotFoundError(path)
+    try:
+        V = lib.smtpu_vocab_size(vp)
+        keys = np.empty(V, np.uint64)
+        counts = np.empty(V, np.int64)
+        lib.smtpu_vocab_copy(vp, keys.ctypes.data, counts.ctypes.data)
+        vocab = Vocab(keys, counts,
+                      {int(k): i for i, k in enumerate(keys)})
+        cp = lib.smtpu_corpus_map(path.encode(), _MODE[mode], vp,
+                                  min_sentence_length, max_sentence_length)
+        if not cp:
+            raise FileNotFoundError(path)
+        try:
+            n_sent = lib.smtpu_corpus_n_sentences(cp)
+            n_tok = lib.smtpu_corpus_n_tokens(cp)
+            tokens = np.empty(n_tok, np.int32)
+            offsets = np.empty(n_sent + 1, np.int64)
+            lib.smtpu_corpus_copy(cp, tokens.ctypes.data,
+                                  offsets.ctypes.data)
+        finally:
+            lib.smtpu_corpus_free(cp)
+    finally:
+        lib.smtpu_vocab_free(vp)
+    return vocab, tokens, offsets
+
+
+class NativeCBOWBatcher:
+    """C++-backed drop-in for ``CBOWBatcher`` (same batch contract)."""
+
+    def __init__(self, tokens: np.ndarray, offsets: np.ndarray, vocab: Vocab,
+                 window: int, sample: float = -1.0, seed: int = 2008):
+        from swiftmpi_tpu.ops.sampling import subsample_keep_prob
+        lib = _load_lib()
+        if lib is None:
+            raise RuntimeError("native loader unavailable")
+        self._lib = lib
+        self.window = int(window)
+        self.vocab = vocab
+        # keep buffer refs alive: the batcher borrows these arrays
+        self._tokens = np.ascontiguousarray(tokens, np.int32)
+        self._offsets = np.ascontiguousarray(offsets, np.int64)
+        if sample >= 0:
+            self._keep = np.ascontiguousarray(
+                subsample_keep_prob(vocab.counts, sample), np.float32)
+            keep_ptr = self._keep.ctypes.data
+        else:
+            self._keep = None
+            keep_ptr = None
+        self._seed = seed
+        self._epoch_i = 0
+        self._h = lib.smtpu_batcher_new(
+            self._tokens.ctypes.data, self._offsets.ctypes.data,
+            len(self._offsets) - 1, self.window, keep_ptr, seed)
+
+    def epoch(self, batch_size: int) -> Iterator[CBOWBatch]:
+        lib, W2 = self._lib, 2 * self.window
+        self._epoch_i += 1
+        lib.smtpu_batcher_reset(self._h, self._seed + self._epoch_i)
+        while True:
+            centers = np.zeros(batch_size, np.int32)
+            contexts = np.zeros((batch_size, W2), np.int32)
+            mask = np.zeros((batch_size, W2), np.uint8)
+            n = lib.smtpu_batcher_next(
+                self._h, batch_size, centers.ctypes.data,
+                contexts.ctypes.data, mask.ctypes.data)
+            if n == 0:
+                return
+            yield CBOWBatch(centers, contexts, mask.astype(bool), int(n))
+            if n < batch_size:
+                return
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.smtpu_batcher_free(self._h)
+                self._h = None
+        except Exception:
+            pass

@@ -33,7 +33,7 @@ from swiftmpi_tpu.utils.hashing import bkdr_hash
 class Vocab:
     keys: np.ndarray     # (V,) uint64 external key per vocab index
     counts: np.ndarray   # (V,) int64 corpus frequency
-    index: Dict[int, int]  # key -> vocab index
+    index: Dict[int, int]  # uint64 key -> vocab index
 
     def __len__(self) -> int:
         return len(self.keys)
@@ -41,6 +41,11 @@ class Vocab:
     @property
     def total_words(self) -> int:
         return int(self.counts.sum())
+
+    def index_of(self, key: int):
+        """Vocab index for a raw token key (negative ints wrap to uint64,
+        matching storage), or None if OOV."""
+        return self.index.get(int(key) & ((1 << 64) - 1))
 
 
 def tokenize(line: str, mode: str = "int") -> List[int]:
@@ -62,10 +67,12 @@ def tokenize(line: str, mode: str = "int") -> List[int]:
 
 def build_vocab(sentences: Sequence[Sequence[int]],
                 min_count: int = 1) -> Vocab:
+    _M64 = (1 << 64) - 1
     counts: Dict[int, int] = {}
     for sent in sentences:
         for k in sent:
-            counts[k] = counts.get(k, 0) + 1
+            k &= _M64  # normalize to uint64 (negative int tokens wrap,
+            counts[k] = counts.get(k, 0) + 1  # matching the native loader)
     items = [(k, c) for k, c in counts.items() if c >= min_count]
     items.sort(key=lambda kc: (-kc[1], kc[0]))  # frequent-first, stable
     keys = np.array([k for k, _ in items], np.uint64)
@@ -116,7 +123,8 @@ class CBOWBatcher:
         # pre-map sentences to vocab indices, dropping OOV
         self._sents: List[np.ndarray] = []
         for sent in sentences:
-            idx = [vocab.index[k] for k in sent if k in vocab.index]
+            idx = [i for i in (vocab.index_of(k) for k in sent)
+                   if i is not None]
             if idx:
                 self._sents.append(np.asarray(idx, np.int32))
 

@@ -143,8 +143,9 @@ class Sent2Vec:
         # unparseable lines (sent2vec.cpp:71-74) — no garbage vectors.
         kept: List[Tuple[str, List[int]]] = []
         for ln in lines:
-            t = [wm.vocab.index[k] for k in tokenize(ln, tokenize_mode)
-                 if k in wm.vocab.index]
+            t = [i for i in (wm.vocab.index_of(k)
+                             for k in tokenize(ln, tokenize_mode))
+                 if i is not None]
             if t:
                 kept.append((ln, t))
         dropped = len(lines) - len(kept)

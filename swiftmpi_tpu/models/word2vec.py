@@ -41,7 +41,7 @@ import numpy as np
 
 from swiftmpi_tpu.cluster.cluster import Cluster
 from swiftmpi_tpu.data.text import (CBOWBatcher, Vocab, build_vocab,
-                                    load_corpus)
+                                    load_corpus)  # noqa: F401 (Vocab: API)
 from swiftmpi_tpu.io.checkpoint import dump_table_text, load_table_text
 from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
 from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
@@ -95,7 +95,12 @@ class Word2Vec:
 
     # -- vocab / table bring-up (word2vec_global.h:385-444) ----------------
     def build(self, sentences) -> "Word2Vec":
-        self.vocab = build_vocab(sentences)
+        return self.build_from_vocab(build_vocab(sentences))
+
+    def build_from_vocab(self, vocab: Vocab) -> "Word2Vec":
+        """Bring up table + sampler from a prebuilt vocab (e.g. the native
+        C++ loader's) without a python counting pass."""
+        self.vocab = vocab
         V = len(self.vocab)
         if V == 0:
             raise ValueError(
@@ -213,10 +218,11 @@ class Word2Vec:
         return apply_fn
 
     # -- training (word2vec.h:475-547) -------------------------------------
-    def train(self, data, niters: int = 1,
+    def train(self, data=None, niters: int = 1,
               batch_size: Optional[int] = None,
               checkpoint_path: Optional[str] = None,
-              checkpoint_every: int = 1) -> List[float]:
+              checkpoint_every: int = 1,
+              batcher=None) -> List[float]:
         """``data``: corpus path or list of key-list sentences.  Returns
         per-iteration mean error (reference Error::norm per train_iter,
         word2vec.h:491).
@@ -224,12 +230,27 @@ class Word2Vec:
         ``checkpoint_path``: mid-training full-fidelity checkpoints
         (optimizer state included) every ``checkpoint_every`` iterations —
         a capability the reference lacks (SURVEY.md §5: checkpoint-out only
-        at exit, optimizer state dropped).  Resume with ``resume()``."""
-        if isinstance(data, str):
-            data = load_corpus(data, min_sentence_length=max(
-                self.min_sentence_length, 1))
-        if self.vocab is None:
-            self.build(data)
+        at exit, optimizer state dropped).  Resume with ``resume()``.
+
+        ``batcher``: custom batch source with an ``epoch(batch_size)``
+        iterator (e.g. the native C++ ``NativeCBOWBatcher``); its vocab
+        indexing must match this model's vocab (both pipelines sort by
+        (count desc, key asc), so python- and native-built vocabs agree)."""
+        if batcher is None:
+            if isinstance(data, str):
+                data = load_corpus(data, min_sentence_length=max(
+                    self.min_sentence_length, 1))
+            if data is None:
+                raise ValueError("train() needs data or a batcher")
+            if self.vocab is None:
+                self.build(data)
+        elif self.vocab is None:
+            if hasattr(batcher, "vocab"):
+                self.build_from_vocab(batcher.vocab)
+            else:
+                raise RuntimeError(
+                    "call build()/build_from_vocab() before train() with a "
+                    "vocab-less batcher")
         sync = self.local_steps <= 1
         if self._step is None:
             if sync:
@@ -239,7 +260,9 @@ class Word2Vec:
                               jax.jit(self._build_apply()))
         batch_size = batch_size or max(
             256, self.minibatch // (2 * self.window))
-        batcher = CBOWBatcher(data, self.vocab, self.window, self.sample)
+        if batcher is None:
+            batcher = CBOWBatcher(data, self.vocab, self.window,
+                                  self.sample)
         state = self.table.state
         frozen = state   # stale snapshot for the async mode
         losses = []

@@ -1,0 +1,124 @@
+"""Parity tests: native C++ loader vs the pure-Python pipeline."""
+
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.data.text import (CBOWBatcher, build_vocab, load_corpus,
+                                    synthetic_corpus)
+from swiftmpi_tpu.data import native
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native loader not built")
+
+
+@pytest.fixture
+def corpus_file(tmp_path):
+    corpus = synthetic_corpus(30, vocab_size=80, length=20, seed=12)
+    p = tmp_path / "corpus.txt"
+    with open(p, "w") as f:
+        for s in corpus:
+            f.write(" ".join(map(str, s)) + "\n")
+    return str(p), corpus
+
+
+def test_native_vocab_matches_python(corpus_file):
+    path, corpus = corpus_file
+    vocab_py = build_vocab(load_corpus(path))
+    vocab_c, tokens, offsets = native.load_corpus_native(path)
+    np.testing.assert_array_equal(vocab_py.keys, vocab_c.keys)
+    np.testing.assert_array_equal(vocab_py.counts, vocab_c.counts)
+    assert len(offsets) - 1 == len(corpus)
+    assert tokens.sum() >= 0 and (tokens < len(vocab_c)).all()
+
+
+def test_native_bkdr_mode_matches_python(tmp_path):
+    p = tmp_path / "words.txt"
+    p.write_text("the quick brown fox the the quick\n")
+    vocab_py = build_vocab(load_corpus(str(p), mode="bkdr"))
+    vocab_c, _, _ = native.load_corpus_native(str(p), mode="bkdr")
+    np.testing.assert_array_equal(vocab_py.keys, vocab_c.keys)
+    np.testing.assert_array_equal(vocab_py.counts, vocab_c.counts)
+
+
+def test_native_vocab_parity_with_sentence_filtering(tmp_path):
+    # Vocab counting must see the same filtered token stream as the corpus
+    # map (and as python's load_corpus -> build_vocab pipeline).
+    p = tmp_path / "c.txt"
+    p.write_text("1 2\n3 4 5 6 7\n1 3 5 7 9 11\n")
+    vocab_py = build_vocab(load_corpus(str(p), min_sentence_length=3))
+    vocab_c, _, _ = native.load_corpus_native(str(p), min_sentence_length=3)
+    np.testing.assert_array_equal(vocab_py.keys, vocab_c.keys)
+    np.testing.assert_array_equal(vocab_py.counts, vocab_c.counts)
+
+
+def test_native_vocab_parity_negative_tokens(tmp_path):
+    p = tmp_path / "n.txt"
+    p.write_text("-5 -5 3 3 3 -5 7\n")
+    vocab_py = build_vocab(load_corpus(str(p)))
+    vocab_c, _, _ = native.load_corpus_native(str(p))
+    np.testing.assert_array_equal(vocab_py.keys, vocab_c.keys)
+    # and the batcher path resolves raw negative tokens via index_of
+    assert vocab_py.index_of(-5) is not None
+    assert vocab_py.index_of(-5) == vocab_c.index_of(-5)
+
+
+def test_native_min_sentence_and_chunking(tmp_path):
+    p = tmp_path / "mixed.txt"
+    p.write_text("1 2\n" + " ".join(str(i % 5) for i in range(70)) + "\n")
+    vocab_c, tokens, offsets = native.load_corpus_native(
+        str(p), min_sentence_length=3, max_sentence_length=30)
+    lens = np.diff(offsets)
+    # "1 2" dropped (len<3); 70-token line chunked 30/30/10
+    assert lens.tolist() == [30, 30, 10]
+
+
+def test_native_batcher_covers_all_positions(corpus_file):
+    path, corpus = corpus_file
+    vocab_c, tokens, offsets = native.load_corpus_native(path)
+    b = native.NativeCBOWBatcher(tokens, offsets, vocab_c, window=3)
+    centers = []
+    for batch in b.epoch(64):
+        assert batch.contexts.shape == (64, 6)
+        # every real row has at least one context; padding is zero
+        assert batch.ctx_mask[:batch.n_words].any(axis=1).all()
+        assert (batch.contexts[~batch.ctx_mask] == 0).all()
+        centers.append(batch.centers[:batch.n_words])
+    centers = np.concatenate(centers)
+    # without subsampling every position is a center exactly once per epoch
+    got = np.bincount(centers, minlength=len(vocab_c))
+    np.testing.assert_array_equal(got, np.asarray(vocab_c.counts))
+
+
+def test_native_batcher_subsampling_and_reshuffle(corpus_file):
+    path, _ = corpus_file
+    vocab_c, tokens, offsets = native.load_corpus_native(path)
+    b = native.NativeCBOWBatcher(tokens, offsets, vocab_c, window=2,
+                                 sample=0.01, seed=7)
+    n1 = sum(bt.n_words for bt in b.epoch(64))
+    n2 = sum(bt.n_words for bt in b.epoch(64))
+    total = int(vocab_c.counts.sum())
+    assert 0 < n1 < total  # subsampling dropped centers
+    assert 0 < n2 < total
+    first_a = next(iter(b.epoch(64))).centers.copy()
+    first_b = next(iter(b.epoch(64))).centers.copy()
+    assert not np.array_equal(first_a, first_b)  # epochs reshuffled
+
+
+def test_native_batcher_trains_word2vec(devices8, corpus_file):
+    # End-to-end: the native batcher slots into Word2Vec.train unchanged.
+    from swiftmpi_tpu.models import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+    path, corpus = corpus_file
+    vocab_c, tokens, offsets = native.load_corpus_native(path)
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla"},
+        "word2vec": {"len_vec": 8, "window": 2, "negative": 3,
+                     "sample": -1, "learning_rate": 0.05},
+        "server": {"initial_learning_rate": 0.3},
+        "worker": {"minibatch": 256},
+    })
+    model = Word2Vec(config=cfg)
+    losses = model.train(load_corpus(path), niters=2, batch_size=64,
+                         batcher=native.NativeCBOWBatcher(
+                             tokens, offsets, vocab_c, window=2))
+    assert len(losses) == 2
