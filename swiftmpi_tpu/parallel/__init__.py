@@ -1,0 +1,13 @@
+"""Parallelism primitives: collectives and context-parallel attention."""
+
+from swiftmpi_tpu.parallel.collectives import (all_gather, all_to_all,
+                                               axis_index, axis_size, pmean,
+                                               psum, reduce_scatter,
+                                               ring_permute)
+from swiftmpi_tpu.parallel.ring_attention import (SEQ_AXIS, full_attention,
+                                                  ring_attention,
+                                                  ulysses_attention)
+
+__all__ = ["all_gather", "all_to_all", "axis_index", "axis_size", "pmean",
+           "psum", "reduce_scatter", "ring_permute", "SEQ_AXIS",
+           "full_attention", "ring_attention", "ulysses_attention"]
