@@ -115,6 +115,28 @@ class AdaGradAccess(AccessMethod):
         return out
 
 
+class PallasAdaGradAccess(AdaGradAccess):
+    """AdaGradAccess with the update rule executed by the fused Pallas TPU
+    kernel (ops/pallas_kernels.adagrad_update) — guaranteed-in-place HBM
+    update via input/output aliasing.  Numerics identical to the base
+    rule; interpret mode keeps it runnable on CPU."""
+
+    def apply_push(self, params, grads):
+        from swiftmpi_tpu.ops.pallas_kernels import (adagrad_update,
+                                                     default_interpret)
+        interpret = default_interpret()
+        out = dict(params)
+        for r in self.rules:
+            g = grads[r.grad].astype(jnp.float32)
+            p2, a2 = adagrad_update(
+                params[r.param], params[r.accum], g,
+                lr=self.learning_rate, fudge=self.fudge_factor,
+                interpret=interpret)
+            out[r.param] = p2
+            out[r.accum] = a2
+        return out
+
+
 def lr_access(learning_rate: float) -> AdaGradAccess:
     """Logistic-regression row: scalar weight + grad²-sum
     (reference LRParam, lr.cpp:14-22,60-81)."""
