@@ -29,6 +29,12 @@ stale gradients, word2vec_global.h:577-651) maps to ``local_steps > 1`` —
 gradients are computed against a table snapshot refreshed only every
 ``local_steps`` batches while pushes land immediately, reproducing
 bounded-staleness async SGD without abandoning SPMD.
+
+Skip-gram mode (``[word2vec] sg: 1`` — the BASELINE.md config-#2 text8
+benchmark): each (context, center) pair is an independent example — input
+vector v[context word], targets h[center] (label 1) + K fresh negatives per
+pair (label 0), exactly the word2vec.c skip-gram loop the reference's CBOW
+hot loop was derived from.  Same batch layout; the pair axis is (B, 2W).
 """
 
 from __future__ import annotations
@@ -52,6 +58,29 @@ from swiftmpi_tpu.utils.logger import get_logger
 from swiftmpi_tpu.utils.timers import Throughput
 
 log = get_logger(__name__)
+
+
+def _mean_scale(slots_flat, capacity):
+    """Reciprocal per-key contribution count (the reference's grad/count
+    mean normalization at push serialization, word2vec.h:120-132).
+    Invalid (-1) slots get a scale against a clipped index; their
+    contributions are already zeroed by the caller's masks."""
+    safe = jnp.where(slots_flat >= 0, slots_flat, capacity)
+    counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
+        1.0, mode="drop")
+    return 1.0 / jnp.maximum(
+        counts[jnp.clip(slots_flat, 0, capacity - 1)], 1.0)
+
+
+def _assemble_push(tf, cf, h_flat, v_flat, capacity):
+    """Mean-normalize per-key contributions and lay out the combined
+    (targets ++ contexts) slot/grad arrays for one transfer push."""
+    h_flat = h_flat * _mean_scale(tf, capacity)[:, None]
+    v_flat = v_flat * _mean_scale(cf, capacity)[:, None]
+    slots = jnp.concatenate([tf, cf])
+    grads = {"h": jnp.concatenate([h_flat, jnp.zeros_like(v_flat)]),
+             "v": jnp.concatenate([jnp.zeros_like(h_flat), v_flat])}
+    return slots, grads
 
 
 def w2v_formatter(row: Dict[str, np.ndarray]) -> str:
@@ -78,6 +107,7 @@ class Word2Vec:
         self.window = g("word2vec", "window", 4).to_int32()
         self.negative = g("word2vec", "negative", 20).to_int32()
         self.sample = g("word2vec", "sample", -1.0).to_float()
+        self.sg = g("word2vec", "sg", 0).to_int32()
         self.alpha = g("word2vec", "learning_rate", 0.05).to_float()
         self.min_sentence_length = g(
             "word2vec", "min_sentence_length", 1).to_int32()
@@ -164,10 +194,12 @@ class Word2Vec:
         return multi
 
     def _build_grads(self):
-        """Gradient phase of the step: pull rows, CBOW-NS math, per-key
-        mean normalization — no push.  Split out so the async
+        """Gradient phase of the step: pull rows, CBOW- or skip-gram-NS
+        math, per-key mean normalization — no push.  Split out so the async
         (``local_steps``) mode can compute grads against a *stale* state
         snapshot while pushes land on the live state."""
+        if self.sg:
+            return self._build_grads_sg()
         access = self.access
         transfer = self.transfer
         capacity = self.table.capacity
@@ -209,25 +241,69 @@ class Word2Vec:
             v_contrib = jnp.where(ctx_mask[..., None],
                                   neu1e[:, None, :], 0.0)         # (B,2W,d)
 
-            # per-key mean normalization, separate h/v counts
-            # (WLocalGrad h_count/v_count, word2vec.h:62-84,120-132)
-            def mean_scale(slots_flat):
-                safe = jnp.where(slots_flat >= 0, slots_flat, capacity)
-                counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
-                    1.0, mode="drop")
-                return 1.0 / jnp.maximum(
-                    counts[jnp.clip(slots_flat, 0, capacity - 1)], 1.0)
+            all_slots, grads = _assemble_push(
+                t_slots.reshape(-1), ctx_slots.reshape(-1),
+                h_contrib.reshape(-1, d), v_contrib.reshape(-1, d),
+                capacity)
 
-            tf = t_slots.reshape(-1)
-            cf = ctx_slots.reshape(-1)
-            h_flat = h_contrib.reshape(-1, d) * mean_scale(tf)[:, None]
-            v_flat = v_contrib.reshape(-1, d) * mean_scale(cf)[:, None]
+            err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
+            err_cnt = t_valid.sum()
+            return all_slots, grads, err_sum, err_cnt
 
-            all_slots = jnp.concatenate([tf, cf])
-            zeros_h = jnp.zeros_like(v_flat)
-            zeros_v = jnp.zeros_like(h_flat)
-            grads = {"h": jnp.concatenate([h_flat, zeros_h]),
-                     "v": jnp.concatenate([zeros_v, v_flat])}
+        return grads_fn
+
+    def _build_grads_sg(self):
+        """Skip-gram gradient phase.  Pair axis (B, 2W): input v[context],
+        targets h[center]+K negatives sampled fresh *per pair* (word2vec.c
+        semantics; the reference's learn_instance is the CBOW specialization
+        of the same loop, word2vec.h:550-615).  Masked pairs (window
+        padding) contribute nothing."""
+        access = self.access
+        transfer = self.transfer
+        capacity = self.table.capacity
+        K = self.negative
+        alpha = self.alpha
+        d = self.len_vec
+
+        def grads_fn(state, slot_of_vocab, alias_prob, alias_idx,
+                     centers, contexts, ctx_mask, key):
+            B, W2 = contexts.shape
+            negs = sample_alias(key, alias_prob, alias_idx, (B, W2, K))
+            targets_v = jnp.concatenate(
+                [jnp.broadcast_to(centers[:, None, None], (B, W2, 1)), negs],
+                axis=2)                                       # (B, W2, K+1)
+            # negative == center is skipped (word2vec.h:584-586); padding
+            # pairs are fully dead.
+            t_valid = jnp.concatenate(
+                [jnp.ones((B, W2, 1), bool),
+                 negs != centers[:, None, None]], axis=2)
+            t_valid = t_valid & ctx_mask[..., None]
+            t_slots = jnp.where(t_valid, slot_of_vocab[targets_v], -1)
+            ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
+
+            pulled = transfer.pull(
+                state,
+                jnp.concatenate([t_slots.reshape(-1),
+                                 ctx_slots.reshape(-1)]),
+                access)
+            n_t = B * W2 * (K + 1)
+            h_t = pulled["h"][:n_t].reshape(B, W2, K + 1, d)
+            v_in = pulled["v"][n_t:].reshape(B, W2, d)
+
+            f = jnp.einsum("bwd,bwkd->bwk", v_in, h_t)
+            labels = jnp.concatenate(
+                [jnp.ones((B, W2, 1)), jnp.zeros((B, W2, K))], axis=2)
+            g = (labels - sigmoid_clipped(f)) * alpha
+            g = jnp.where(t_valid, g, 0.0)                    # (B, W2, K+1)
+
+            h_contrib = g[..., None] * v_in[:, :, None, :]    # (B,W2,K+1,d)
+            v_contrib = jnp.einsum("bwk,bwkd->bwd", g, h_t)   # (B, W2, d)
+            v_contrib = jnp.where(ctx_mask[..., None], v_contrib, 0.0)
+
+            all_slots, grads = _assemble_push(
+                t_slots.reshape(-1), ctx_slots.reshape(-1),
+                h_contrib.reshape(-1, d), v_contrib.reshape(-1, d),
+                capacity)
 
             err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
             err_cnt = t_valid.sum()
@@ -305,6 +381,11 @@ class Word2Vec:
                         jnp.asarray(batch.ctx_mask), sub)
                 if sync:
                     state, es, ec = self._step(state, *args)
+                    # the step donates (deletes) the input state buffers;
+                    # repoint the table at the live ones immediately so an
+                    # abnormal exit (raise, Ctrl-C) never strands the model
+                    # with deleted arrays
+                    self.table.state = state
                 else:
                     # async/global variant semantics (word2vec_global.h:
                     # 577-651): grads computed against a stale snapshot,
@@ -313,6 +394,7 @@ class Word2Vec:
                     grads_fn, apply_fn = self._step
                     slots, grads, es, ec = grads_fn(frozen, *args)
                     state = apply_fn(state, slots, grads)
+                    self.table.state = state
                     step_i += 1
                     if step_i % self.local_steps == 0:
                         frozen = state

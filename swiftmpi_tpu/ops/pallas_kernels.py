@@ -9,12 +9,16 @@ lr.cpp:68-75) as a single VMEM pass:
 
 XLA already fuses this chain well; the Pallas version pins the execution
 shape — elementwise over a flat ``(rows, 128)`` lane-aligned view with one
-VMEM pass per block and input/output aliasing inside the kernel, so the
-update itself never double-buffers the table.  (The flat view may cost a
-relayout copy at entry/exit for widths that are not lane-aligned; for
-128-multiple embeddings and aligned capacities the reshape is layout-free.
-The kernel exists as the framework's optimizer-kernel extension point, not
-because the jnp rule is slow.)
+VMEM pass per block, and declares input/output aliasing for the pallas
+call.  Whether the aliasing actually elides the table copy depends on the
+caller: inside the framework's jitted training step the whole table state
+is donated (``_build_step``'s ``donate_argnums=0``), so XLA can satisfy
+the alias in place; called standalone (as the tests do), the jit keeps its
+inputs valid and a copy is inserted.  (The flat view may also cost a
+relayout copy for widths that are not lane-aligned; for 128-multiple
+embeddings the reshape is layout-free.  The kernel exists as the
+framework's optimizer-kernel extension point, not because the jnp rule is
+slow.)
 
 On non-TPU backends the kernel runs in Pallas interpret mode (numerics
 identical), which the tests use to pin it against the pure-jnp rule.

@@ -117,9 +117,11 @@ class AdaGradAccess(AccessMethod):
 
 class PallasAdaGradAccess(AdaGradAccess):
     """AdaGradAccess with the update rule executed by the fused Pallas TPU
-    kernel (ops/pallas_kernels.adagrad_update) — guaranteed-in-place HBM
-    update via input/output aliasing.  Numerics identical to the base
-    rule; interpret mode keeps it runnable on CPU."""
+    kernel (ops/pallas_kernels.adagrad_update).  The kernel declares
+    input/output aliasing; the update is truly in-place when the enclosing
+    training step donates the table state (as ``Word2Vec._build_step``
+    does).  Numerics identical to the base rule; interpret mode keeps it
+    runnable on CPU."""
 
     def apply_push(self, params, grads):
         from swiftmpi_tpu.ops.pallas_kernels import (adagrad_update,

@@ -161,6 +161,118 @@ def test_w2v_embeddings_capture_cooccurrence(devices8):
     assert within > across, (within, across)
 
 
+def test_w2v_skipgram_trains_and_loss_decreases(devices8):
+    corpus = synthetic_corpus(60, vocab_size=100, length=18, seed=3)
+    model = make_model(word2vec={"sg": 1})
+    losses = model.train(corpus, niters=5, batch_size=64)
+    assert len(losses) == 5
+    assert losses[-1] < losses[0], losses
+
+
+def test_w2v_skipgram_grads_match_numpy():
+    """SG gradient phase vs a direct numpy transcription of the word2vec.c
+    skip-gram inner loop (mean-normalized per key, as at push time)."""
+    model = make_model(word2vec={"sg": 1, "negative": 3, "len_vec": 8,
+                                 "window": 2})
+    corpus = synthetic_corpus(10, vocab_size=30, length=10, seed=5)
+    model.build(corpus)
+    batcher = CBOWBatcher(corpus, model.vocab, model.window)
+    batch = next(batcher.epoch(16))
+    grads_fn = jax.jit(model._build_grads())
+    key = jax.random.key(7)
+    slots, grads, es, ec = grads_fn(
+        model.table.state, model._slot_of_vocab, model._alias_prob,
+        model._alias_idx, jnp.asarray(batch.centers),
+        jnp.asarray(batch.contexts), jnp.asarray(batch.ctx_mask), key)
+    slots, es, ec = np.asarray(slots), float(es), int(ec)
+    gh, gv = np.asarray(grads["h"]), np.asarray(grads["v"])
+
+    # numpy reference: recompute from the same sampled negatives (first
+    # B*W2*K of the slots tensor layout: [center|negs] per pair)
+    B, W2 = batch.contexts.shape
+    K = model.negative
+    d = model.len_vec
+    t_slots = slots[:B * W2 * (K + 1)].reshape(B, W2, K + 1)
+    sov = np.asarray(model._slot_of_vocab)
+    h_tab = np.asarray(model.table.state["h"])
+    v_tab = np.asarray(model.table.state["v"])
+    alpha = model.alpha
+
+    exp_err, n_valid = 0.0, 0
+    # accumulate un-normalized grads per slot, then compare mean-normalized
+    acc_h = {}
+    acc_v = {}
+    cnt_h = {}
+    cnt_v = {}
+    for b in range(B):
+        for w in range(W2):
+            if not batch.ctx_mask[b, w]:
+                assert (t_slots[b, w] == -1).all()
+                continue
+            vs = sov[batch.contexts[b, w]]
+            v_in = v_tab[vs]
+            for k in range(K + 1):
+                ts = t_slots[b, w, k]
+                if ts < 0:
+                    continue
+                label = 1.0 if k == 0 else 0.0
+                f = float(v_in @ h_tab[ts])
+                f = np.clip(f, -6.0, 6.0)
+                sig = 1.0 / (1.0 + np.exp(-f))
+                g = (label - sig) * alpha
+                exp_err += 1e4 * g * g
+                n_valid += 1
+                acc_h[ts] = acc_h.get(ts, 0) + g * v_in
+                cnt_h[ts] = cnt_h.get(ts, 0) + 1
+                acc_v[vs] = acc_v.get(vs, 0) + g * h_tab[ts]
+            cnt_v[vs] = cnt_v.get(vs, 0) + 1
+
+    assert n_valid == ec
+    np.testing.assert_allclose(exp_err, es, rtol=2e-3)
+    # scatter-summed device grads per slot
+    dev_h = {}
+    dev_v = {}
+    for i, s in enumerate(slots):
+        if s < 0:
+            continue
+        dev_h[s] = dev_h.get(s, 0) + gh[i]
+        dev_v[s] = dev_v.get(s, 0) + gv[i]
+    for s, a in acc_h.items():
+        np.testing.assert_allclose(dev_h[s], a / cnt_h[s],
+                                   rtol=2e-3, atol=1e-6)
+    for s, a in acc_v.items():
+        np.testing.assert_allclose(dev_v[s], a / cnt_v[s],
+                                   rtol=2e-3, atol=1e-6)
+
+
+def test_w2v_table_survives_mid_train_abort(devices8):
+    """The sync step donates its state input; the table must repoint at
+    live buffers every step so an abnormal exit never strands the model
+    with deleted arrays."""
+    corpus = synthetic_corpus(20, vocab_size=40, length=12, seed=9)
+    model = make_model()
+    model.build(corpus)
+    batcher = CBOWBatcher(corpus, model.vocab, model.window)
+
+    class Boom(Exception):
+        pass
+
+    def exploding_epoch(batch_size):
+        for i, b in enumerate(batcher.epoch(batch_size)):
+            if i == 2:
+                raise Boom
+            yield b
+
+    broken = type("B", (), {"epoch": staticmethod(exploding_epoch)})()
+    with pytest.raises(Boom):
+        model.train(batcher=broken, niters=1, batch_size=32)
+    # every field still readable after the abort
+    for f, arr in model.table.state.items():
+        np.asarray(arr)
+    k = int(model.vocab.keys[0])
+    assert model.embedding(k) is not None
+
+
 def test_w2v_async_local_steps_trains(devices8):
     corpus = synthetic_corpus(40, vocab_size=60, length=14, seed=8)
     model = make_model(word2vec={"local_steps": 3})
