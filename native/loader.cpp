@@ -17,12 +17,16 @@
 // Build: g++ -O3 -std=c++17 -shared -fPIC loader.cpp -o libsmtpu_loader.so
 
 #include <algorithm>
+#include <condition_variable>
 #include <cstdint>
 #include <cstdio>
 #include <cstdlib>
 #include <cstring>
+#include <deque>
+#include <mutex>
 #include <random>
 #include <string>
+#include <thread>
 #include <unordered_map>
 #include <vector>
 
@@ -282,5 +286,94 @@ int64_t smtpu_batcher_next(SmtpuBatcher* b, int64_t batch_size,
 }
 
 void smtpu_batcher_free(SmtpuBatcher* b) { delete b; }
+
+// ---- prefetch executor ----------------------------------------------------
+//
+// Background batch-assembly pipeline: a producer thread drives the batcher
+// through one epoch while the device computes — the TPU-native role of the
+// reference's AsynExec thread pool + BasicChannel task queue
+// (/root/reference/src/utils/AsynExec.h:34-51, BasicChannel.h), repurposed
+// from RPC-handler fan-out to input-pipeline overlap.  Bounded queue depth
+// gives backpressure exactly like queue_with_capacity (utils/queue.h:50-114).
+
+struct SmtpuPrefetcher {
+  struct Item {
+    std::vector<int32_t> centers;
+    std::vector<int32_t> contexts;
+    std::vector<uint8_t> mask;
+    int64_t n;
+  };
+  SmtpuBatcher* b;   // borrowed; caller keeps it alive
+  int64_t batch_size;
+  size_t depth;
+  std::thread producer;
+  std::mutex mu;
+  std::condition_variable cv_push, cv_pop;
+  std::deque<Item> q;
+  bool done = false;       // producer finished the epoch
+  bool cancel = false;     // consumer is shutting down
+
+  void run() {
+    const int W2 = 2 * b->window;
+    for (;;) {
+      Item it;
+      it.centers.resize(batch_size);
+      it.contexts.resize(batch_size * W2);
+      it.mask.resize(batch_size * W2);
+      it.n = smtpu_batcher_next(b, batch_size, it.centers.data(),
+                                it.contexts.data(), it.mask.data());
+      std::unique_lock<std::mutex> lk(mu);
+      if (it.n == 0) break;
+      cv_push.wait(lk, [&] { return q.size() < depth || cancel; });
+      if (cancel) return;
+      bool last = it.n < batch_size;
+      q.push_back(std::move(it));
+      cv_pop.notify_one();
+      if (last) break;
+    }
+    std::lock_guard<std::mutex> lk(mu);
+    done = true;
+    cv_pop.notify_one();
+  }
+};
+
+SmtpuPrefetcher* smtpu_prefetcher_new(SmtpuBatcher* b, int64_t batch_size,
+                                      int64_t depth, uint64_t epoch_seed) {
+  smtpu_batcher_reset(b, epoch_seed);
+  auto* p = new SmtpuPrefetcher();
+  p->b = b;
+  p->batch_size = batch_size;
+  p->depth = (size_t)(depth < 1 ? 1 : depth);
+  p->producer = std::thread([p] { p->run(); });
+  return p;
+}
+
+// Blocks until a batch is ready; returns n examples (0 = epoch exhausted).
+int64_t smtpu_prefetcher_next(SmtpuPrefetcher* p, int32_t* centers,
+                              int32_t* contexts, uint8_t* mask) {
+  std::unique_lock<std::mutex> lk(p->mu);
+  p->cv_pop.wait(lk, [&] { return !p->q.empty() || p->done; });
+  if (p->q.empty()) return 0;
+  SmtpuPrefetcher::Item it = std::move(p->q.front());
+  p->q.pop_front();
+  p->cv_push.notify_one();
+  lk.unlock();
+  const int W2 = 2 * p->b->window;
+  memcpy(centers, it.centers.data(), p->batch_size * sizeof(int32_t));
+  memcpy(contexts, it.contexts.data(),
+         p->batch_size * W2 * sizeof(int32_t));
+  memcpy(mask, it.mask.data(), p->batch_size * W2);
+  return it.n;
+}
+
+void smtpu_prefetcher_free(SmtpuPrefetcher* p) {
+  {
+    std::lock_guard<std::mutex> lk(p->mu);
+    p->cancel = true;
+    p->cv_push.notify_all();
+  }
+  if (p->producer.joinable()) p->producer.join();
+  delete p;
+}
 
 }  // extern "C"

@@ -51,9 +51,9 @@ def main(argv=None) -> int:
         vocab_c, tokens, offsets = native.load_corpus_native(
             cmd.getValue("data"), mode=mode,
             min_sentence_length=max(model.min_sentence_length, 1))
-        batcher = native.NativeCBOWBatcher(
+        batcher = native.PrefetchingCBOWBatcher(
             tokens, offsets, vocab_c, model.window, model.sample)
-        log.info("using native C++ loader")
+        log.info("using native C++ loader (prefetching)")
         losses = model.train(niters=niters, batcher=batcher)
     else:
         corpus = load_corpus(cmd.getValue("data"), mode=mode,

@@ -64,6 +64,49 @@ def load_file(path: str) -> List[Tuple[float, List[Tuple[int, float]]]]:
     return out
 
 
+@dataclass
+class CSRData:
+    """Whole-dataset CSR arrays (the native parser's output shape); row i's
+    features are ``feat_ids[offsets[i]:offsets[i+1]]``."""
+    labels: np.ndarray     # (N,) float32 {0,1}
+    offsets: np.ndarray    # (N+1,) int64
+    feat_ids: np.ndarray   # (nnz,) uint64
+    feat_vals: np.ndarray  # (nnz,) float32
+
+    def __len__(self) -> int:
+        return len(self.labels)
+
+    @property
+    def max_feats(self) -> int:
+        if not len(self.labels):
+            return 0
+        return int(np.max(np.diff(self.offsets)))
+
+
+def to_csr(instances) -> CSRData:
+    """Python instance list -> CSR arrays (fallback for the native parser)."""
+    labels = np.asarray([y for y, _ in instances], np.float32)
+    offsets = np.zeros(len(instances) + 1, np.int64)
+    ids, vals = [], []
+    for i, (_, feats) in enumerate(instances):
+        for f, v in feats:
+            ids.append(f)
+            vals.append(v)
+        offsets[i + 1] = len(ids)
+    return CSRData(labels, offsets, np.asarray(ids, np.uint64),
+                   np.asarray(vals, np.float32))
+
+
+def load_data(path: str) -> CSRData:
+    """Load a libSVM file as CSR, via the native C++ parser when available
+    (io.cpp smtpu_libsvm_parse) else the python line parser."""
+    from swiftmpi_tpu.data import native
+    if native.available():
+        labels, offsets, ids, vals = native.parse_libsvm_native(path)
+        return CSRData(labels, offsets, ids, vals)
+    return to_csr(load_file(path))
+
+
 def make_batch(instances, max_feats: Optional[int] = None) -> LibSVMBatch:
     B = len(instances)
     F = max_feats or max(len(f) for _, f in instances)
@@ -80,13 +123,50 @@ def make_batch(instances, max_feats: Optional[int] = None) -> LibSVMBatch:
     return LibSVMBatch(targets, ids, vals, mask)
 
 
+def _iter_csr(data: CSRData, batch_size: int, F: int,
+              drop_remainder: bool) -> Iterator[LibSVMBatch]:
+    """Vectorized minibatch assembly straight from CSR arrays — no
+    per-instance python loop."""
+    N = len(data)
+    nnz = len(data.feat_ids)
+    col = np.arange(F)
+    for i in range(0, N, batch_size):
+        j = min(i + batch_size, N)
+        if j - i < batch_size and drop_remainder:
+            return
+        lens = (data.offsets[i + 1:j + 1] - data.offsets[i:j])
+        lens = np.minimum(lens, F)
+        mask = col[None, :] < lens[:, None]                  # (b, F)
+        if nnz == 0:  # all-feature-less rows: nothing to gather
+            ids = np.zeros((j - i, F), np.uint64)
+            vals = np.zeros((j - i, F), np.float32)
+        else:
+            flat = data.offsets[i:j, None] + col[None, :]
+            flat = np.clip(flat, 0, nnz - 1)
+            ids = np.where(mask, data.feat_ids[flat], np.uint64(0))
+            vals = np.where(mask, data.feat_vals[flat], np.float32(0))
+        targets = data.labels[i:j]
+        if j - i < batch_size:                               # pad tail
+            pad = batch_size - (j - i)
+            targets = np.concatenate([targets, np.zeros(pad, np.float32)])
+            ids = np.concatenate([ids, np.zeros((pad, F), np.uint64)])
+            vals = np.concatenate([vals, np.zeros((pad, F), np.float32)])
+            mask = np.concatenate([mask, np.zeros((pad, F), bool)])
+        yield LibSVMBatch(targets, ids, vals, mask)
+
+
 def iter_minibatches(instances, batch_size: int,
                      max_feats: Optional[int] = None,
                      drop_remainder: bool = False
                      ) -> Iterator[LibSVMBatch]:
     """Fixed-size minibatches (reference [worker] minibatch config); the
     trailing short batch is padded up to ``batch_size`` with zero-mask rows
-    so every step has one static shape (one XLA compilation)."""
+    so every step has one static shape (one XLA compilation).  Accepts a
+    python instance list or ``CSRData``."""
+    if isinstance(instances, CSRData):
+        F = max_feats or instances.max_feats
+        yield from _iter_csr(instances, batch_size, F, drop_remainder)
+        return
     F = max_feats or max(len(f) for _, f in instances)
     for i in range(0, len(instances), batch_size):
         chunk = instances[i:i + batch_size]

@@ -39,15 +39,21 @@ def _load_lib():
     with _lib_lock:
         if _lib is not None or _build_failed:
             return _lib
-        if not os.path.exists(_SO_PATH):
-            src = os.path.join(_NATIVE_DIR, "loader.cpp")
-            if not os.path.exists(src):
+        srcs = [os.path.join(_NATIVE_DIR, f)
+                for f in ("loader.cpp", "io.cpp")]
+        stale = (not os.path.exists(_SO_PATH)
+                 or any(os.path.exists(s)
+                        and os.path.getmtime(s) > os.path.getmtime(_SO_PATH)
+                        for s in srcs))
+        if stale:
+            srcs = [s for s in srcs if os.path.exists(s)]
+            if not srcs:
                 _build_failed = True
                 return None
             try:
                 subprocess.run(
                     ["g++", "-O3", "-std=c++17", "-Wall", "-shared",
-                     "-fPIC", src, "-o", _SO_PATH],
+                     "-fPIC", *srcs, "-o", _SO_PATH],
                     check=True, capture_output=True, timeout=120)
             except (subprocess.SubprocessError, FileNotFoundError) as e:
                 log.warning("native loader build failed (%s); "
@@ -80,6 +86,32 @@ def _load_lib():
         lib.smtpu_batcher_next.argtypes = [c.c_void_p, c.c_int64, c.c_void_p,
                                            c.c_void_p, c.c_void_p]
         lib.smtpu_batcher_free.argtypes = [c.c_void_p]
+        lib.smtpu_prefetcher_new.restype = c.c_void_p
+        lib.smtpu_prefetcher_new.argtypes = [c.c_void_p, c.c_int64,
+                                             c.c_int64, c.c_uint64]
+        lib.smtpu_prefetcher_next.restype = c.c_int64
+        lib.smtpu_prefetcher_next.argtypes = [c.c_void_p, c.c_void_p,
+                                              c.c_void_p, c.c_void_p]
+        lib.smtpu_prefetcher_free.argtypes = [c.c_void_p]
+        lib.smtpu_libsvm_parse.restype = c.c_void_p
+        lib.smtpu_libsvm_parse.argtypes = [c.c_char_p]
+        lib.smtpu_libsvm_n_rows.restype = c.c_int64
+        lib.smtpu_libsvm_n_rows.argtypes = [c.c_void_p]
+        lib.smtpu_libsvm_nnz.restype = c.c_int64
+        lib.smtpu_libsvm_nnz.argtypes = [c.c_void_p]
+        lib.smtpu_libsvm_n_bad.restype = c.c_int64
+        lib.smtpu_libsvm_n_bad.argtypes = [c.c_void_p]
+        lib.smtpu_libsvm_copy.argtypes = [c.c_void_p] + [c.c_void_p] * 4
+        lib.smtpu_libsvm_free.argtypes = [c.c_void_p]
+        lib.smtpu_dump_rows.restype = c.c_int64
+        lib.smtpu_dump_rows.argtypes = [c.c_char_p, c.c_void_p, c.c_int64,
+                                        c.c_int64, c.c_void_p, c.c_void_p]
+        lib.smtpu_load_rows.restype = c.c_void_p
+        lib.smtpu_load_rows.argtypes = [c.c_char_p, c.c_int64, c.c_void_p]
+        lib.smtpu_text_n_rows.restype = c.c_int64
+        lib.smtpu_text_n_rows.argtypes = [c.c_void_p]
+        lib.smtpu_text_copy.argtypes = [c.c_void_p, c.c_void_p, c.c_void_p]
+        lib.smtpu_text_free.argtypes = [c.c_void_p]
         _lib = lib
         return _lib
 
@@ -159,22 +191,30 @@ class NativeCBOWBatcher:
             self._tokens.ctypes.data, self._offsets.ctypes.data,
             len(self._offsets) - 1, self.window, keep_ptr, seed)
 
-    def epoch(self, batch_size: int) -> Iterator[CBOWBatch]:
-        lib, W2 = self._lib, 2 * self.window
-        self._epoch_i += 1
-        lib.smtpu_batcher_reset(self._h, self._seed + self._epoch_i)
+    def _drain(self, batch_size: int, next_fn) -> Iterator[CBOWBatch]:
+        """Shared batch-yield loop: ``next_fn(centers, contexts, mask)``
+        fills one batch and returns n examples (0 = epoch done)."""
+        W2 = 2 * self.window
         while True:
             centers = np.zeros(batch_size, np.int32)
             contexts = np.zeros((batch_size, W2), np.int32)
             mask = np.zeros((batch_size, W2), np.uint8)
-            n = lib.smtpu_batcher_next(
-                self._h, batch_size, centers.ctypes.data,
-                contexts.ctypes.data, mask.ctypes.data)
+            n = next_fn(centers.ctypes.data, contexts.ctypes.data,
+                        mask.ctypes.data)
             if n == 0:
                 return
             yield CBOWBatch(centers, contexts, mask.astype(bool), int(n))
             if n < batch_size:
                 return
+
+    def epoch(self, batch_size: int) -> Iterator[CBOWBatch]:
+        lib = self._lib
+        self._epoch_i += 1
+        lib.smtpu_batcher_reset(self._h, self._seed + self._epoch_i)
+        yield from self._drain(
+            batch_size,
+            lambda c, x, m: lib.smtpu_batcher_next(
+                self._h, batch_size, c, x, m))
 
     def __del__(self):
         try:
@@ -183,3 +223,102 @@ class NativeCBOWBatcher:
                 self._h = None
         except Exception:
             pass
+
+
+class PrefetchingCBOWBatcher(NativeCBOWBatcher):
+    """NativeCBOWBatcher whose epochs run through the C++ prefetch
+    executor: a producer thread assembles batches into a bounded queue
+    while the device computes (the reference AsynExec/queue_with_capacity
+    machinery recast as input-pipeline overlap — loader.cpp)."""
+
+    def __init__(self, *args, depth: int = 4, **kwargs):
+        super().__init__(*args, **kwargs)
+        self.depth = int(depth)
+
+    def epoch(self, batch_size: int) -> Iterator[CBOWBatch]:
+        lib = self._lib
+        self._epoch_i += 1
+        p = lib.smtpu_prefetcher_new(self._h, batch_size, self.depth,
+                                     self._seed + self._epoch_i)
+        try:
+            yield from self._drain(
+                batch_size,
+                lambda c, x, m: lib.smtpu_prefetcher_next(p, c, x, m))
+        finally:
+            lib.smtpu_prefetcher_free(p)
+
+
+# ---- libSVM (io.cpp) ------------------------------------------------------
+
+def parse_libsvm_native(path: str):
+    """Whole-file CSR parse: (labels (N,), offsets (N+1,), feat_ids (nnz,),
+    feat_vals (nnz,)).  Labels are already mapped to {0,1}."""
+    lib = _load_lib()
+    if lib is None:
+        raise RuntimeError("native loader unavailable")
+    h = lib.smtpu_libsvm_parse(path.encode())
+    if not h:
+        raise FileNotFoundError(path)
+    try:
+        n_bad = lib.smtpu_libsvm_n_bad(h)
+        if n_bad:
+            raise ValueError(
+                f"{path}: {n_bad} malformed libSVM line(s) "
+                "(bad label or feature token)")
+        n = lib.smtpu_libsvm_n_rows(h)
+        nnz = lib.smtpu_libsvm_nnz(h)
+        labels = np.empty(n, np.float32)
+        offsets = np.empty(n + 1, np.int64)
+        ids = np.empty(nnz, np.uint64)
+        vals = np.empty(nnz, np.float32)
+        lib.smtpu_libsvm_copy(h, labels.ctypes.data, offsets.ctypes.data,
+                              ids.ctypes.data, vals.ctypes.data)
+    finally:
+        lib.smtpu_libsvm_free(h)
+    return labels, offsets, ids, vals
+
+
+# ---- text checkpoints (io.cpp) --------------------------------------------
+
+def dump_rows_native(path: str, keys: np.ndarray, fields) -> int:
+    """Write ``key\\tfield0\\tfield1...`` lines; ``fields`` is an ordered
+    list of (n, d) float32 arrays.  Returns rows written."""
+    lib = _load_lib()
+    if lib is None:
+        raise RuntimeError("native loader unavailable")
+    keys = np.ascontiguousarray(keys, np.uint64)
+    if len(keys) == 0:  # empty table: empty file, like the python writer
+        open(path, "w").close()
+        return 0
+    arrs = [np.ascontiguousarray(a, np.float32).reshape(len(keys), -1)
+            for a in fields]
+    dims = np.asarray([a.shape[1] for a in arrs], np.int64)
+    ptrs = (ctypes.c_void_p * len(arrs))(
+        *[a.ctypes.data for a in arrs])
+    n = lib.smtpu_dump_rows(path.encode(), keys.ctypes.data, len(keys),
+                            len(arrs), ptrs, dims.ctypes.data)
+    if n < 0:
+        raise OSError(f"cannot write {path}")
+    return int(n)
+
+
+def load_rows_native(path: str, dims):
+    """Read ``key\\tfield...`` lines where field j has ``dims[j]`` floats.
+    Returns (keys (N,), [(N, dims[j]) float32 arrays])."""
+    lib = _load_lib()
+    if lib is None:
+        raise RuntimeError("native loader unavailable")
+    dims = np.asarray(dims, np.int64)
+    h = lib.smtpu_load_rows(path.encode(), len(dims), dims.ctypes.data)
+    if not h:
+        raise FileNotFoundError(path)
+    try:
+        n = lib.smtpu_text_n_rows(h)
+        keys = np.empty(n, np.uint64)
+        arrs = [np.empty((n, int(d)), np.float32) for d in dims]
+        ptrs = (ctypes.c_void_p * len(arrs))(
+            *[a.ctypes.data for a in arrs])
+        lib.smtpu_text_copy(h, keys.ctypes.data, ptrs)
+    finally:
+        lib.smtpu_text_free(h)
+    return keys, arrs

@@ -48,13 +48,36 @@ def default_parser(fields) -> Parser:
 
 # -- text (reference-compatible) ------------------------------------------
 
+def _index_arrays(key_index):
+    n = len(key_index)
+    keys = np.empty(n, np.uint64)
+    slots = np.empty(n, np.int64)
+    for i, (k, s) in enumerate(key_index.items()):
+        keys[i] = k
+        slots[i] = s
+    return keys, slots
+
+
 def dump_table_text(table: SparseTable, path: str,
-                    formatter: Optional[Formatter] = None) -> int:
-    """Write ``key\\tvalue`` lines for every occupied row; returns count."""
-    formatter = formatter or default_formatter(table.access.pull_fields)
+                    formatter: Optional[Formatter] = None,
+                    fields: Optional[tuple] = None) -> int:
+    """Write ``key\\tvalue`` lines for every occupied row; returns count.
+
+    With no custom ``formatter`` the value layout is the ``fields`` order
+    (default: the access method's pull fields), each a space-joined float
+    vector, tab-separated — and the write runs through the native C++
+    writer (io.cpp smtpu_dump_rows) when available."""
+    fields = tuple(fields or table.access.pull_fields)
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    if formatter is None:
+        from swiftmpi_tpu.data import native
+        if native.available():
+            keys, slots = _index_arrays(table.key_index)
+            arrs = [np.asarray(table.state[f])[slots] for f in fields]
+            return native.dump_rows_native(path, keys, arrs)
+        formatter = default_formatter(fields)
     rows = {f: np.asarray(table.state[f]) for f in table.access.fields}
     n = 0
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
     with open(path, "w") as f:
         for key, slot in table.key_index.items():
             row = {name: arr[slot] for name, arr in rows.items()}
@@ -65,11 +88,39 @@ def dump_table_text(table: SparseTable, path: str,
 
 def load_table_text(table: SparseTable, path: str,
                     parser: Optional[Parser] = None,
-                    shard_filter: Optional[int] = None) -> int:
+                    shard_filter: Optional[int] = None,
+                    fields: Optional[tuple] = None) -> int:
     """Stream ``key\\tvalue`` lines into the table, creating slots lazily;
     with ``shard_filter`` keep only keys owned by that shard (the reference
-    per-server load filter, server.h:49-62).  Returns rows loaded."""
-    parser = parser or default_parser(table.access.pull_fields)
+    per-server load filter, server.h:49-62).  Returns rows loaded.
+
+    With no custom ``parser``, rows are fixed-layout ``fields`` float
+    vectors and parsing runs through the native C++ reader when
+    available."""
+    fields = tuple(fields or table.access.pull_fields)
+    if parser is None:
+        from swiftmpi_tpu.data import native
+        if native.available():
+            dims = [int(np.prod(
+                np.atleast_1d(table.access.fields[f].dim))) for f in fields]
+            key_arr, arrs = native.load_rows_native(path, dims)
+            if not len(key_arr):
+                return 0
+            if shard_filter is not None:
+                keep = table.key_index.shard_of(key_arr) == shard_filter
+                key_arr = key_arr[keep]
+                arrs = [a[keep] for a in arrs]
+                if not len(key_arr):
+                    return 0
+            idx = np.asarray(table.key_index.lookup(key_arr), np.int32)
+            state = dict(table.state)
+            for fname, block in zip(fields, arrs):
+                arr = np.asarray(state[fname]).copy()
+                arr[idx] = block.reshape(len(idx), -1)
+                state[fname] = _replace(table, fname, arr)
+            table.state = state
+            return len(key_arr)
+        parser = default_parser(fields)
     keys: list = []
     rests: list = []
     with open(path) as f:

@@ -28,14 +28,20 @@ import jax.numpy as jnp
 import numpy as np
 
 from swiftmpi_tpu.cluster.cluster import Cluster
-from swiftmpi_tpu.data.libsvm import (LibSVMBatch, iter_minibatches,
-                                      load_file)
+from swiftmpi_tpu.data.libsvm import (CSRData, LibSVMBatch, iter_minibatches,
+                                      load_data, load_file)  # noqa: F401
 from swiftmpi_tpu.io.checkpoint import (dump_table_text, load_table_text)
 from swiftmpi_tpu.parameter import lr_access
 from swiftmpi_tpu.utils.config import ConfigParser, global_config
 from swiftmpi_tpu.utils.logger import get_logger
 
 log = get_logger(__name__)
+
+
+def _max_feats(data) -> int:
+    if isinstance(data, CSRData):
+        return data.max_feats
+    return max(len(f) for _, f in data)
 
 
 def lr_formatter(row: Dict[str, np.ndarray]) -> str:
@@ -97,14 +103,15 @@ class LogisticRegression:
     # -- training (lr.cpp:157-240) ----------------------------------------
     def train(self, data, niters: int = 1,
               max_feats: Optional[int] = None) -> List[float]:
-        """``data``: path to a libSVM file or a pre-parsed instance list.
-        Returns per-iteration mean training error (reference logs
-        ``error: total/nrecords`` per iter, lr.cpp:231)."""
+        """``data``: path to a libSVM file, a pre-parsed instance list, or
+        ``CSRData`` (native parser output).  Returns per-iteration mean
+        training error (reference logs ``error: total/nrecords`` per iter,
+        lr.cpp:231)."""
         if isinstance(data, str):
-            data = load_file(data)
+            data = load_data(data)
         if self._step is None:
             self._step = self._build_step()
-        F = max_feats or max(len(f) for _, f in data)
+        F = max_feats or _max_feats(data)
         losses = []
         state = self.table.state
         for it in range(niters):
@@ -128,8 +135,8 @@ class LogisticRegression:
     # -- prediction (lr.cpp:240-295) --------------------------------------
     def predict(self, data, max_feats: Optional[int] = None) -> np.ndarray:
         if isinstance(data, str):
-            data = load_file(data)
-        F = max_feats or max(len(f) for _, f in data)
+            data = load_data(data)
+        F = max_feats or _max_feats(data)
         scores = []
         for batch in iter_minibatches(data, self.minibatch, F):
             slots = self.table.key_index.lookup(
@@ -147,14 +154,15 @@ class LogisticRegression:
         """Offline eval, the reference's tools/evaluate.py (26-line
         threshold-at-0.5 error rate)."""
         if isinstance(data, str):
-            data = load_file(data)
+            data = load_data(data)
         scores = self.predict(data)
-        targets = np.array([y for y, _ in data])
+        targets = (data.labels if isinstance(data, CSRData)
+                   else np.array([y for y, _ in data]))
         return float(((scores > 0.5) != (targets > 0.5)).mean())
 
     # -- checkpoint (lr.cpp:297-300; server.h:49-77) -----------------------
     def save(self, path: str) -> int:
-        return dump_table_text(self.table, path, formatter=lr_formatter)
+        return dump_table_text(self.table, path, fields=("val",))
 
     def load(self, path: str) -> int:
-        return load_table_text(self.table, path, parser=lr_parser)
+        return load_table_text(self.table, path, fields=("val",))

@@ -431,7 +431,9 @@ class Word2Vec:
 
     # -- embeddings out/in (word2vec.h:100-117; cluster.h:41-54) -----------
     def save(self, path: str) -> int:
-        return dump_table_text(self.table, path, formatter=w2v_formatter)
+        # reference WParam layout: v TAB h (word2vec.h:100-110); fields mode
+        # routes through the native C++ writer when available
+        return dump_table_text(self.table, path, fields=("v", "h"))
 
     def load(self, path: str) -> int:
         if self.table is None:
@@ -439,7 +441,7 @@ class Word2Vec:
                 raise RuntimeError("set capacity_per_shard before load()")
             self.table = self.cluster.create_table(
                 "w2v", self.access, self._capacity_per_shard)
-        return load_table_text(self.table, path, parser=w2v_parser)
+        return load_table_text(self.table, path, fields=("v", "h"))
 
     def embedding(self, key: int) -> Optional[np.ndarray]:
         """Input-side (v) vector for an external key, or None."""

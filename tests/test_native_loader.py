@@ -122,3 +122,166 @@ def test_native_batcher_trains_word2vec(devices8, corpus_file):
                          batcher=native.NativeCBOWBatcher(
                              tokens, offsets, vocab_c, window=2))
     assert len(losses) == 2
+
+
+# ---- prefetch executor ----------------------------------------------------
+
+def test_prefetcher_stream_matches_plain_batcher(corpus_file):
+    """Same seed => the prefetching epoch yields the identical batch
+    stream (FIFO queue preserves producer order)."""
+    path, _ = corpus_file
+    vocab_c, tokens, offsets = native.load_corpus_native(path)
+    plain = native.NativeCBOWBatcher(tokens, offsets, vocab_c, window=2,
+                                     seed=42)
+    pre = native.PrefetchingCBOWBatcher(tokens, offsets, vocab_c, window=2,
+                                        seed=42, depth=3)
+    a = list(plain.epoch(64))
+    b = list(pre.epoch(64))
+    assert len(a) == len(b) and len(a) > 1
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x.centers, y.centers)
+        np.testing.assert_array_equal(x.contexts, y.contexts)
+        np.testing.assert_array_equal(x.ctx_mask, y.ctx_mask)
+        assert x.n_words == y.n_words
+
+
+def test_prefetcher_early_abandon_no_hang(corpus_file):
+    """Dropping the epoch iterator mid-stream must cancel the producer
+    thread promptly (bounded queue would otherwise block it forever)."""
+    path, _ = corpus_file
+    vocab_c, tokens, offsets = native.load_corpus_native(path)
+    pre = native.PrefetchingCBOWBatcher(tokens, offsets, vocab_c, window=2,
+                                        depth=1)
+    it = pre.epoch(16)
+    next(it)
+    it.close()  # triggers finally -> smtpu_prefetcher_free -> join
+    # a fresh epoch still works after the abandoned one
+    assert sum(b.n_words for b in pre.epoch(64)) > 0
+
+
+# ---- native libSVM parser -------------------------------------------------
+
+def test_native_libsvm_matches_python(tmp_path):
+    from swiftmpi_tpu.data.libsvm import load_file, to_csr
+    p = tmp_path / "a9a.txt"
+    p.write_text(
+        "+1 3:1 11:0.5 14:-2\n"
+        "-1 1:2.5 7:1\n"
+        "\n"
+        "# a comment line\n"
+        "1 5:1 # trailing comment 9:9\n"
+        "-1 2:0.125\n")
+    labels, offsets, ids, vals = native.parse_libsvm_native(str(p))
+    csr = to_csr(load_file(str(p)))
+    np.testing.assert_array_equal(labels, csr.labels)
+    np.testing.assert_array_equal(offsets, csr.offsets)
+    np.testing.assert_array_equal(ids, csr.feat_ids)
+    np.testing.assert_allclose(vals, csr.feat_vals)
+    assert labels.tolist() == [1.0, 0.0, 1.0, 0.0]
+
+
+def test_native_libsvm_batches_match_python(tmp_path):
+    from swiftmpi_tpu.data.libsvm import (iter_minibatches, load_data,
+                                          load_file, synthetic_dataset)
+    data = synthetic_dataset(37, dim=50, nnz=6, seed=3)
+    p = tmp_path / "d.txt"
+    with open(p, "w") as f:
+        for y, feats in data:
+            f.write(f"{int(y)} " +
+                    " ".join(f"{k}:{v}" for k, v in feats) + "\n")
+    csr = load_data(str(p))
+    a = list(iter_minibatches(load_file(str(p)), 16))
+    b = list(iter_minibatches(csr, 16))
+    assert len(a) == len(b)
+    for x, y in zip(a, b):
+        np.testing.assert_allclose(x.targets, y.targets)
+        np.testing.assert_array_equal(x.feat_ids, y.feat_ids)
+        np.testing.assert_allclose(x.feat_vals, y.feat_vals, rtol=1e-6)
+        np.testing.assert_array_equal(x.mask, y.mask)
+
+
+# ---- native text checkpoint IO --------------------------------------------
+
+def test_native_text_dump_load_roundtrip(tmp_path, devices8):
+    from swiftmpi_tpu.cluster import ps_mesh, SHARD_AXIS
+    from swiftmpi_tpu.parameter import KeyIndex, SparseTable, w2v_access
+    from swiftmpi_tpu.io.checkpoint import dump_table_text, load_table_text
+    access = w2v_access(0.3, 8)
+    ki = KeyIndex(1, 64)
+    t = SparseTable(access, ki)
+    keys = np.arange(10, 30, dtype=np.uint64)
+    slots = ki.lookup(keys)
+    # give rows distinguishable values
+    import jax.numpy as jnp
+    state = dict(t.state)
+    v = np.asarray(state["v"]).copy()
+    v[slots] = np.arange(20 * 8, dtype=np.float32).reshape(20, 8) / 7
+    state["v"] = jnp.asarray(v)
+    t.state = state
+    path = str(tmp_path / "dump.txt")
+    n = dump_table_text(t, path, fields=("v", "h"))
+    assert n == 20
+    # native writer layout: key TAB v-vec TAB h-vec
+    parts = open(path).readline().split("\t")
+    assert len(parts) == 3 and len(parts[1].split()) == 8
+
+    t2 = SparseTable(access, KeyIndex(1, 64))
+    n2 = load_table_text(t2, path, fields=("v", "h"))
+    assert n2 == 20
+    for k in (10, 17, 29):
+        np.testing.assert_allclose(
+            np.asarray(t2.state["v"])[t2.key_index.slot(k)],
+            np.asarray(t.state["v"])[t.key_index.slot(k)], rtol=1e-6)
+
+
+def test_native_and_python_text_dumps_parse_identically(tmp_path, devices8):
+    """%.9g (native) and repr() (python) prints differ textually but must
+    round-trip to the same float32 rows."""
+    from swiftmpi_tpu.parameter import KeyIndex, SparseTable, lr_access
+    from swiftmpi_tpu.io.checkpoint import (default_formatter,
+                                            dump_table_text,
+                                            load_table_text)
+    access = lr_access(0.05)
+    t = SparseTable(access, KeyIndex(1, 32), seed=5)
+    t.key_index.lookup(np.arange(1, 9, dtype=np.uint64))
+    p_native = str(tmp_path / "n.txt")
+    p_python = str(tmp_path / "p.txt")
+    dump_table_text(t, p_native, fields=("val",))
+    dump_table_text(t, p_python, fields=("val",),
+                    formatter=default_formatter(("val",)))
+    t_n = SparseTable(access, KeyIndex(1, 32))
+    t_p = SparseTable(access, KeyIndex(1, 32))
+    load_table_text(t_n, p_native, fields=("val",))
+    load_table_text(t_p, p_python, fields=("val",))
+    for k in range(1, 9):
+        np.testing.assert_array_equal(
+            np.asarray(t_n.state["val"])[t_n.key_index.slot(k)],
+            np.asarray(t_p.state["val"])[t_p.key_index.slot(k)])
+
+
+def test_native_libsvm_edge_parity(tmp_path):
+    """Feature-less rows dropped in both paths; malformed lines raise in
+    both; empty-table dumps write an empty file."""
+    from swiftmpi_tpu.data.libsvm import load_file, to_csr
+    p = tmp_path / "edge.txt"
+    p.write_text("1\n-1 2:0.5\n")  # label-only row must be dropped
+    labels, offsets, ids, vals = native.parse_libsvm_native(str(p))
+    csr_py = to_csr(load_file(str(p)))
+    np.testing.assert_array_equal(labels, csr_py.labels)
+    assert len(labels) == 1
+
+    bad = tmp_path / "bad.txt"
+    bad.write_text("1 abc 3:1\n")
+    with pytest.raises(ValueError):
+        native.parse_libsvm_native(str(bad))
+    with pytest.raises(ValueError):
+        load_file(str(bad))
+
+
+def test_native_dump_empty_table(tmp_path, devices8):
+    from swiftmpi_tpu.parameter import KeyIndex, SparseTable, lr_access
+    from swiftmpi_tpu.io.checkpoint import dump_table_text
+    t = SparseTable(lr_access(0.05), KeyIndex(1, 16))
+    path = str(tmp_path / "empty.txt")
+    assert dump_table_text(t, path, fields=("val",)) == 0
+    assert open(path).read() == ""
