@@ -28,6 +28,10 @@ def main(argv=None) -> int:
     cmd.registerParameter("niters", "number of iterations")
     cmd.registerParameter("output", "path to output the embeddings")
     cmd.registerParameter("variant", "sync (int keys) | async (hashed keys)")
+    cmd.registerParameter("checkpoint",
+                          "checkpoint path: save every iteration and "
+                          "auto-resume if present (re-run the same "
+                          "command after a crash to continue)")
     if cmd.hasParameter("help") or not cmd.hasParameter("data"):
         cmd.print_help()
         return 0
@@ -44,6 +48,7 @@ def main(argv=None) -> int:
 
     model = Word2Vec()
     niters = int(cmd.getValue("niters", "1"))
+    corpus, batcher = None, None
     from swiftmpi_tpu.data import native
     if native.available():
         # C++ fast path end to end: vocab, corpus mapping, and batch
@@ -54,11 +59,27 @@ def main(argv=None) -> int:
         batcher = native.PrefetchingCBOWBatcher(
             tokens, offsets, vocab_c, model.window, model.sample)
         log.info("using native C++ loader (prefetching)")
-        losses = model.train(niters=niters, batcher=batcher)
+        model.build_from_vocab(vocab_c)
     else:
         corpus = load_corpus(cmd.getValue("data"), mode=mode,
                              min_sentence_length=model.min_sentence_length)
-        losses = model.train(corpus, niters=niters)
+        model.build(corpus)
+    if cmd.hasParameter("checkpoint"):
+        from swiftmpi_tpu.io.resilience import train_with_resume
+        losses = train_with_resume(
+            model, corpus, niters=niters,
+            checkpoint_path=cmd.getValue("checkpoint"),
+            checkpoint_every=1, batcher=batcher)
+        if not losses:
+            log.info("checkpoint already at %d iters; nothing to train",
+                     niters)
+            if cmd.hasParameter("output"):
+                n = model.save(cmd.getValue("output"))
+                log.info("wrote %d embeddings -> %s", n,
+                         cmd.getValue("output"))
+            return 0
+    else:
+        losses = model.train(corpus, niters=niters, batcher=batcher)
     log.info("final error: %.5f", losses[-1])
     if cmd.hasParameter("output"):
         n = model.save(cmd.getValue("output"))

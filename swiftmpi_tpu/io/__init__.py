@@ -1,8 +1,11 @@
-"""Checkpoint I/O: reference-compatible text dumps + binary resume."""
+"""Checkpoint I/O + resilience: text dumps, binary resume, elastic reshard."""
 
 from swiftmpi_tpu.io.checkpoint import (default_formatter, default_parser,
                                         dump_table_text, load_checkpoint,
                                         load_table_text, save_checkpoint)
+from swiftmpi_tpu.io.resilience import (load_checkpoint_elastic,
+                                        train_with_resume)
 
 __all__ = ["default_formatter", "default_parser", "dump_table_text",
-           "load_checkpoint", "load_table_text", "save_checkpoint"]
+           "load_checkpoint", "load_table_text", "save_checkpoint",
+           "load_checkpoint_elastic", "train_with_resume"]

@@ -1,0 +1,117 @@
+"""Failure recovery: elastic checkpoint reshard + auto-resuming training.
+
+The reference has none of this — its hashfrag header says "without
+Replication, Fault Tolerance and Repair" (`/root/reference/src/cluster/
+hashfrag.h:13`) and a dead node hangs the pull/push barrier forever
+(SURVEY.md §5).  On an SPMD TPU deployment the failure model is different: a
+chip/host failure kills the whole program, so recovery means *restart from
+checkpoint* — these utilities make that path first-class:
+
+* ``load_checkpoint_elastic`` — restore a full-fidelity npz checkpoint into
+  a table with a **different shard count / capacity** (scale the mesh up or
+  down between runs).  The strict ``load_checkpoint`` refuses mismatched
+  geometry because exact resume must be bit-stable; the elastic variant
+  re-keys every row through the new table's KeyIndex instead.
+* ``train_with_resume`` — wrap a model's train loop with
+  checkpoint-every-k-iterations and automatic reload-and-retry on failure
+  (bounded restarts), turning the mid-training checkpoints
+  (io/checkpoint.py) into actual fault tolerance.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict
+
+import numpy as np
+
+from swiftmpi_tpu.io.checkpoint import _replace, save_checkpoint
+from swiftmpi_tpu.parameter.sparse_table import SparseTable
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+
+def load_checkpoint_elastic(table: SparseTable, path: str
+                            ) -> Dict[str, np.ndarray]:
+    """Restore an npz checkpoint into a table whose shard geometry may
+    differ from the checkpoint's: every key is re-routed through the new
+    table's KeyIndex (new hashfrag, new slot ranges) and its row moved to
+    the new slot.  Optimizer state travels with the row, so training
+    continues exactly (up to row placement) after a mesh resize.
+
+    Returns the checkpoint's ``extra`` arrays (e.g. the iteration counter).
+    Raises ``CapacityError`` if the new geometry cannot hold all rows.
+    """
+    with np.load(path if path.endswith(".npz") else path + ".npz") as z:
+        keys = z["keys"]
+        old_slots = z["slots"]
+        new_slots = np.asarray(table.key_index.lookup(keys), np.int64)
+        state = dict(table.state)
+        for name in table.access.fields:
+            arr = np.asarray(state[name]).copy()
+            arr[new_slots] = z[f"field__{name}"][old_slots]
+            state[name] = _replace(table, name, arr)
+        table.state = state
+        log.info("elastic restore: %d rows re-keyed from %d-shard "
+                 "checkpoint into %d-shard table", len(keys),
+                 int(z["num_shards"]), table.key_index.num_shards)
+        return {k[len("extra__"):]: z[k] for k in z.files
+                if k.startswith("extra__")}
+
+
+def train_with_resume(model, data=None, niters: int = 1,
+                      checkpoint_path: str = "ckpt",
+                      checkpoint_every: int = 1,
+                      max_restarts: int = 2,
+                      batcher=None, **train_kwargs):
+    """Run ``model.train`` to ``niters`` total iterations with periodic
+    checkpoints, resuming from the latest checkpoint after a failure (up to
+    ``max_restarts`` times).  If a checkpoint already exists at
+    ``checkpoint_path``, training continues from it — so re-running the
+    same command after a crash (the SPMD failure model: the process dies)
+    also picks up where it left off.
+
+    The model must provide ``train(..., checkpoint_path, checkpoint_every)``
+    and ``resume(path) -> start_iter`` (Word2Vec does).  Returns the
+    concatenated per-iteration losses from the final successful run.
+    """
+    npz = checkpoint_path if checkpoint_path.endswith(".npz") \
+        else checkpoint_path + ".npz"
+    start = 0
+    if os.path.exists(npz):
+        start = int(model.resume(checkpoint_path))
+        log.info("found checkpoint %s at iter %d; continuing", npz, start)
+    elif getattr(model, "table", None) is not None:
+        # iter-0 snapshot: a crash before the first periodic checkpoint
+        # must rewind to the true initial state, not retrain on top of
+        # partially-updated rows
+        save_checkpoint(model.table, checkpoint_path,
+                        extra={"iter": np.int64(0)})
+    restarts = 0
+    losses = []
+    while True:
+        remaining = niters - start
+        if remaining <= 0:
+            return losses
+        try:
+            losses = model.train(
+                data, niters=remaining, checkpoint_path=checkpoint_path,
+                checkpoint_every=checkpoint_every, start_iter=start,
+                batcher=batcher, **train_kwargs)
+            return losses
+        except Exception as e:  # noqa: BLE001 — retry any training failure
+            restarts += 1
+            if restarts > max_restarts:
+                log.error("giving up after %d restarts: %s", max_restarts, e)
+                raise
+            if not os.path.exists(npz):
+                # no checkpoint to rewind to (table was not built before
+                # the crash) — retrying would train on mutated state
+                log.error("no checkpoint exists to rewind to; re-raising")
+                raise
+            start = int(model.resume(checkpoint_path))
+            log.warning("training failed (%s); restart %d/%d from iter %d",
+                        e, restarts, max_restarts, start)
+
+

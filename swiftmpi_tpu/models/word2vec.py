@@ -325,6 +325,7 @@ class Word2Vec:
               batch_size: Optional[int] = None,
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
+              start_iter: int = 0,
               batcher=None) -> List[float]:
         """``data``: corpus path or list of key-list sentences.  Returns
         per-iteration mean error (reference Error::norm per train_iter,
@@ -408,9 +409,12 @@ class Word2Vec:
             if checkpoint_path and (it + 1) % checkpoint_every == 0:
                 self.table.state = state
                 from swiftmpi_tpu.io.checkpoint import save_checkpoint
-                save_checkpoint(self.table, checkpoint_path,
-                                extra={"iter": np.int64(it + 1)})
-                log.info("checkpoint @ iter %d -> %s", it + 1,
+                # cumulative iteration: a resumed run must not rewind the
+                # counter, or a later resume re-trains finished iters
+                save_checkpoint(
+                    self.table, checkpoint_path,
+                    extra={"iter": np.int64(start_iter + it + 1)})
+                log.info("checkpoint @ iter %d -> %s", start_iter + it + 1,
                          checkpoint_path)
         self.table.state = state
         return losses

@@ -1,0 +1,84 @@
+"""Device health probing — the failure-*detection* half of resilience.
+
+The reference cannot detect a dead peer at all: a node death hangs the
+pull/push StateBarrier forever (SURVEY.md §5; utils/Barrier.h:90-101 has an
+unused timeout hook).  Here detection is explicit and bounded: each device
+runs a tiny round-trip computation under a deadline; a device that errors
+or exceeds the deadline is reported unhealthy, and the caller decides
+(typically: restart from checkpoint via io.resilience on a healthy mesh).
+"""
+
+from __future__ import annotations
+
+import threading
+from dataclasses import dataclass
+from typing import List, Optional
+
+import numpy as np
+
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class DeviceHealth:
+    device: str
+    ok: bool
+    latency_s: float
+    error: Optional[str] = None
+
+
+def _probe(device) -> float:
+    import jax
+    import jax.numpy as jnp
+    import time
+    x = np.arange(256, dtype=np.float32).reshape(16, 16)
+    t0 = time.perf_counter()
+    y = jax.device_put(x, device)
+    z = jnp.dot(y, y).sum()
+    z.block_until_ready()
+    if not np.isfinite(float(z)):
+        raise RuntimeError("non-finite probe result")
+    return time.perf_counter() - t0
+
+
+def check_devices(devices=None, timeout_s: float = 30.0
+                  ) -> List[DeviceHealth]:
+    """Round-trip a small matmul on every device with a deadline.  Probes
+    run on daemon threads so a hung device is truly abandoned after
+    ``timeout_s`` — it neither blocks this call nor interpreter exit (the
+    process is presumed about to restart from checkpoint anyway)."""
+    import jax
+    devices = list(devices if devices is not None else jax.devices())
+    if not devices:
+        return []
+    results: List[Optional[DeviceHealth]] = [None] * len(devices)
+    threads = []
+    for i, d in enumerate(devices):
+        def probe_one(i=i, d=d):
+            try:
+                dt = _probe(d)
+                results[i] = DeviceHealth(str(d), True, dt)
+            except Exception as e:  # noqa: BLE001 — any failure = unhealthy
+                results[i] = DeviceHealth(str(d), False, 0.0, repr(e))
+        t = threading.Thread(target=probe_one, daemon=True,
+                             name=f"health-probe-{i}")
+        t.start()
+        threads.append(t)
+    import time
+    t_end = time.monotonic() + timeout_s  # one wall clock for all joins
+    for t in threads:
+        t.join(max(0.0, t_end - time.monotonic()))
+    out = [r if r is not None
+           else DeviceHealth(str(d), False, timeout_s, "probe timed out")
+           for r, d in zip(results, devices)]
+    bad = [h for h in out if not h.ok]
+    if bad:
+        log.warning("unhealthy devices: %s",
+                    [(h.device, h.error) for h in bad])
+    return out
+
+
+def all_healthy(devices=None, timeout_s: float = 30.0) -> bool:
+    return all(h.ok for h in check_devices(devices, timeout_s))

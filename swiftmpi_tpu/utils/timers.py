@@ -94,6 +94,21 @@ class Metrics:
         with self._lock:
             return dict(self._values)
 
+    def to_json(self) -> str:
+        import json
+        return json.dumps(self.snapshot(), sort_keys=True)
+
+    def dump(self, path: str) -> None:
+        """Structured metrics export (one JSON object), for scraping by
+        external monitors — the observability surface the reference's
+        log-line-only story lacks.  Written atomically (temp + rename) so
+        a concurrent scrape never sees a partial document."""
+        import os
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            f.write(self.to_json() + "\n")
+        os.replace(tmp, path)
+
 
 _GLOBAL_METRICS = Metrics()
 
