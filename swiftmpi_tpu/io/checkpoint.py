@@ -170,6 +170,12 @@ def _replace(table: SparseTable, fname: str, arr: np.ndarray):
 
 # -- binary (full fidelity, mid-training) ----------------------------------
 
+def npz_path(path: str) -> str:
+    """Canonical on-disk name for a binary checkpoint (np.savez appends
+    .npz itself; every reader/writer must agree on the same name)."""
+    return path if path.endswith(".npz") else path + ".npz"
+
+
 def save_checkpoint(table: SparseTable, path: str,
                     extra: Optional[Dict[str, np.ndarray]] = None) -> None:
     """npz with all fields (incl. optimizer state), the key index, and any
@@ -187,14 +193,19 @@ def save_checkpoint(table: SparseTable, path: str,
         table.key_index.capacity_per_shard)
     for k, v in (extra or {}).items():
         payload[f"extra__{k}"] = np.asarray(v)
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    np.savez(path, **payload)
+    # atomic: a crash mid-write must never clobber the last good
+    # checkpoint (it is the only thing auto-resume can rewind to)
+    dst = npz_path(path)
+    os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
+    tmp = dst + ".tmp.npz"
+    np.savez(tmp, **payload)
+    os.replace(tmp, dst)
 
 
 def load_checkpoint(table: SparseTable, path: str) -> Dict[str, np.ndarray]:
     """Restore table state + key index from ``save_checkpoint`` output;
     returns the ``extra`` arrays."""
-    with np.load(path if path.endswith(".npz") else path + ".npz") as z:
+    with np.load(npz_path(path)) as z:
         if int(z["num_shards"]) != table.key_index.num_shards:
             raise ValueError(
                 f"checkpoint has {int(z['num_shards'])} shards, table has "

@@ -25,7 +25,7 @@ from typing import Dict
 
 import numpy as np
 
-from swiftmpi_tpu.io.checkpoint import _replace, save_checkpoint
+from swiftmpi_tpu.io.checkpoint import _replace, npz_path, save_checkpoint
 from swiftmpi_tpu.parameter.sparse_table import SparseTable
 from swiftmpi_tpu.utils.logger import get_logger
 
@@ -43,7 +43,7 @@ def load_checkpoint_elastic(table: SparseTable, path: str
     Returns the checkpoint's ``extra`` arrays (e.g. the iteration counter).
     Raises ``CapacityError`` if the new geometry cannot hold all rows.
     """
-    with np.load(path if path.endswith(".npz") else path + ".npz") as z:
+    with np.load(npz_path(path)) as z:
         keys = z["keys"]
         old_slots = z["slots"]
         new_slots = np.asarray(table.key_index.lookup(keys), np.int64)
@@ -74,10 +74,11 @@ def train_with_resume(model, data=None, niters: int = 1,
 
     The model must provide ``train(..., checkpoint_path, checkpoint_every)``
     and ``resume(path) -> start_iter`` (Word2Vec does).  Returns the
-    concatenated per-iteration losses from the final successful run.
+    per-iteration losses of the final successful ``train`` call, i.e. of
+    iterations ``start..niters`` (failed attempts' partial losses are lost
+    with the exception; a resumed run reports only the iterations it ran).
     """
-    npz = checkpoint_path if checkpoint_path.endswith(".npz") \
-        else checkpoint_path + ".npz"
+    npz = npz_path(checkpoint_path)
     start = 0
     if os.path.exists(npz):
         start = int(model.resume(checkpoint_path))

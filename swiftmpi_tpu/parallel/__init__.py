@@ -1,13 +1,22 @@
-"""Parallelism primitives: collectives and context-parallel attention."""
+"""Parallelism primitives: collectives, context/pipeline/expert parallel."""
 
 from swiftmpi_tpu.parallel.collectives import (all_gather, all_to_all,
                                                axis_index, axis_size, pmean,
                                                psum, reduce_scatter,
                                                ring_permute)
+from swiftmpi_tpu.parallel.moe import (EXPERT_AXIS, MoEParams,
+                                       init_moe_params, moe_ffn,
+                                       moe_ffn_reference)
+from swiftmpi_tpu.parallel.pipeline import (STAGE_AXIS, pipeline_apply,
+                                            pipeline_loss,
+                                            stack_stage_params)
 from swiftmpi_tpu.parallel.ring_attention import (SEQ_AXIS, full_attention,
                                                   ring_attention,
                                                   ulysses_attention)
 
 __all__ = ["all_gather", "all_to_all", "axis_index", "axis_size", "pmean",
            "psum", "reduce_scatter", "ring_permute", "SEQ_AXIS",
-           "full_attention", "ring_attention", "ulysses_attention"]
+           "full_attention", "ring_attention", "ulysses_attention",
+           "STAGE_AXIS", "pipeline_apply", "pipeline_loss",
+           "stack_stage_params", "EXPERT_AXIS", "MoEParams",
+           "init_moe_params", "moe_ffn", "moe_ffn_reference"]
