@@ -197,9 +197,13 @@ def save_checkpoint(table: SparseTable, path: str,
     # checkpoint (it is the only thing auto-resume can rewind to)
     dst = npz_path(path)
     os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
-    tmp = dst + ".tmp.npz"
-    np.savez(tmp, **payload)
-    os.replace(tmp, dst)
+    tmp = f"{dst}.{os.getpid()}.tmp.npz"   # unique per writer
+    try:
+        np.savez(tmp, **payload)
+        os.replace(tmp, dst)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def load_checkpoint(table: SparseTable, path: str) -> Dict[str, np.ndarray]:

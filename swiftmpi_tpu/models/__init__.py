@@ -1,12 +1,20 @@
-"""Model families: the reference's three apps, TPU-native.
+"""Model families: the reference's three apps, TPU-native, plus the
+transformer LM that exercises the long-context / multi-axis parallelism.
 
 logistic (lr.cpp), word2vec sync+async (word2vec.h / word2vec_global.h),
-sent2vec (sent2vec.cpp).
+sent2vec (sent2vec.cpp); transformer is new surface (no reference
+counterpart — SURVEY.md §2.7).
 """
 
 from swiftmpi_tpu.models.logistic import LogisticRegression
 from swiftmpi_tpu.models.word2vec import Word2Vec
 from swiftmpi_tpu.models.sent2vec import Sent2Vec, build_word_model_from_dump
+from swiftmpi_tpu.models.transformer import (TransformerConfig, forward,
+                                             forward_pipelined, init_params,
+                                             lm_loss, param_shardings,
+                                             sgd_step)
 
 __all__ = ["LogisticRegression", "Word2Vec", "Sent2Vec",
-           "build_word_model_from_dump"]
+           "build_word_model_from_dump", "TransformerConfig", "forward",
+           "forward_pipelined", "init_params", "lm_loss",
+           "param_shardings", "sgd_step"]

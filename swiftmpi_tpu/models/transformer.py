@@ -1,0 +1,259 @@
+"""Decoder-only transformer LM: the long-context / multi-axis model family.
+
+The reference stops at shallow embedding models (LR, word2vec, sent2vec —
+SURVEY.md §2.5); this model exists so every parallelism axis the framework
+provides is exercised by a real trainable model, the way a modern user of
+the framework would compose them:
+
+* **dp**   — batch sharded over ``data``; gradient combine is implicit in
+  GSPMD (jit over global arrays inserts the psums).
+* **tp**   — Megatron-style tensor parallelism via sharding *annotations*
+  (``param_shardings``): attention heads and the FFN hidden dim shard over
+  ``model``; XLA/GSPMD inserts the all-reduces.  No hand-written
+  collectives — the idiomatic TPU expression of TP.
+* **sp/cp** — attention runs as ``ring_attention`` / ``ulysses_attention``
+  over a ``seq`` axis (parallel/ring_attention.py) for sequences that
+  don't fit one chip.
+* **pp**   — the block trunk is homogeneous, so it drops into
+  ``pipeline_apply`` over a ``stage`` axis (parallel/pipeline.py).
+* **ep**   — the FFN can be a routed mixture-of-experts over an ``expert``
+  axis (parallel/moe.py).
+
+Architecture: pre-RMSNorm, RoPE positions, causal multi-head attention,
+SiLU-gated or MoE FFN, weight-tied output head.  bfloat16-friendly: all
+matmuls are MXU-shaped; norms/softmax accumulate in f32.
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from swiftmpi_tpu.parallel.moe import (MoEParams, init_moe_params, moe_ffn,
+                                       moe_ffn_reference)
+from swiftmpi_tpu.parallel.pipeline import (pipeline_apply,
+                                            stack_stage_params)
+from swiftmpi_tpu.parallel.ring_attention import (full_attention,
+                                                  ring_attention,
+                                                  ulysses_attention)
+
+
+@dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int
+    d_model: int = 128
+    n_layers: int = 2
+    n_heads: int = 4
+    d_ff: int = 512
+    max_seq: int = 2048
+    attention: str = "full"          # full | ring | ulysses
+    n_experts: int = 0               # 0 => dense SiLU-gated FFN
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    rope_base: float = 10_000.0
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+
+# -- params ----------------------------------------------------------------
+
+def init_params(key, cfg: TransformerConfig) -> Dict[str, Any]:
+    """Block params are stacked on a leading (n_layers) axis — the layout
+    both ``lax.scan`` over layers and ``pipeline_apply`` want."""
+    k_emb, k_blk = jax.random.split(key)
+    s = 1.0 / math.sqrt(cfg.d_model)
+
+    def one_block(k):
+        ks = jax.random.split(k, 7)
+        d, h = cfg.d_model, cfg.d_ff
+        blk = {
+            "ln1": jnp.ones((d,), cfg.dtype),
+            "ln2": jnp.ones((d,), cfg.dtype),
+            "wq": jax.random.normal(ks[0], (d, d), cfg.dtype) * s,
+            "wk": jax.random.normal(ks[1], (d, d), cfg.dtype) * s,
+            "wv": jax.random.normal(ks[2], (d, d), cfg.dtype) * s,
+            "wo": jax.random.normal(ks[3], (d, d), cfg.dtype) * s,
+        }
+        if cfg.n_experts:
+            blk["moe"] = init_moe_params(ks[4], d, h, cfg.n_experts,
+                                         cfg.dtype)
+        else:
+            blk["w_gate"] = jax.random.normal(ks[4], (d, h), cfg.dtype) * s
+            blk["w_up"] = jax.random.normal(ks[5], (d, h), cfg.dtype) * s
+            blk["w_down"] = (jax.random.normal(ks[6], (h, d), cfg.dtype)
+                             / math.sqrt(h))
+        return blk
+
+    blocks = [one_block(k) for k in jax.random.split(k_blk, cfg.n_layers)]
+    return {
+        "embed": jax.random.normal(
+            k_emb, (cfg.vocab_size, cfg.d_model), cfg.dtype) * s,
+        "blocks": stack_stage_params(blocks),
+        "ln_f": jnp.ones((cfg.d_model,), cfg.dtype),
+    }
+
+
+def param_shardings(params, cfg: TransformerConfig, mesh: Mesh,
+                    *, model_axis: str = "model",
+                    data_axis: str = "data") -> Any:
+    """Megatron-style TP as GSPMD annotations: FFN hidden dim and QKV/O
+    head dim shard over ``model_axis``; embeddings shard rows over it.
+    Returns a NamedSharding pytree matching ``params``."""
+    del data_axis  # params are never dp-sharded; activations are
+
+    def spec(path: str, leaf) -> P:
+        if path in ("wq", "wk", "wv", "w_gate", "w_up"):
+            return P(None, None, model_axis)      # (L, d, d|dff) col-shard
+        if path in ("wo", "w_down"):
+            return P(None, model_axis, None)      # (L, dff|d, d) row-shard
+        if path == "w_in":
+            return P(None, None, None, model_axis)   # (L, E, d, dff)
+        if path == "w_out":
+            return P(None, None, model_axis, None)   # (L, E, dff, d)
+        if path == "embed":
+            return P(model_axis, None)
+        return P()
+
+    def walk(tree, name=""):
+        if isinstance(tree, MoEParams):
+            return MoEParams(*(walk(v, f) for f, v in
+                               zip(tree._fields, tree)))
+        if isinstance(tree, dict):
+            return {k: walk(v, k) for k, v in tree.items()}
+        return NamedSharding(mesh, spec(name, tree))
+
+    return walk(params)
+
+
+# -- forward ---------------------------------------------------------------
+
+def _rms_norm(x, g, eps=1e-6):
+    x32 = x.astype(jnp.float32)
+    r = jax.lax.rsqrt(jnp.mean(x32 * x32, -1, keepdims=True) + eps)
+    return (x32 * r).astype(x.dtype) * g
+
+
+def _rope(x, base: float):
+    """(B, S, H, D) rotary position embedding."""
+    B, S, H, D = x.shape
+    half = D // 2
+    freqs = base ** (-jnp.arange(half, dtype=jnp.float32) / half)
+    ang = jnp.arange(S, dtype=jnp.float32)[:, None] * freqs[None]  # (S, h)
+    cos, sin = jnp.cos(ang), jnp.sin(ang)
+    x1, x2 = x[..., :half], x[..., half:]
+    rot1 = x1 * cos[None, :, None] - x2 * sin[None, :, None]
+    rot2 = x2 * cos[None, :, None] + x1 * sin[None, :, None]
+    return jnp.concatenate([rot1, rot2], -1).astype(x.dtype)
+
+
+def _attention(blk, x, cfg: TransformerConfig, mesh: Optional[Mesh],
+               seq_axis: str):
+    B, S, d = x.shape
+    H, Dh = cfg.n_heads, cfg.head_dim
+    h = _rms_norm(x, blk["ln1"])
+    q = (h @ blk["wq"]).reshape(B, S, H, Dh)
+    k = (h @ blk["wk"]).reshape(B, S, H, Dh)
+    v = (h @ blk["wv"]).reshape(B, S, H, Dh)
+    q, k = _rope(q, cfg.rope_base), _rope(k, cfg.rope_base)
+    if cfg.attention == "ring" and mesh is not None:
+        o = ring_attention(q, k, v, mesh, axis=seq_axis, causal=True)
+    elif cfg.attention == "ulysses" and mesh is not None:
+        o = ulysses_attention(q, k, v, mesh, axis=seq_axis, causal=True)
+    else:
+        o = full_attention(q, k, v, causal=True)
+    return x + o.reshape(B, S, d) @ blk["wo"]
+
+
+def _ffn(blk, x, cfg: TransformerConfig, mesh: Optional[Mesh],
+         expert_axis: str):
+    B, S, d = x.shape
+    h = _rms_norm(x, blk["ln2"])
+    if cfg.n_experts:
+        tokens = h.reshape(B * S, d)
+        if mesh is not None and expert_axis in mesh.axis_names:
+            y, aux = moe_ffn(blk["moe"], tokens, mesh, axis=expert_axis,
+                             k=cfg.moe_top_k,
+                             capacity_factor=cfg.moe_capacity_factor)
+        else:
+            y, aux = moe_ffn_reference(blk["moe"], tokens, k=cfg.moe_top_k)
+        return x + y.reshape(B, S, d), aux
+    y = (jax.nn.silu(h @ blk["w_gate"]) * (h @ blk["w_up"])) @ blk["w_down"]
+    return x + y, jnp.float32(0.0)
+
+
+def block_apply(blk, x, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+                *, seq_axis: str = "seq", expert_axis: str = "expert"):
+    x = _attention(blk, x, cfg, mesh, seq_axis)
+    return _ffn(blk, x, cfg, mesh, expert_axis)
+
+
+def forward(params, tokens, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None, *, seq_axis: str = "seq",
+            expert_axis: str = "expert"):
+    """tokens (B, S) int32 -> (logits (B, S, V), aux_loss)."""
+    x = params["embed"][tokens]
+    aux = jnp.float32(0.0)
+    for i in range(cfg.n_layers):
+        blk = jax.tree.map(lambda p: p[i], params["blocks"])
+        x, a = block_apply(blk, x, cfg, mesh, seq_axis=seq_axis,
+                           expert_axis=expert_axis)
+        aux = aux + a
+    x = _rms_norm(x, params["ln_f"])
+    return x @ params["embed"].T, aux
+
+
+def forward_pipelined(params, tokens, cfg: TransformerConfig, mesh: Mesh,
+                      *, stage_axis: str = "stage",
+                      num_microbatches: int = 4):
+    """Same function, trunk run as a stage pipeline over ``stage_axis``
+    (one block per stage: n_layers must equal the axis size).  Embed and
+    head stay outside the pipelined trunk (homogeneous-activation rule).
+    Dense-FFN, local attention — the pipeline composes with dp, not with
+    the collective attention variants (one shard_map at a time)."""
+    if cfg.n_experts or cfg.attention != "full":
+        raise ValueError("pipelined trunk requires full attention and "
+                         "dense FFN (nested shard_map is not supported)")
+    x = params["embed"][tokens]
+
+    def stage_fn(blk, act):
+        out, _ = block_apply(blk, act, cfg, None)
+        return out
+
+    x = pipeline_apply(stage_fn, params["blocks"], x, mesh,
+                       axis=stage_axis, num_microbatches=num_microbatches)
+    x = _rms_norm(x, params["ln_f"])
+    return x @ params["embed"].T, jnp.float32(0.0)
+
+
+# -- training --------------------------------------------------------------
+
+def lm_loss(params, tokens, cfg: TransformerConfig,
+            mesh: Optional[Mesh] = None, aux_weight: float = 0.01,
+            **fwd_kwargs):
+    """Next-token cross entropy (+ weighted MoE aux)."""
+    logits, aux = forward(params, tokens[:, :-1], cfg, mesh, **fwd_kwargs)
+    targets = tokens[:, 1:]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+    nll = -jnp.take_along_axis(logp, targets[..., None], -1).mean()
+    return nll + aux_weight * aux
+
+
+@partial(jax.jit, static_argnames=("cfg", "lr"), donate_argnums=0)
+def sgd_step(params, tokens, cfg: TransformerConfig, lr: float = 0.1):
+    """One SGD training step.  Under a mesh, dp/tp come from the shardings
+    of ``params``/``tokens`` (GSPMD inserts the collectives); no
+    parallelism code appears here at all — the point of the design."""
+    loss, grads = jax.value_and_grad(lm_loss)(params, tokens, cfg)
+    new = jax.tree.map(lambda p, g: p - lr * g.astype(p.dtype),
+                       params, grads)
+    return new, loss

@@ -108,6 +108,8 @@ def moe_ffn(params: MoEParams, x: jax.Array, mesh: Mesh, *,
     if E % n:
         raise ValueError(f"experts={E} must divide over axis size {n}")
     T = x.shape[0]
+    if T % n:
+        raise ValueError(f"tokens={T} must divide over axis size {n}")
     t_local = T // n
     capacity = max(1, int(math.ceil(t_local * k / E * capacity_factor)))
 
