@@ -18,7 +18,9 @@ and back; models provide reference-compatible ones.
 
 from __future__ import annotations
 
+import glob
 import os
+import time
 from typing import Callable, Dict, Optional
 
 import numpy as np
@@ -170,6 +172,11 @@ def _replace(table: SparseTable, fname: str, arr: np.ndarray):
 
 # -- binary (full fidelity, mid-training) ----------------------------------
 
+# orphaned tmp files older than this are swept on the next save; younger
+# ones may belong to a concurrent writer mid-savez and must be left alone
+_TMP_SWEEP_AGE_S = 300.0
+
+
 def npz_path(path: str) -> str:
     """Canonical on-disk name for a binary checkpoint (np.savez appends
     .npz itself; every reader/writer must agree on the same name)."""
@@ -198,6 +205,18 @@ def save_checkpoint(table: SparseTable, path: str,
     dst = npz_path(path)
     os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
     tmp = f"{dst}.{os.getpid()}.tmp.npz"   # unique per writer
+    # a writer killed between savez and replace (OOM/SIGKILL skips the
+    # finally) leaves its pid-suffixed tmp behind forever; sweep stale
+    # ones, but never a concurrent writer's in-progress file (age guard)
+    now = time.time()
+    for stale in glob.glob(glob.escape(dst) + ".*.tmp.npz"):
+        if stale == tmp:
+            continue
+        try:
+            if now - os.path.getmtime(stale) > _TMP_SWEEP_AGE_S:
+                os.unlink(stale)
+        except OSError:
+            pass
     try:
         np.savez(tmp, **payload)
         os.replace(tmp, dst)

@@ -165,9 +165,12 @@ def _attention(blk, x, cfg: TransformerConfig, mesh: Optional[Mesh],
     k = (h @ blk["wk"]).reshape(B, S, H, Dh)
     v = (h @ blk["wv"]).reshape(B, S, H, Dh)
     q, k = _rope(q, cfg.rope_base), _rope(k, cfg.rope_base)
-    if cfg.attention == "ring" and mesh is not None:
+    # like _ffn: the collective variants need their axis on the mesh;
+    # otherwise fall back to the numerically identical local computation
+    has_seq = mesh is not None and seq_axis in mesh.axis_names
+    if cfg.attention == "ring" and has_seq:
         o = ring_attention(q, k, v, mesh, axis=seq_axis, causal=True)
-    elif cfg.attention == "ulysses" and mesh is not None:
+    elif cfg.attention == "ulysses" and has_seq:
         o = ulysses_attention(q, k, v, mesh, axis=seq_axis, causal=True)
     else:
         o = full_attention(q, k, v, causal=True)
@@ -202,12 +205,17 @@ def forward(params, tokens, cfg: TransformerConfig,
             expert_axis: str = "expert"):
     """tokens (B, S) int32 -> (logits (B, S, V), aux_loss)."""
     x = params["embed"][tokens]
-    aux = jnp.float32(0.0)
-    for i in range(cfg.n_layers):
-        blk = jax.tree.map(lambda p: p[i], params["blocks"])
+
+    # one compiled block body regardless of depth: scan over the stacked
+    # (n_layers, ...) params instead of unrolling n_layers copies
+    def body(carry, blk):
+        x, aux = carry
         x, a = block_apply(blk, x, cfg, mesh, seq_axis=seq_axis,
                            expert_axis=expert_axis)
-        aux = aux + a
+        return (x, aux + a), None
+
+    (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
+                               params["blocks"])
     x = _rms_norm(x, params["ln_f"])
     return x @ params["embed"].T, aux
 

@@ -69,6 +69,32 @@ def test_binary_checkpoint_resume_exact(tmp_path):
                                       np.asarray(table2.state[f]))
 
 
+def test_binary_checkpoint_sweeps_stale_tmp(tmp_path):
+    """A writer killed between savez and replace leaves its pid-suffixed
+    tmp behind; the next save must sweep old orphans but never touch a
+    concurrent writer's fresh in-progress file."""
+    import os
+    import time
+
+    from swiftmpi_tpu.io.checkpoint import npz_path
+
+    table, _ = make_table()
+    path = str(tmp_path / "ckpt")
+    dst = npz_path(path)
+    os.makedirs(tmp_path, exist_ok=True)
+    orphan = f"{dst}.99998.tmp.npz"
+    fresh = f"{dst}.99999.tmp.npz"
+    for p in (orphan, fresh):
+        with open(p, "w") as f:
+            f.write("partial write")
+    old = time.time() - 3600
+    os.utime(orphan, (old, old))
+    save_checkpoint(table, path)
+    assert not os.path.exists(orphan)      # aged orphan swept
+    assert os.path.exists(fresh)           # live writer's file untouched
+    assert os.path.exists(dst)
+
+
 def test_binary_checkpoint_shape_mismatch(tmp_path):
     table, _ = make_table()
     path = str(tmp_path / "ckpt")

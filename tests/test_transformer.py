@@ -1,5 +1,7 @@
 """Transformer LM: every parallel axis against the single-device golden."""
 
+import dataclasses
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -57,8 +59,7 @@ class TestParallelParity:
         want, _ = tfm.forward(params, tokens, CFG)
         for mode, n in (("ring", 8), ("ulysses", 4)):  # ulysses: H % n == 0
             mesh = Mesh(np.array(devices8[:n]), (SEQ_AXIS,))
-            cfg = tfm.TransformerConfig(**{**CFG.__dict__,
-                                           "attention": mode})
+            cfg = dataclasses.replace(CFG, attention=mode)
             got, _ = tfm.forward(params, tokens, cfg, mesh)
             np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                        rtol=2e-4, atol=2e-5,
@@ -80,6 +81,12 @@ class TestParallelParity:
         params, tokens = _toy(cfg)
         with pytest.raises(ValueError, match="pipelined trunk"):
             tfm.forward_pipelined(params, tokens, cfg, mesh)
+        cfg_ring = tfm.TransformerConfig(vocab_size=64, d_model=32,
+                                         n_layers=2, n_heads=4, d_ff=64,
+                                         attention="ring")
+        params, tokens = _toy(cfg_ring)
+        with pytest.raises(ValueError, match="pipelined trunk"):
+            tfm.forward_pipelined(params, tokens, cfg_ring, mesh)
 
     def test_expert_parallel_moe_matches_reference(self, devices8):
         cfg = tfm.TransformerConfig(vocab_size=64, d_model=32, n_layers=2,
