@@ -6,6 +6,9 @@ orchestrator itself (bring-up/finalize around a training run) lives in
 cluster.py and composes mesh + hashfrag + parameter tables.
 """
 
+from swiftmpi_tpu.cluster.bootstrap import (barrier, init_distributed,
+                                            process_count, process_index,
+                                            shutdown_distributed)
 from swiftmpi_tpu.cluster.mesh import (DATA_AXIS, MODEL_AXIS, SHARD_AXIS,
                                        MeshSpec, batch_sharded, build_mesh,
                                        mesh_info, ps_mesh, replicated,
@@ -15,7 +18,8 @@ from swiftmpi_tpu.cluster.hashfrag import HashFrag
 __all__ = [
     "DATA_AXIS", "MODEL_AXIS", "SHARD_AXIS", "MeshSpec", "batch_sharded",
     "build_mesh", "mesh_info", "ps_mesh", "replicated", "row_sharded",
-    "HashFrag", "Cluster",
+    "HashFrag", "Cluster", "barrier", "init_distributed", "process_count",
+    "process_index", "shutdown_distributed",
 ]
 
 

@@ -1,0 +1,159 @@
+"""Multi-process bring-up: the reference's MPI control plane, TPU-native.
+
+The reference boots with ``MPI_Init`` and uses MPI only as a control plane —
+rank/size, an IP-table allgather so every rank can address every other, and
+barriers (`/root/reference/src/utils/mpi.h:11-53`); processes are started by
+``mpirun -np N -hostfile hosts``
+(`/root/reference/src/apps/word2vec/cluster_run.sh:2`).
+
+Here the control plane is ``jax.distributed``: a coordinator service is the
+rendezvous (no IP-table exchange — the runtime shares device topology),
+``jax.process_index()/process_count()`` replace rank/size, and
+``sync_global_devices`` replaces ``MPI_Barrier``.  The data plane needs no
+addressing at all: after initialization every process sees the *global*
+device set, a ``Mesh`` spans it, and XLA compiles collectives onto ICI
+within a slice and DCN across hosts.
+
+Process launch is the scheduler's job (GKE/xmanager on real pods — they set
+the coordinator env); for single-host development and CI,
+``python -m swiftmpi_tpu.launch -np N -- prog args...`` is the mpirun
+equivalent (see swiftmpi_tpu/launch.py).
+
+Environment contract (set by the launcher or the pod scheduler):
+
+* ``SMTPU_COORDINATOR``    — ``host:port`` of process 0's coordinator.
+* ``SMTPU_NUM_PROCESSES``  — world size.
+* ``SMTPU_PROCESS_ID``     — this process's rank.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from swiftmpi_tpu.utils.config import ConfigParser
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+ENV_COORDINATOR = "SMTPU_COORDINATOR"
+ENV_NUM_PROCESSES = "SMTPU_NUM_PROCESSES"
+ENV_PROCESS_ID = "SMTPU_PROCESS_ID"
+
+_initialized = False
+
+
+def distributed_env() -> Optional[dict]:
+    """The launcher/scheduler contract from the environment, or None for
+    single-process runs (the reference analog: was this started under
+    mpirun or plain)."""
+    if ENV_COORDINATOR not in os.environ:
+        return None
+    return {
+        "coordinator_address": os.environ[ENV_COORDINATOR],
+        "num_processes": int(os.environ.get(ENV_NUM_PROCESSES, "1")),
+        "process_id": int(os.environ.get(ENV_PROCESS_ID, "0")),
+    }
+
+
+def init_distributed(config: Optional[ConfigParser] = None) -> bool:
+    """``MPI_Init`` equivalent.  Joins the coordinator named by the
+    environment (or ``[cluster] coordinator/num_processes/process_id``
+    config keys); no-op when neither names one, or when already joined.
+    Returns True iff this run is multi-process.
+
+    Must run before the first touch of the jax backend in this process —
+    like MPI_Init, bring-up is the program's first act.
+    """
+    global _initialized
+    if _initialized:
+        import jax
+
+        return jax.process_count() > 1
+
+    # NOTE: nothing may touch the jax backend before
+    # jax.distributed.initialize (even jax.devices()/process_count());
+    # keep this path free of backend queries.
+    env = distributed_env()
+    if env is None and config is not None and \
+            config.has("cluster", "coordinator"):
+        env = {
+            "coordinator_address":
+                config.get("cluster", "coordinator").to_string(),
+            "num_processes":
+                config.get("cluster", "num_processes").to_int32()
+                if config.has("cluster", "num_processes") else 1,
+            "process_id":
+                config.get("cluster", "process_id").to_int32()
+                if config.has("cluster", "process_id") else 0,
+        }
+    if env is None or env["num_processes"] <= 1:
+        return False
+
+    import jax
+
+    jax.distributed.initialize(**env)
+    _initialized = True
+    log.info("distributed up: process %d/%d, %d global / %d local devices",
+             env["process_id"], env["num_processes"],
+             len(jax.devices()), jax.local_device_count())
+    return True
+
+
+def shutdown_distributed() -> None:
+    """``MPI_Finalize`` equivalent; safe to call unconditionally."""
+    global _initialized
+    if not _initialized:
+        return
+    import jax
+
+    jax.distributed.shutdown()
+    _initialized = False
+
+
+def barrier(name: str = "smtpu_barrier") -> None:
+    """``MPI_Barrier`` equivalent (utils/mpi.h:37): blocks until every
+    process reaches the same named point.  Implemented as a tiny global
+    collective, so it also flushes outstanding dispatches."""
+    from jax.experimental import multihost_utils
+
+    multihost_utils.sync_global_devices(name)
+
+
+def host_array(x) -> "np.ndarray":
+    """Full host value of a (possibly multi-process global) jax.Array.
+
+    Single-process / fully-addressable arrays read directly; arrays that
+    span other processes are fetched with ``process_allgather`` — a
+    COLLECTIVE: in multi-process runs every process must call this on the
+    same array (checkpoint writers do, then only process 0 hits the disk).
+    """
+    import jax
+    import numpy as np
+
+    if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        from jax.experimental import multihost_utils
+
+        return np.asarray(multihost_utils.process_allgather(x, tiled=True))
+    return np.asarray(x)
+
+
+def is_writer() -> bool:
+    """True on the process that owns shared-filesystem writes (the
+    reference analog: each server rank writes its own shard file; here the
+    gathered table is written once, by process 0)."""
+    import jax
+
+    return jax.process_index() == 0
+
+
+def process_index() -> int:
+    import jax
+
+    return jax.process_index()
+
+
+def process_count() -> int:
+    import jax
+
+    return jax.process_count()

@@ -26,6 +26,7 @@ from typing import Dict, List, Optional
 
 import jax
 
+from swiftmpi_tpu.cluster.bootstrap import init_distributed
 from swiftmpi_tpu.cluster.hashfrag import HashFrag
 from swiftmpi_tpu.cluster.mesh import (MODEL_AXIS, SHARD_AXIS, MeshSpec,
                                        build_mesh, mesh_info, ps_mesh)
@@ -52,6 +53,9 @@ class Cluster:
 
     # -- bring-up (cluster.h:27-30) ----------------------------------------
     def initialize(self) -> "Cluster":
+        # MPI_Init equivalent: join the coordinator if the launcher/pod
+        # scheduler named one (no-op otherwise; see cluster/bootstrap.py)
+        multi_process = init_distributed(self.config)
         devices = list(jax.devices() if self._devices is None
                        else self._devices)
         n_servers = (self.config.get("cluster", "server_num").to_int32()
@@ -75,9 +79,11 @@ class Cluster:
                 raise ValueError(
                     f"server_num={n_servers} must divide "
                     f"{len(devices)} devices")
+            # multi-process: keep the data axis outermost across hosts so
+            # table-shard collectives ride ICI and only dp crosses DCN
             self.mesh = build_mesh(
                 MeshSpec.from_dict({"data": -1, "model": n_servers}),
-                devices=devices)
+                devices=devices, hybrid=multi_process)
             self.table_axis = MODEL_AXIS
         self.n_servers = n_servers
         frag_num = (self.config.get("server", "frag_num").to_int32()

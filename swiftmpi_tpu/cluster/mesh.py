@@ -35,6 +35,10 @@ import numpy as np
 from jax.experimental import mesh_utils
 from jax.sharding import Mesh, NamedSharding, PartitionSpec
 
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SHARD_AXIS = "shard"
@@ -83,17 +87,30 @@ def build_mesh(spec: Optional[MeshSpec] = None,
     names = tuple(a for a, _ in axes)
     shape = tuple(s for _, s in axes)
     if hybrid and jax.process_count() > 1:
-        # Split the leading axis across hosts (DCN); its per-host remainder
-        # and all other axes stay within a slice (ICI).
+        # Split one axis across hosts (DCN); its per-host remainder and all
+        # other axes stay within a slice (ICI).  Prefer the leading (least
+        # network-intense) axis, else the first one the process count
+        # divides; if none divides, a plain global mesh is still valid —
+        # DCN placement is a performance choice, not a correctness one.
         n_proc = jax.process_count()
-        if shape[0] % n_proc:
-            raise ValueError(
-                f"leading axis {names[0]}={shape[0]} must be a multiple of "
-                f"process count {n_proc} for a hybrid mesh")
-        per_slice = (shape[0] // n_proc,) + shape[1:]
-        dcn = (n_proc,) + (1,) * (len(shape) - 1)
+        dcn_axis = next((i for i, s in enumerate(shape) if s % n_proc == 0),
+                        None)
+        if dcn_axis is None:
+            log.warning(
+                "no mesh axis %s divisible by process count %d; building a "
+                "non-hybrid global mesh (collectives may cross DCN)",
+                dict(axes), n_proc)
+            return Mesh(np.asarray(devices).reshape(shape), names)
+        per_slice = tuple(s // n_proc if i == dcn_axis else s
+                          for i, s in enumerate(shape))
+        dcn = tuple(n_proc if i == dcn_axis else 1
+                    for i in range(len(shape)))
+        # DCN granule = slice where the platform reports a real multi-slice
+        # topology; otherwise (CPU dev/CI, single-slice pods) = process
+        n_slices = len({getattr(d, "slice_index", None) for d in devices})
         dev_array = mesh_utils.create_hybrid_device_mesh(
-            mesh_shape=per_slice, dcn_mesh_shape=dcn, devices=devices)
+            mesh_shape=per_slice, dcn_mesh_shape=dcn, devices=devices,
+            process_is_granule=n_slices != n_proc)
         return Mesh(dev_array.reshape(shape), names)
     dev_array = np.asarray(devices).reshape(shape)
     return Mesh(dev_array, names)

@@ -25,6 +25,7 @@ from typing import Callable, Dict, Optional
 
 import numpy as np
 
+from swiftmpi_tpu.cluster.bootstrap import host_array, is_writer
 from swiftmpi_tpu.parameter.sparse_table import SparseTable
 
 Formatter = Callable[[Dict[str, np.ndarray]], str]
@@ -75,10 +76,16 @@ def dump_table_text(table: SparseTable, path: str,
         from swiftmpi_tpu.data import native
         if native.available():
             keys, slots = _index_arrays(table.key_index)
-            arrs = [np.asarray(table.state[f])[slots] for f in fields]
+            # host_array is a collective in multi-process runs: gather on
+            # every process, write once
+            arrs = [host_array(table.state[f])[slots] for f in fields]
+            if not is_writer():
+                return len(keys)
             return native.dump_rows_native(path, keys, arrs)
         formatter = default_formatter(fields)
-    rows = {f: np.asarray(table.state[f]) for f in table.access.fields}
+    rows = {f: host_array(table.state[f]) for f in table.access.fields}
+    if not is_writer():
+        return len(table.key_index)
     n = 0
     with open(path, "w") as f:
         for key, slot in table.key_index.items():
@@ -192,7 +199,8 @@ def save_checkpoint(table: SparseTable, path: str,
                        count=len(table.key_index))
     slots = np.fromiter((table.key_index.slot(int(k)) for k in keys),
                         dtype=np.int64, count=len(keys))
-    payload = {f"field__{f}": np.asarray(v) for f, v in table.state.items()}
+    payload = {f"field__{f}": host_array(v)
+               for f, v in table.state.items()}
     payload["keys"] = keys
     payload["slots"] = slots
     payload["num_shards"] = np.int64(table.key_index.num_shards)
@@ -200,6 +208,8 @@ def save_checkpoint(table: SparseTable, path: str,
         table.key_index.capacity_per_shard)
     for k, v in (extra or {}).items():
         payload[f"extra__{k}"] = np.asarray(v)
+    if not is_writer():        # gather above was the collective part
+        return
     # atomic: a crash mid-write must never clobber the last good
     # checkpoint (it is the only thing auto-resume can rewind to)
     dst = npz_path(path)

@@ -1,0 +1,156 @@
+"""Single-host multi-process launcher: the ``mpirun -np N`` equivalent.
+
+The reference is launched as ``mpirun -np N -hostfile hosts ./bin/word2vec
+-config ... -data ...`` (`/root/reference/src/apps/word2vec/cluster_run.sh:2`,
+``run.sh`` for the single-process variant).  Here::
+
+    python -m swiftmpi_tpu.launch -np 4 -- python -m \
+        swiftmpi_tpu.apps.w2v_main -config demo.conf -data corpus.txt ...
+
+spawns N local processes wired to one ``jax.distributed`` coordinator (the
+bootstrap env contract in cluster/bootstrap.py); each child calls
+``init_distributed()`` via ``Cluster.initialize()`` and sees the global
+device set.  Multi-host launches are the pod scheduler's job — it sets the
+same three env vars per host; this launcher is the dev/CI story, exactly
+like the reference's loopback ``mpirun -np 1`` (SURVEY.md §4).
+
+Flags (reference CMDLine style, ``-key value``):
+
+* ``-np N``       — number of processes (default 1).
+* ``-cpu D``      — give each process D virtual CPU devices
+                    (JAX_PLATFORMS=cpu + xla_force_host_platform_device_count;
+                    the standard fake-multi-device trick for development).
+* ``-port P``     — coordinator port (default: an OS-assigned free port).
+
+Children inherit stdout/stderr with a ``[rank k]`` line prefix; first
+non-zero exit terminates the rest (mpirun semantics).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List
+
+from swiftmpi_tpu.cluster.bootstrap import (ENV_COORDINATOR,
+                                            ENV_NUM_PROCESSES,
+                                            ENV_PROCESS_ID)
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def _child_env(base: Dict[str, str], port: int, rank: int, nprocs: int,
+               cpu_devices: int) -> Dict[str, str]:
+    env = dict(base)
+    env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
+    env[ENV_NUM_PROCESSES] = str(nprocs)
+    env[ENV_PROCESS_ID] = str(rank)
+    if cpu_devices:
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PALLAS_AXON_POOL_IPS"] = ""   # disable single-chip TPU hook
+        flags = [f for f in env.get("XLA_FLAGS", "").split()
+                 if not f.startswith("--xla_force_host_platform_"
+                                     "device_count")]
+        flags.append(
+            f"--xla_force_host_platform_device_count={cpu_devices}")
+        env["XLA_FLAGS"] = " ".join(flags)
+    return env
+
+
+def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
+           port: int = 0, kill_grace_s: float = 5.0) -> int:
+    """Spawn ``nprocs`` copies of ``argv`` under one coordinator; returns
+    the first non-zero child exit code (terminating the others), else 0.
+
+    One reader thread per child (a blocking ``readline`` there cannot
+    stall exit detection here); the main thread only polls exit codes.
+    SIGTERM on first failure escalates to SIGKILL after ``kill_grace_s``.
+    """
+    port = port or _free_port()
+    procs = []
+    print_lock = threading.Lock()
+
+    def reader(rank: int, stream) -> None:
+        for line in stream:                      # until EOF
+            with print_lock:
+                sys.stdout.write(f"[rank {rank}] {line}")
+                sys.stdout.flush()
+
+    threads = []
+    for rank in range(nprocs):
+        p = subprocess.Popen(
+            argv, env=_child_env(os.environ, port, rank, nprocs,
+                                 cpu_devices),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs.append(p)
+        t = threading.Thread(target=reader, args=(rank, p.stdout),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    rc = 0
+    try:
+        while any(p.poll() is None for p in procs):
+            time.sleep(0.1)
+            for p in procs:
+                code = p.poll()
+                if code not in (None, 0) and rc == 0:
+                    rc = code          # first failure wins, mpirun-style
+                    for q in procs:
+                        if q.poll() is None:
+                            q.terminate()
+                    deadline = time.monotonic() + kill_grace_s
+                    for q in procs:
+                        try:
+                            q.wait(max(0.0, deadline - time.monotonic()))
+                        except subprocess.TimeoutExpired:
+                            q.kill()   # SIGTERM ignored: escalate
+        for p in procs:
+            code = p.wait()
+            if code and rc == 0:
+                rc = code
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        # drain remaining output; daemon threads may outlive a child that
+        # leaked its stdout to a grandchild — don't hang on them
+        for t in threads:
+            t.join(timeout=1.0)
+    return rc
+
+
+def main(args: List[str]) -> int:
+    from swiftmpi_tpu.utils.cmdline import CMDLine
+
+    if "--" not in args:
+        print("usage: python -m swiftmpi_tpu.launch -np N [-cpu D] "
+              "[-port P] -- prog args...", file=sys.stderr)
+        return 2
+    split = args.index("--")
+    cmd = CMDLine(["launch"] + args[:split])
+    cmd.registerParameter("np", "number of processes")
+    cmd.registerParameter("cpu", "virtual CPU devices per process")
+    cmd.registerParameter("port", "coordinator port")
+    prog = args[split + 1:]
+    if not prog:
+        print("launch: nothing to run after --", file=sys.stderr)
+        return 2
+    return launch(
+        prog,
+        nprocs=int(cmd.get_value("np")) if cmd.hasParameter("np") else 1,
+        cpu_devices=int(cmd.get_value("cpu"))
+        if cmd.hasParameter("cpu") else 0,
+        port=int(cmd.get_value("port")) if cmd.hasParameter("port") else 0)
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

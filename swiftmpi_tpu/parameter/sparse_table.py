@@ -101,7 +101,9 @@ class SparseTable:
         return len(self.key_index)
 
     def rows_as_numpy(self) -> Dict[str, np.ndarray]:
-        return {f: np.asarray(v) for f, v in self.state.items()}
+        from swiftmpi_tpu.cluster.bootstrap import host_array
+
+        return {f: host_array(v) for f, v in self.state.items()}
 
     def __repr__(self) -> str:  # pragma: no cover
         return (f"SparseTable(fields={list(self.access.fields)}, "

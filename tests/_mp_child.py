@@ -1,0 +1,93 @@
+"""Child program for the multi-process launcher test (not a pytest file).
+
+Run under ``python -m swiftmpi_tpu.launch -np 2 -cpu 2 -- python
+tests/_mp_child.py``: joins the coordinator through the normal
+``Cluster.initialize()`` path, checks the global device view, runs a
+cross-process reduction, and hits the barrier — the whole MPI-equivalent
+control+data plane in one pass.
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import jax                                                     # noqa: E402
+import jax.numpy as jnp                                        # noqa: E402
+import numpy as np                                             # noqa: E402
+from jax.sharding import NamedSharding, PartitionSpec as P     # noqa: E402
+
+from swiftmpi_tpu.cluster import (Cluster, barrier, process_count,  # noqa
+                                  process_index, shutdown_distributed)
+from swiftmpi_tpu.utils import ConfigParser                    # noqa: E402
+
+
+def main():
+    cfg = ConfigParser().update(
+        {"cluster": {"transfer": "xla", "server_num": 1}})
+    cluster = Cluster(cfg).initialize()
+
+    nprocs = process_count()
+    assert nprocs == int(os.environ["SMTPU_NUM_PROCESSES"]), \
+        f"joined {nprocs} processes"
+    n = len(jax.devices())
+    assert n == nprocs * jax.local_device_count()
+
+    # cross-process reduction: every device holds its global position;
+    # the replicated sum must see all of them (DCN-equivalent collective)
+    mesh = cluster.mesh
+    data = np.arange(n, dtype=np.float32)
+    arr = jax.make_array_from_callback(
+        (n,), NamedSharding(mesh, P("data")), lambda idx: data[idx])
+    total = jax.jit(jnp.sum, out_shardings=NamedSharding(mesh, P()))(arr)
+    want = n * (n - 1) / 2
+    assert float(total) == want, f"{float(total)} != {want}"
+
+    # default config (server_num absent -> every device a server): the
+    # data axis is 1, so the DCN granule must move to a divisible axis
+    # instead of failing bring-up
+    default_cluster = Cluster(ConfigParser()).initialize()
+    assert default_cluster.mesh.devices.size == n
+
+    # one REAL training step across processes: identical host batches on
+    # every process, dp-sharded over the global data axis, table updates
+    # through the jitted step (the reference's distributed SGD epoch body)
+    from swiftmpi_tpu.data.text import CBOWBatcher, synthetic_corpus
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+
+    cfg.update({"word2vec": {"len_vec": 8, "window": 2, "negative": 2,
+                             "sample": -1, "learning_rate": 0.05},
+                "server": {"initial_learning_rate": 0.3, "frag_num": 64},
+                "worker": {"minibatch": 32}})
+    model = Word2Vec(config=cfg, cluster=cluster)
+    corpus = synthetic_corpus(8, vocab_size=32, length=12, seed=0)
+    model.build(corpus)
+    batch = next(CBOWBatcher(corpus, model.vocab, model.window).epoch(
+        4 * n))
+    step = model._build_step()
+
+    def global_put(x, spec):
+        x = np.asarray(x)
+        return jax.make_array_from_callback(
+            x.shape, NamedSharding(mesh, spec), lambda idx: x[idx])
+
+    state = model.table.state
+    new_state, es, ec = step(
+        state, model._slot_of_vocab, model._alias_prob, model._alias_idx,
+        global_put(batch.centers, P("data")),
+        global_put(batch.contexts, P("data", None)),
+        global_put(batch.ctx_mask, P("data", None)),
+        jax.random.key(1))
+    jax.block_until_ready(new_state)
+    loss = float(es) / max(int(ec), 1)
+    assert np.isfinite(loss), f"non-finite loss {loss}"
+
+    barrier("mp_child_done")
+    print(f"MP_OK proc={process_index()}/{nprocs} devices={n} "
+          f"sum={float(total)} loss={loss:.4f}", flush=True)
+    shutdown_distributed()
+
+
+if __name__ == "__main__":
+    main()
