@@ -190,6 +190,30 @@ def npz_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def atomic_savez(dst: str, payload: Dict[str, np.ndarray]) -> None:
+    """Crash-safe npz write: savez to a pid-unique tmp then rename, so a
+    crash mid-write never clobbers the last good checkpoint.  Sweeps aged
+    orphan tmps from killed writers (age-guarded: a concurrent writer's
+    fresh in-progress file is left alone)."""
+    os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
+    tmp = f"{dst}.{os.getpid()}.tmp.npz"   # unique per writer
+    now = time.time()
+    for stale in glob.glob(glob.escape(dst) + ".*.tmp.npz"):
+        if stale == tmp:
+            continue
+        try:
+            if now - os.path.getmtime(stale) > _TMP_SWEEP_AGE_S:
+                os.unlink(stale)
+        except OSError:
+            pass
+    try:
+        np.savez(tmp, **payload)
+        os.replace(tmp, dst)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
 def save_checkpoint(table: SparseTable, path: str,
                     extra: Optional[Dict[str, np.ndarray]] = None) -> None:
     """npz with all fields (incl. optimizer state), the key index, and any
@@ -212,27 +236,7 @@ def save_checkpoint(table: SparseTable, path: str,
         return
     # atomic: a crash mid-write must never clobber the last good
     # checkpoint (it is the only thing auto-resume can rewind to)
-    dst = npz_path(path)
-    os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
-    tmp = f"{dst}.{os.getpid()}.tmp.npz"   # unique per writer
-    # a writer killed between savez and replace (OOM/SIGKILL skips the
-    # finally) leaves its pid-suffixed tmp behind forever; sweep stale
-    # ones, but never a concurrent writer's in-progress file (age guard)
-    now = time.time()
-    for stale in glob.glob(glob.escape(dst) + ".*.tmp.npz"):
-        if stale == tmp:
-            continue
-        try:
-            if now - os.path.getmtime(stale) > _TMP_SWEEP_AGE_S:
-                os.unlink(stale)
-        except OSError:
-            pass
-    try:
-        np.savez(tmp, **payload)
-        os.replace(tmp, dst)
-    finally:
-        if os.path.exists(tmp):
-            os.unlink(tmp)
+    atomic_savez(npz_path(path), payload)
 
 
 def load_checkpoint(table: SparseTable, path: str) -> Dict[str, np.ndarray]:

@@ -13,8 +13,10 @@ from swiftmpi_tpu.models.transformer import (TransformerConfig, forward,
                                              forward_pipelined, init_params,
                                              lm_loss, param_shardings,
                                              sgd_step)
+from swiftmpi_tpu.models.trainer import TrainState, Trainer, make_optimizer
 
 __all__ = ["LogisticRegression", "Word2Vec", "Sent2Vec",
            "build_word_model_from_dump", "TransformerConfig", "forward",
            "forward_pipelined", "init_params", "lm_loss",
-           "param_shardings", "sgd_step"]
+           "param_shardings", "sgd_step", "TrainState", "Trainer",
+           "make_optimizer"]

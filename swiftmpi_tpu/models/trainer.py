@@ -1,0 +1,206 @@
+"""Transformer training loop: optimizer, schedule, sharding, checkpoints.
+
+The reference has no dense-model trainer at all (its optimizer lives
+server-side as the AdaGrad push rule, accessmethod.h) — this is the
+framework's training infrastructure for the transformer family, composed
+the idiomatic TPU way:
+
+* optimizer = optax (adamw/sgd + warmup-cosine), state sharded like the
+  params so dp/tp carry over to the optimizer for free;
+* one jitted, donated ``train_step``: loss, grads, update — GSPMD inserts
+  every collective from the shardings alone;
+* ``remat`` in TransformerConfig turns on per-block ``jax.checkpoint``
+  (activation memory O(layers) -> O(1) at ~1/3 extra FLOPs);
+* checkpoints are flat npz (multihost-safe: collective gather, process-0
+  writes — same policy as io/checkpoint.py), resume-exact including
+  optimizer state and step counter.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from swiftmpi_tpu.cluster.bootstrap import host_array, is_writer
+from swiftmpi_tpu.io.checkpoint import atomic_savez
+from swiftmpi_tpu.models.transformer import (TransformerConfig, init_params,
+                                             lm_loss, param_shardings)
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+
+@dataclass
+class TrainState:
+    params: Any
+    opt_state: Any
+    step: jax.Array          # replicated scalar int32
+
+    def tree(self):
+        return {"params": self.params, "opt_state": self.opt_state,
+                "step": self.step}
+
+
+def make_optimizer(name: str = "adamw", learning_rate: float = 3e-4,
+                   warmup_steps: int = 100, decay_steps: int = 10_000,
+                   weight_decay: float = 0.01,
+                   grad_clip: float = 1.0) -> optax.GradientTransformation:
+    """Warmup-cosine schedule + global-norm clip around adamw/sgd."""
+    sched = optax.warmup_cosine_decay_schedule(
+        0.0, learning_rate, warmup_steps, max(decay_steps, warmup_steps + 1))
+    if name == "adamw":
+        opt = optax.adamw(sched, weight_decay=weight_decay)
+    elif name == "sgd":
+        opt = optax.sgd(sched, momentum=0.9)
+    else:
+        raise ValueError(f"unknown optimizer {name!r}")
+    return optax.chain(optax.clip_by_global_norm(grad_clip), opt)
+
+
+class Trainer:
+    """Owns params + optimizer state and the jitted step.
+
+    ``mesh`` (optional) applies ``param_shardings`` (tp over ``model``) to
+    params AND optimizer state; tokens fed to ``step`` shard over
+    ``data``.  Without a mesh everything is single-device.
+    """
+
+    def __init__(self, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
+                 optimizer: str = "adamw", aux_weight: float = 0.01,
+                 **opt_kwargs):
+        self.cfg = cfg
+        self.mesh = mesh
+        self.optimizer = make_optimizer(optimizer, **opt_kwargs)
+        self.aux_weight = aux_weight
+        self._step_fn = None
+
+    # -- state ------------------------------------------------------------
+    def init_state(self, key) -> TrainState:
+        params = init_params(key, self.cfg)
+        if self.mesh is not None:
+            shardings = param_shardings(params, self.cfg, self.mesh)
+            params = jax.jit(lambda p: p, out_shardings=shardings)(params)
+            # optimizer state mirrors param shapes -> mirror the shardings
+            opt_state = jax.jit(
+                self.optimizer.init,
+                out_shardings=self._opt_shardings(params, shardings))(
+                    params)
+        else:
+            opt_state = jax.jit(self.optimizer.init)(params)
+        return TrainState(params, opt_state,
+                          jnp.zeros((), jnp.int32))
+
+    def _opt_shardings(self, params, param_sh):
+        """Shardings for the optimizer state: optax states embed
+        param-shaped pytrees (adam's mu/nu, sgd's trace) with the SAME
+        treedef as the params — any subtree matching that structure gets
+        the param shardings, everything else (counts, schedule steps)
+        replicates."""
+        shapes = jax.eval_shape(self.optimizer.init, params)
+        repl = NamedSharding(self.mesh, P())
+        params_treedef = jax.tree.structure(params)
+
+        def walk(node):
+            try:
+                if jax.tree.structure(node) == params_treedef:
+                    return param_sh
+            except Exception:
+                pass
+            if isinstance(node, tuple) and hasattr(node, "_fields"):
+                return type(node)(*(walk(v) for v in node))
+            if isinstance(node, tuple):
+                return tuple(walk(v) for v in node)
+            if isinstance(node, list):
+                return [walk(v) for v in node]
+            if isinstance(node, dict):
+                return {k: walk(v) for k, v in node.items()}
+            return repl
+
+        return walk(shapes)
+
+    # -- the step ---------------------------------------------------------
+    def _build_step(self):
+        cfg, mesh, opt = self.cfg, self.mesh, self.optimizer
+        aux_w = self.aux_weight
+
+        @partial(jax.jit, donate_argnums=(0, 1, 2))
+        def train_step(params, opt_state, step, tokens):
+            loss, grads = jax.value_and_grad(lm_loss)(
+                params, tokens, cfg, mesh, aux_weight=aux_w)
+            updates, opt_state = opt.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, step + 1, loss
+
+        return train_step
+
+    def step(self, state: TrainState, tokens) -> Tuple[TrainState,
+                                                       jax.Array]:
+        if self._step_fn is None:
+            self._step_fn = self._build_step()
+        if self.mesh is not None:
+            want = NamedSharding(self.mesh, P("data", None))
+            if not (isinstance(tokens, jax.Array)
+                    and tokens.sharding == want):
+                # reshard whatever we got (host array or a jax.Array on
+                # the wrong devices) so dp is never silently dropped
+                tokens = jax.device_put(jnp.asarray(tokens), want)
+        params, opt_state, step, loss = self._step_fn(
+            state.params, state.opt_state, state.step, tokens)
+        return TrainState(params, opt_state, step), loss
+
+    # -- checkpoints (multihost-safe, atomic) ------------------------------
+    def save(self, state: TrainState, path: str) -> None:
+        flat, treedef = jax.tree.flatten(state.tree())
+        # every process gathers (host_array is a collective); only the
+        # writer touches the disk — and logs from the gathered copy, so no
+        # collective runs after non-writers have returned
+        payload = {f"leaf_{i}": host_array(v) for i, v in enumerate(flat)}
+        if not is_writer():
+            return
+        payload["treedef"] = np.frombuffer(
+            repr(treedef).encode(), dtype=np.uint8)
+        dst = path if path.endswith(".npz") else path + ".npz"
+        atomic_savez(dst, payload)
+        step_i = next(i for i, v in enumerate(flat) if v is state.step)
+        log.info("trainer checkpoint -> %s (step %d)", dst,
+                 int(payload[f"leaf_{step_i}"]))
+
+    def load(self, path: str, key=None) -> TrainState:
+        """Rebuild a TrainState from ``save`` output.  The tree structure
+        comes from a fresh ``init_state`` (cfg must match); leaf order is
+        the flatten order, so shapes are validated leaf-by-leaf."""
+        state = self.init_state(key if key is not None
+                                else jax.random.key(0))
+        flat, treedef = jax.tree.flatten(state.tree())
+        dst = path if path.endswith(".npz") else path + ".npz"
+        with np.load(dst) as z:
+            saved_def = z["treedef"].tobytes().decode()
+            if saved_def != repr(treedef):
+                raise ValueError(
+                    "checkpoint state tree does not match this trainer "
+                    "(optimizer/config mismatch?): saved "
+                    f"{saved_def[:120]}... != {repr(treedef)[:120]}...")
+            loaded = [z[f"leaf_{i}"] for i in range(len(flat))]
+        for i, (have, want) in enumerate(zip(loaded, flat)):
+            if tuple(have.shape) != tuple(want.shape):
+                raise ValueError(
+                    f"checkpoint leaf {i} shape {have.shape} != "
+                    f"model {tuple(want.shape)} (config mismatch?)")
+        def put(arr, ref):
+            if isinstance(ref, jax.Array):
+                # make_array_from_callback works for multi-process global
+                # shardings too (device_put would require addressability)
+                return jax.make_array_from_callback(
+                    arr.shape, ref.sharding, lambda idx: arr[idx])
+            return arr
+
+        tree = jax.tree.unflatten(
+            treedef, [put(a, r) for a, r in zip(loaded, flat)])
+        return TrainState(tree["params"], tree["opt_state"], tree["step"])

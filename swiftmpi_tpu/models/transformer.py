@@ -57,6 +57,9 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
     rope_base: float = 10_000.0
+    remat: bool = False              # jax.checkpoint each block: trade
+                                     # recompute FLOPs for HBM (activation
+                                     # memory goes O(L) -> O(1) blocks)
     dtype: Any = jnp.float32
 
     @property
@@ -214,6 +217,8 @@ def forward(params, tokens, cfg: TransformerConfig,
                            expert_axis=expert_axis)
         return (x, aux + a), None
 
+    if cfg.remat:
+        body = jax.checkpoint(body)
     (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
                                params["blocks"])
     x = _rms_norm(x, params["ln_f"])
@@ -236,6 +241,9 @@ def forward_pipelined(params, tokens, cfg: TransformerConfig, mesh: Mesh,
     def stage_fn(blk, act):
         out, _ = block_apply(blk, act, cfg, None)
         return out
+
+    if cfg.remat:
+        stage_fn = jax.checkpoint(stage_fn)
 
     x = pipeline_apply(stage_fn, params["blocks"], x, mesh,
                        axis=stage_axis, num_microbatches=num_microbatches)
