@@ -1,0 +1,88 @@
+"""Distributed data parallelism over the batch stream.
+
+The reference distributes training data by giving each MPI rank its own
+file ("Distribute the data set... allocate each node a file",
+apps/word2vec/README.md; per-thread byte slices word2vec_global.h:594-600)
+— each rank computes on its shard, gradients combine at the servers.
+
+Here the same contract is a wrapper over any per-process batcher: every
+process streams batches from its own data shard, and each local batch
+becomes one *global* jax.Array sharded over the ``data`` mesh axis
+(`jax.make_array_from_process_local_data`) — so the jitted training step
+runs one SPMD program over everybody's data and the gradient combine is
+whatever the step already does (psum / table scatter).
+
+Lockstep protocol: SPMD requires every process to dispatch the same number
+of steps, but shards deplete unevenly (subsampling is stochastic).  Before
+each step a tiny allgather exchanges (has_batch, n_words); the epoch ends
+the moment ANY shard runs dry — the same "epoch = until the fastest rank
+finishes" semantics as the reference's async variant, where threads simply
+stop at their slice end (word2vec_global.h:630-651).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from swiftmpi_tpu.cluster.mesh import DATA_AXIS
+from swiftmpi_tpu.data.text import CBOWBatch
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+
+def shard_sentences(sentences, rank: Optional[int] = None,
+                    nprocs: Optional[int] = None):
+    """This process's data shard (round-robin, balanced to ±1 sentence) —
+    the equivalent of the reference's per-node data file."""
+    rank = jax.process_index() if rank is None else rank
+    nprocs = jax.process_count() if nprocs is None else nprocs
+    return sentences[rank::nprocs]
+
+
+class DistributedBatcher:
+    """Wraps a per-process batcher into a lockstep global batch stream.
+
+    ``batcher`` must yield objects with ``centers/contexts/ctx_mask/
+    n_words`` (CBOWBatch shape); under-filled batches are skipped so all
+    ranks keep identical static shapes.  The global batch size seen by the
+    training step is ``batch_size * process_count``.
+    """
+
+    def __init__(self, batcher, mesh: Mesh, axis: str = DATA_AXIS):
+        self.batcher = batcher
+        self.mesh = mesh
+        self.axis = axis
+        self.vocab = getattr(batcher, "vocab", None)
+
+    def epoch(self, batch_size: int) -> Iterator[CBOWBatch]:
+        from jax.experimental import multihost_utils
+
+        sh1 = NamedSharding(self.mesh, P(self.axis))
+        sh2 = NamedSharding(self.mesh, P(self.axis, None))
+        it = self.batcher.epoch(batch_size)
+        steps = 0
+        while True:
+            batch = next(it, None)
+            while batch is not None and len(batch) != batch_size:
+                batch = next(it, None)      # drop ragged tail batches
+            flag = np.asarray(
+                [0 if batch is None else 1,
+                 0 if batch is None else batch.n_words], np.int64)
+            flags = multihost_utils.process_allgather(flag)
+            if int(flags[:, 0].min()) == 0:
+                if batch is not None:
+                    log.debug("epoch cut at %d steps: another shard ran "
+                              "dry first", steps)
+                return
+            mk = jax.make_array_from_process_local_data
+            yield CBOWBatch(
+                mk(sh1, np.ascontiguousarray(batch.centers)),
+                mk(sh2, np.ascontiguousarray(batch.contexts)),
+                mk(sh2, np.ascontiguousarray(batch.ctx_mask)),
+                int(flags[:, 1].sum()))
+            steps += 1

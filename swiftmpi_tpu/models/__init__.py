@@ -13,10 +13,21 @@ from swiftmpi_tpu.models.transformer import (TransformerConfig, forward,
                                              forward_pipelined, init_params,
                                              lm_loss, param_shardings,
                                              sgd_step)
-from swiftmpi_tpu.models.trainer import TrainState, Trainer, make_optimizer
 
 __all__ = ["LogisticRegression", "Word2Vec", "Sent2Vec",
            "build_word_model_from_dump", "TransformerConfig", "forward",
            "forward_pipelined", "init_params", "lm_loss",
            "param_shardings", "sgd_step", "TrainState", "Trainer",
            "make_optimizer"]
+
+_TRAINER_EXPORTS = ("TrainState", "Trainer", "make_optimizer")
+
+
+def __getattr__(name):
+    # lazy: keeps optax out of the import graph of users who never touch
+    # the transformer trainer (word2vec/logistic need only jax)
+    if name in _TRAINER_EXPORTS:
+        from swiftmpi_tpu.models import trainer
+
+        return getattr(trainer, name)
+    raise AttributeError(name)

@@ -29,7 +29,7 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from swiftmpi_tpu.cluster.bootstrap import host_array, is_writer
-from swiftmpi_tpu.io.checkpoint import atomic_savez
+from swiftmpi_tpu.io.checkpoint import atomic_savez, npz_path
 from swiftmpi_tpu.models.transformer import (TransformerConfig, init_params,
                                              lm_loss, param_shardings)
 from swiftmpi_tpu.utils.logger import get_logger
@@ -148,9 +148,15 @@ class Trainer:
             want = NamedSharding(self.mesh, P("data", None))
             if not (isinstance(tokens, jax.Array)
                     and tokens.sharding == want):
-                # reshard whatever we got (host array or a jax.Array on
-                # the wrong devices) so dp is never silently dropped
-                tokens = jax.device_put(jnp.asarray(tokens), want)
+                # reshard whatever we got so dp is never silently dropped;
+                # multi-process: host tokens are this process's LOCAL rows
+                # of the global batch (device_put would wrongly assume the
+                # same full value on every host)
+                if jax.process_count() > 1:
+                    tokens = jax.make_array_from_process_local_data(
+                        want, np.asarray(tokens))
+                else:
+                    tokens = jax.device_put(jnp.asarray(tokens), want)
         params, opt_state, step, loss = self._step_fn(
             state.params, state.opt_state, state.step, tokens)
         return TrainState(params, opt_state, step), loss
@@ -166,7 +172,7 @@ class Trainer:
             return
         payload["treedef"] = np.frombuffer(
             repr(treedef).encode(), dtype=np.uint8)
-        dst = path if path.endswith(".npz") else path + ".npz"
+        dst = npz_path(path)
         atomic_savez(dst, payload)
         step_i = next(i for i, v in enumerate(flat) if v is state.step)
         log.info("trainer checkpoint -> %s (step %d)", dst,
@@ -179,7 +185,7 @@ class Trainer:
         state = self.init_state(key if key is not None
                                 else jax.random.key(0))
         flat, treedef = jax.tree.flatten(state.tree())
-        dst = path if path.endswith(".npz") else path + ".npz"
+        dst = npz_path(path)
         with np.load(dst) as z:
             saved_def = z["treedef"].tobytes().decode()
             if saved_def != repr(treedef):

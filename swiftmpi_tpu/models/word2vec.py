@@ -60,6 +60,13 @@ from swiftmpi_tpu.utils.timers import Throughput
 log = get_logger(__name__)
 
 
+def _dev(x):
+    """Batch arg -> device: distributed batches arrive as global
+    jax.Arrays whose sharding must be left alone (jnp.asarray would
+    re-place them); host arrays go through jnp.asarray."""
+    return x if isinstance(x, jax.Array) else jnp.asarray(x)
+
+
 def _mean_scale(slots_flat, capacity):
     """Reciprocal per-key contribution count (the reference's grad/count
     mean normalization at push serialization, word2vec.h:120-132).
@@ -364,9 +371,22 @@ class Word2Vec:
                               jax.jit(self._build_apply()))
         batch_size = batch_size or max(
             256, self.minibatch // (2 * self.window))
+        nprocs = jax.process_count()
         if batcher is None:
-            batcher = CBOWBatcher(data, self.vocab, self.window,
-                                  self.sample)
+            sents = data
+            seed = 2008
+            if nprocs > 1:
+                # per-rank data shard + rank-decorrelated sampling: the
+                # reference's "one file per node" distribution
+                from swiftmpi_tpu.data.distributed import shard_sentences
+                sents = shard_sentences(data)
+                seed += jax.process_index()
+            batcher = CBOWBatcher(sents, self.vocab, self.window,
+                                  self.sample, seed=seed)
+        if nprocs > 1:
+            from swiftmpi_tpu.data.distributed import DistributedBatcher
+            if not isinstance(batcher, DistributedBatcher):
+                batcher = DistributedBatcher(batcher, self.cluster.mesh)
         state = self.table.state
         frozen = state   # stale snapshot for the async mode
         losses = []
@@ -377,9 +397,8 @@ class Word2Vec:
             for batch in batcher.epoch(batch_size):
                 self._key, sub = jax.random.split(self._key)
                 args = (self._slot_of_vocab, self._alias_prob,
-                        self._alias_idx, jnp.asarray(batch.centers),
-                        jnp.asarray(batch.contexts),
-                        jnp.asarray(batch.ctx_mask), sub)
+                        self._alias_idx, _dev(batch.centers),
+                        _dev(batch.contexts), _dev(batch.ctx_mask), sub)
                 if sync:
                     state, es, ec = self._step(state, *args)
                     # the step donates (deletes) the input state buffers;

@@ -80,12 +80,20 @@ def main():
         global_put(batch.ctx_mask, P("data", None)),
         jax.random.key(1))
     jax.block_until_ready(new_state)
+    model.table.state = new_state   # the step donated the old buffers
     loss = float(es) / max(int(ec), 1)
     assert np.isfinite(loss), f"non-finite loss {loss}"
 
+    # full distributed epoch through the public API: train() shards the
+    # corpus per process, wraps the batcher in DistributedBatcher, and
+    # runs lockstep global steps until the fastest shard drains
+    losses = model.train(corpus, niters=1, batch_size=2 * n)
+    assert len(losses) == 1 and np.isfinite(losses[0]), losses
+
     barrier("mp_child_done")
     print(f"MP_OK proc={process_index()}/{nprocs} devices={n} "
-          f"sum={float(total)} loss={loss:.4f}", flush=True)
+          f"sum={float(total)} loss={loss:.4f} "
+          f"epoch_err={losses[0]:.4f}", flush=True)
     shutdown_distributed()
 
 
