@@ -51,6 +51,19 @@ def default_parser(fields) -> Parser:
 
 # -- text (reference-compatible) ------------------------------------------
 
+def _lookup_growing(table: SparseTable, keys) -> np.ndarray:
+    """key_index.lookup that grows the table on capacity exhaustion — a
+    checkpoint written after auto-growth must load back into a model built
+    with the original (smaller) capacity."""
+    from swiftmpi_tpu.parameter.key_index import CapacityError
+
+    while True:
+        try:
+            return table.key_index.lookup(keys)
+        except CapacityError:
+            table.grow()
+
+
 def _index_arrays(key_index):
     n = len(key_index)
     keys = np.empty(n, np.uint64)
@@ -121,7 +134,7 @@ def load_table_text(table: SparseTable, path: str,
                 arrs = [a[keep] for a in arrs]
                 if not len(key_arr):
                     return 0
-            idx = np.asarray(table.key_index.lookup(key_arr), np.int32)
+            idx = np.asarray(_lookup_growing(table, key_arr), np.int32)
             state = dict(table.state)
             for fname, block in zip(fields, arrs):
                 arr = np.asarray(state[fname]).copy()
@@ -149,7 +162,7 @@ def load_table_text(table: SparseTable, path: str,
         rests = [r for r, k in zip(rests, keep) if k]
         if not len(key_arr):
             return 0
-    all_slots = table.key_index.lookup(key_arr)
+    all_slots = _lookup_growing(table, key_arr)
     updates: Dict[str, list] = {f: [] for f in table.access.fields}
     for rest in rests:
         for fname, value in parser(rest).items():

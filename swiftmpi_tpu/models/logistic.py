@@ -32,6 +32,7 @@ from swiftmpi_tpu.data.libsvm import (CSRData, LibSVMBatch, iter_minibatches,
                                       load_data, load_file)  # noqa: F401
 from swiftmpi_tpu.io.checkpoint import (dump_table_text, load_table_text)
 from swiftmpi_tpu.parameter import lr_access
+from swiftmpi_tpu.parameter.key_index import CapacityError
 from swiftmpi_tpu.utils.config import ConfigParser, global_config
 from swiftmpi_tpu.utils.logger import get_logger
 
@@ -117,8 +118,22 @@ class LogisticRegression:
         for it in range(niters):
             total, count = 0.0, 0
             for batch in iter_minibatches(data, self.minibatch, F):
-                slots = self.table.key_index.lookup(
-                    np.where(batch.mask, batch.feat_ids, 0))
+                keys = np.where(batch.mask, batch.feat_ids, 0)
+                while True:
+                    try:
+                        slots = self.table.key_index.lookup(keys)
+                        break
+                    except CapacityError:
+                        # unlike the reference's self-growing
+                        # dense_hash_map, dense HBM arrays grow by explicit
+                        # re-layout; the jitted step bakes in capacity, so
+                        # rebuild it (loop: one batch may need >1 doubling)
+                        self.table.state = state   # sync the live buffers
+                        self.table.grow()
+                        log.info("table grown to %d rows",
+                                 self.table.capacity)
+                        self._step = self._build_step()
+                        state = self.table.state
                 state, loss, n = self._step(
                     state, jnp.asarray(slots),
                     jnp.asarray(batch.feat_vals),

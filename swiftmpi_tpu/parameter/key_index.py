@@ -112,6 +112,23 @@ class KeyIndex:
         """Occupied slots per shard (load-balance introspection)."""
         return self._next_local.copy()
 
+    # -- growth ------------------------------------------------------------
+    def grow(self, new_capacity_per_shard: int) -> None:
+        """Raise per-shard capacity, remapping every assigned slot to the
+        new ``slot = shard * new_cap + local`` layout (locals, and hence
+        per-shard insertion order, are preserved).  The device-side table
+        must be re-laid-out to match — use ``SparseTable.grow``, which
+        calls this."""
+        new = int(new_capacity_per_shard)
+        if new <= self.capacity_per_shard:
+            raise ValueError(
+                f"new capacity {new} must exceed {self.capacity_per_shard}")
+        old = self.capacity_per_shard
+        self.capacity_per_shard = new
+        for key, slot in list(self._slot_of.items()):
+            shard, local = divmod(slot, old)
+            self._slot_of[key] = shard * new + local
+
     # -- checkpoint restore ------------------------------------------------
     def restore(self, keys, slots) -> None:
         """Rebuild the index from saved (key, slot) pairs, preserving the

@@ -78,6 +78,57 @@ class SparseTable:
         return jax.jit(init_all, out_shardings=shardings)(
             jax.random.key(self.seed))
 
+    # -- growth ------------------------------------------------------------
+    def grow(self, new_capacity_per_shard: Optional[int] = None) -> None:
+        """Re-lay-out the table at a larger per-shard capacity (default
+        2x), preserving every occupied row (params AND optimizer state)
+        and freshly initializing the new slots.
+
+        The reference never needs this — ``dense_hash_map`` grows by
+        itself (sparsetable.h) — but dense static-shape HBM arrays don't,
+        so growth is an explicit re-shard: old rows scatter into their new
+        ``shard * new_cap + local`` positions in one jitted remap (no
+        donation — both layouts coexist during the scatter, so budget one
+        extra copy of the table).  Mesh sharding is preserved (num_shards
+        is unchanged, so per-device shard ranges still line up)."""
+        ki = self.key_index
+        old_per = ki.capacity_per_shard
+        new_per = int(new_capacity_per_shard or 2 * old_per)
+        items = list(ki.items())
+        old_slots = np.asarray([s for _, s in items], np.int64)
+        ki.grow(new_per)                      # remaps key -> new slot
+        # same remap the index applied, vectorized: shard and local parts
+        # are preserved, only the stride changes
+        new_slots = (old_slots // old_per) * new_per + old_slots % old_per
+
+        fields = self.access.fields
+        sharding = self.row_sharding()
+        new_cap = ki.capacity
+        # fresh init stream for the enlarged arrays: a different fold per
+        # growth so re-grown slots never repeat earlier row inits
+        self.seed += 1
+
+        def remap(old_state, old_slots, new_slots, key):
+            out = {}
+            for name, fs in sorted(fields.items()):
+                key, sub = jax.random.split(key)
+                arr = fs.init(sub, (new_cap, fs.dim)).astype(fs.dtype)
+                if len(items):
+                    arr = arr.at[new_slots].set(
+                        old_state[name][old_slots])
+                out[name] = arr
+            return out
+
+        # no donation: the enlarged outputs can't reuse the smaller input
+        # buffers anyway, and both copies must coexist during the scatter
+        jitted = jax.jit(
+            remap,
+            out_shardings=None if sharding is None
+            else {name: sharding for name in fields})
+        self.state = jitted(self.state, jnp.asarray(old_slots),
+                            jnp.asarray(new_slots),
+                            jax.random.key(self.seed))
+
     # -- device-level row access ------------------------------------------
     def gather(self, slots) -> TableState:
         """Rows for ``slots`` across pull-visible fields (device op)."""

@@ -126,3 +126,25 @@ def test_cluster_tpu_backend_forces_shard_mesh(devices8):
     cluster = Cluster(config=cfg).initialize()
     assert cluster.mesh.axis_names == (SHARD_AXIS,)
     assert cluster.transfer.name == "tpu"
+
+
+def test_text_load_grows_undersized_table(tmp_path):
+    """A dump written after auto-growth must load into a model built with
+    the original (small) capacity: load grows the table instead of
+    raising CapacityError."""
+    table, ki = make_table(num_shards=2, cap=32)
+    keys = np.arange(40, dtype=np.uint64)
+    ki.lookup(keys)
+    path = str(tmp_path / "dump.txt")
+    dump_table_text(table, path)
+
+    small, ki2 = make_table(num_shards=2, cap=4)
+    loaded = load_table_text(small, path)
+    assert loaded == 40
+    assert small.capacity >= len(ki2)          # grew to fit
+    for k in (0, 17, 39):
+        s1, s2 = ki.slot(k), ki2.slot(k)
+        for f in ("h", "v"):                    # pull fields in the dump
+            np.testing.assert_allclose(
+                np.asarray(small.state[f])[s2],
+                np.asarray(table.state[f])[s1], rtol=1e-6)

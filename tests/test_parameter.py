@@ -138,3 +138,80 @@ def test_cache_dedups_keys():
     cache = LocalParamCache({"v": 1})
     cache.init_keys([1, 2, 1, 3])
     assert len(cache) == 3
+
+
+# -- growth ---------------------------------------------------------------
+
+def test_key_index_grow_preserves_layout():
+    ki = KeyIndex(num_shards=2, capacity_per_shard=8)
+    keys = np.arange(8, dtype=np.uint64)   # murmur spreads these unevenly
+    old_slots = ki.lookup(keys).copy()
+    old_shards = ki.shard_of(keys)
+    ki.grow(16)
+    new_slots = ki.lookup(keys, create=False)
+    # shard ownership and per-shard insertion order (local) preserved
+    assert np.array_equal(new_slots // 16, old_shards)
+    assert np.array_equal(new_slots % 16, old_slots % 8)
+    with pytest.raises(ValueError):
+        ki.grow(8)  # must strictly increase
+
+
+def test_sparse_table_grow_preserves_rows():
+    access = w2v_access(0.3, 4)
+    ki = KeyIndex(num_shards=2, capacity_per_shard=8)
+    table = SparseTable(access, ki, seed=1)
+    keys = np.arange(6, dtype=np.uint64)
+    slots_before = ki.lookup(keys)
+    before = {f: np.asarray(v)[slots_before]
+              for f, v in table.state.items()}
+    table.grow()
+    assert table.capacity == 32
+    slots_after = ki.lookup(keys, create=False)
+    for f in access.fields:
+        assert table.state[f].shape[0] == 32
+        np.testing.assert_array_equal(
+            np.asarray(table.state[f])[slots_after], before[f])
+    # freed: new keys can now be added past the old capacity
+    ki.lookup(np.arange(100, 110, dtype=np.uint64))
+
+
+def test_sparse_table_grow_sharded(devices8):
+    access = w2v_access(0.3, 4)
+    ki = KeyIndex(num_shards=8, capacity_per_shard=4)
+    mesh = ps_mesh(devices=devices8)
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS, seed=1)
+    keys = np.arange(12, dtype=np.uint64)
+    slots_before = ki.lookup(keys)
+    before = {f: np.asarray(v)[slots_before]
+              for f, v in table.state.items()}
+    table.grow(16)
+    slots_after = ki.lookup(keys, create=False)
+    for f in access.fields:
+        # values preserved AND still row-sharded over the mesh
+        np.testing.assert_array_equal(
+            np.asarray(table.state[f])[slots_after], before[f])
+        assert table.state[f].sharding.spec == table.row_sharding().spec
+
+
+def test_logistic_auto_grows_table():
+    from swiftmpi_tpu.models.logistic import LogisticRegression
+    from swiftmpi_tpu.utils import ConfigParser
+
+    rng = np.random.default_rng(0)
+    data = []
+    for _ in range(60):
+        feats = sorted(rng.choice(200, size=6, replace=False))
+        y = 1.0 if (3 in feats or 7 in feats) else 0.0
+        data.append((y, [(int(f) + 1, 1.0) for f in feats]))
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "worker": {"minibatch": 20},
+        "server": {"initial_learning_rate": 0.1, "frag_num": 64}})
+    m = LogisticRegression(config=cfg, capacity_per_shard=16)
+    assert m.table.capacity == 16          # far fewer than 200 features
+    losses = m.train(data, niters=2)
+    assert m.table.capacity > 16           # grew at least once
+    assert np.isfinite(losses[-1])
+    # rows survived growth: a second epoch still trains (slots stable)
+    losses2 = m.train(data, niters=1)
+    assert np.isfinite(losses2[-1])
