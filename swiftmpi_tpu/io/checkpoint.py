@@ -137,7 +137,10 @@ def load_table_text(table: SparseTable, path: str,
             idx = np.asarray(_lookup_growing(table, key_arr), np.int32)
             state = dict(table.state)
             for fname, block in zip(fields, arrs):
-                arr = np.asarray(state[fname]).copy()
+                # host_array, not np.asarray: state may be a non-fully-
+                # addressable global array in multi-process runs (gather is
+                # collective — every process reaches this line)
+                arr = host_array(state[fname]).copy()
                 arr[idx] = block.reshape(len(idx), -1)
                 state[fname] = _replace(table, fname, arr)
             table.state = state
@@ -175,7 +178,7 @@ def load_table_text(table: SparseTable, path: str,
         if not vals:
             continue
         block = np.stack(vals).reshape(len(slots), -1)
-        arr = np.asarray(state[fname]).copy()
+        arr = host_array(state[fname]).copy()   # multihost-safe read side
         arr[idx] = block
         state[fname] = _replace(table, fname, arr)
     table.state = state
@@ -195,6 +198,11 @@ def _replace(table: SparseTable, fname: str, arr: np.ndarray):
 # orphaned tmp files older than this are swept on the next save; younger
 # ones may belong to a concurrent writer mid-savez and must be left alone
 _TMP_SWEEP_AGE_S = 300.0
+# beyond this age a tmp is swept even if its embedded pid is alive: the
+# pid has almost certainly been recycled by an unrelated long-lived
+# process (no real savez runs for days), and without a cap such orphans
+# would accumulate forever
+_TMP_SWEEP_FORCE_AGE_S = 7 * 86400.0
 
 
 def npz_path(path: str) -> str:
@@ -203,11 +211,27 @@ def npz_path(path: str) -> str:
     return path if path.endswith(".npz") else path + ".npz"
 
 
+def _writer_alive(tmp_name: str) -> bool:
+    """True if the pid embedded in ``<dst>.<pid>.tmp.npz`` is a live
+    process — its in-progress write must not be swept (a large-table
+    savez can legitimately outlast the normal age threshold; only the
+    multi-day force cap overrides this, guarding against pid reuse)."""
+    try:
+        pid = int(tmp_name.rsplit(".tmp.npz", 1)[0].rsplit(".", 1)[1])
+        os.kill(pid, 0)
+        return True
+    except (ValueError, IndexError, ProcessLookupError):
+        return False
+    except PermissionError:     # exists, owned by someone else
+        return True
+
+
 def atomic_savez(dst: str, payload: Dict[str, np.ndarray]) -> None:
     """Crash-safe npz write: savez to a pid-unique tmp then rename, so a
-    crash mid-write never clobbers the last good checkpoint.  Sweeps aged
-    orphan tmps from killed writers (age-guarded: a concurrent writer's
-    fresh in-progress file is left alone)."""
+    crash mid-write never clobbers the last good checkpoint.  Sweeps
+    orphan tmps from killed writers — only when the writing pid is dead
+    AND the file has aged (pid check guards long-running concurrent
+    writers; the age threshold guards pid reuse)."""
     os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
     tmp = f"{dst}.{os.getpid()}.tmp.npz"   # unique per writer
     now = time.time()
@@ -215,7 +239,9 @@ def atomic_savez(dst: str, payload: Dict[str, np.ndarray]) -> None:
         if stale == tmp:
             continue
         try:
-            if now - os.path.getmtime(stale) > _TMP_SWEEP_AGE_S:
+            age = now - os.path.getmtime(stale)
+            if age > _TMP_SWEEP_FORCE_AGE_S or (
+                    age > _TMP_SWEEP_AGE_S and not _writer_alive(stale)):
                 os.unlink(stale)
         except OSError:
             pass
@@ -260,8 +286,20 @@ def load_checkpoint(table: SparseTable, path: str) -> Dict[str, np.ndarray]:
             raise ValueError(
                 f"checkpoint has {int(z['num_shards'])} shards, table has "
                 f"{table.key_index.num_shards}")
-        if int(z["capacity_per_shard"]) != table.key_index.capacity_per_shard:
-            raise ValueError("capacity_per_shard mismatch")
+        saved_cap = int(z["capacity_per_shard"])
+        if saved_cap > table.key_index.capacity_per_shard:
+            # checkpoint written after SparseTable.grow(): adopt its
+            # capacity (the text path auto-grows for the same case; only
+            # shrink remains an error).  Bookkeeping only — state arrays
+            # and the index are overwritten from the npz just below, so
+            # SparseTable.grow()'s device-side remap (which transiently
+            # doubles HBM use) would be wasted work.
+            table.key_index.grow(saved_cap)
+        elif saved_cap < table.key_index.capacity_per_shard:
+            raise ValueError(
+                f"checkpoint capacity_per_shard {saved_cap} is smaller "
+                f"than the table's {table.key_index.capacity_per_shard}; "
+                "shrinking on load is not supported")
         state = {}
         for name in table.access.fields:
             state[name] = _replace(table, name, z[f"field__{name}"])

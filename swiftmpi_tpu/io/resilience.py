@@ -25,6 +25,7 @@ from typing import Dict
 
 import numpy as np
 
+from swiftmpi_tpu.cluster.bootstrap import host_array
 from swiftmpi_tpu.io.checkpoint import _replace, npz_path, save_checkpoint
 from swiftmpi_tpu.parameter.sparse_table import SparseTable
 from swiftmpi_tpu.utils.logger import get_logger
@@ -49,7 +50,9 @@ def load_checkpoint_elastic(table: SparseTable, path: str
         new_slots = np.asarray(table.key_index.lookup(keys), np.int64)
         state = dict(table.state)
         for name in table.access.fields:
-            arr = np.asarray(state[name]).copy()
+            # host_array, not np.asarray: state may be a non-fully-
+            # addressable global array in multi-process runs
+            arr = host_array(state[name]).copy()
             arr[new_slots] = z[f"field__{name}"][old_slots]
             state[name] = _replace(table, name, arr)
         table.state = state

@@ -180,4 +180,9 @@ class LogisticRegression:
         return dump_table_text(self.table, path, fields=("val",))
 
     def load(self, path: str) -> int:
-        return load_table_text(self.table, path, fields=("val",))
+        n = load_table_text(self.table, path, fields=("val",))
+        # loading may have grown the table; the jitted step bakes in the
+        # old capacity (count-normalization scatter bounds), so force a
+        # rebuild on next train()
+        self._step = None
+        return n

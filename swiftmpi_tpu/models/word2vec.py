@@ -447,6 +447,10 @@ class Word2Vec:
         if self.table is None:
             raise RuntimeError("build() or load() the model before resume()")
         extra = load_checkpoint(self.table, checkpoint_path)
+        # load_checkpoint grows the table for post-grow() checkpoints; any
+        # cached jitted step baked in the old capacity (the _mean_scale
+        # scatter bounds), so force a rebuild
+        self._step = None
         if self.vocab is not None:
             slots = self.table.key_index.lookup(self.vocab.keys)
             self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
@@ -464,7 +468,9 @@ class Word2Vec:
                 raise RuntimeError("set capacity_per_shard before load()")
             self.table = self.cluster.create_table(
                 "w2v", self.access, self._capacity_per_shard)
-        return load_table_text(self.table, path, fields=("v", "h"))
+        n = load_table_text(self.table, path, fields=("v", "h"))
+        self._step = None    # text load may have grown the table
+        return n
 
     def embedding(self, key: int) -> Optional[np.ndarray]:
         """Input-side (v) vector for an external key, or None."""

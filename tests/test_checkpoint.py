@@ -72,8 +72,12 @@ def test_binary_checkpoint_resume_exact(tmp_path):
 def test_binary_checkpoint_sweeps_stale_tmp(tmp_path):
     """A writer killed between savez and replace leaves its pid-suffixed
     tmp behind; the next save must sweep old orphans but never touch a
-    concurrent writer's fresh in-progress file."""
+    concurrent writer's in-progress file — even an *aged* one whose
+    writing pid is still alive (a big-table savez can outlast any age
+    threshold)."""
     import os
+    import subprocess
+    import sys
     import time
 
     from swiftmpi_tpu.io.checkpoint import npz_path
@@ -82,17 +86,30 @@ def test_binary_checkpoint_sweeps_stale_tmp(tmp_path):
     path = str(tmp_path / "ckpt")
     dst = npz_path(path)
     os.makedirs(tmp_path, exist_ok=True)
-    orphan = f"{dst}.99998.tmp.npz"
-    fresh = f"{dst}.99999.tmp.npz"
-    for p in (orphan, fresh):
-        with open(p, "w") as f:
-            f.write("partial write")
-    old = time.time() - 3600
-    os.utime(orphan, (old, old))
-    save_checkpoint(table, path)
-    assert not os.path.exists(orphan)      # aged orphan swept
-    assert os.path.exists(fresh)           # live writer's file untouched
-    assert os.path.exists(dst)
+    # a definitely-dead pid: a child that has already exited and been
+    # reaped cannot be signalled
+    dead = subprocess.Popen([sys.executable, "-c", "pass"])
+    dead.wait()
+    live = subprocess.Popen([sys.executable, "-c",
+                             "import time; time.sleep(60)"])
+    try:
+        orphan = f"{dst}.{dead.pid}.tmp.npz"
+        slow_writer = f"{dst}.{live.pid}.tmp.npz"
+        fresh_orphan = f"{dst}.{dead.pid + 100000}.tmp.npz"
+        for p in (orphan, slow_writer, fresh_orphan):
+            with open(p, "w") as f:
+                f.write("partial write")
+        old = time.time() - 3600
+        os.utime(orphan, (old, old))
+        os.utime(slow_writer, (old, old))
+        save_checkpoint(table, path)
+        assert not os.path.exists(orphan)       # aged dead-pid orphan swept
+        assert os.path.exists(slow_writer)      # live writer kept, however old
+        assert os.path.exists(fresh_orphan)     # young file kept (pid reuse)
+        assert os.path.exists(dst)
+    finally:
+        live.kill()
+        live.wait()
 
 
 def test_binary_checkpoint_shape_mismatch(tmp_path):
@@ -148,3 +165,28 @@ def test_text_load_grows_undersized_table(tmp_path):
             np.testing.assert_allclose(
                 np.asarray(small.state[f])[s2],
                 np.asarray(table.state[f])[s1], rtol=1e-6)
+
+
+def test_binary_checkpoint_grows_on_load(tmp_path):
+    """npz checkpoint saved after SparseTable.grow() loads into a model
+    built at the original configured capacity (symmetric with the text
+    path's auto-growth); shrink and shard-count mismatch still raise."""
+    table, ki = make_table(num_shards=2, cap=4)
+    ki.lookup(np.arange(6, dtype=np.uint64))
+    table.grow(16)
+    ki.lookup(np.arange(6, 20, dtype=np.uint64))
+    path = str(tmp_path / "ckpt")
+    save_checkpoint(table, path)
+
+    small, ki2 = make_table(num_shards=2, cap=4)
+    load_checkpoint(small, path)
+    assert ki2.capacity_per_shard == 16
+    for k in (0, 7, 19):
+        for f in table.access.fields:
+            np.testing.assert_array_equal(
+                np.asarray(small.state[f])[ki2.slot(k)],
+                np.asarray(table.state[f])[ki.slot(k)])
+
+    big, _ = make_table(num_shards=2, cap=64)
+    with pytest.raises(ValueError, match="shrink"):
+        load_checkpoint(big, path)

@@ -100,3 +100,29 @@ def test_lr_cli(tmp_path, devices8):
     assert main(["lr", "-mode", "predict", "-dataset", str(train_file),
                  "-param", weights, "-output", preds]) == 0
     assert len(open(preds).readlines()) == 80
+
+
+def test_lr_train_after_growing_load(tmp_path, devices8):
+    """load() can grow the table; the jitted step must be rebuilt so the
+    count-normalization scatter covers the new capacity (a stale step
+    silently drops normalization for slots >= old capacity)."""
+    wide = synthetic_dataset(300, dim=4000, nnz=6, seed=7)
+    donor = LogisticRegression(config=ConfigParser().update({
+        "cluster": {"server_num": 2, "transfer": "xla"},
+        "worker": {"minibatch": 50},
+        "server": {"initial_learning_rate": 0.5, "frag_num": 200},
+    }), capacity_per_shard=4096)
+    donor.train(wide, niters=1)
+    path = str(tmp_path / "w.txt")
+    donor.save(path)
+
+    model = LogisticRegression(config=donor.config, capacity_per_shard=64)
+    model.train(synthetic_dataset(40, dim=60, nnz=4, seed=8), niters=1)
+    assert model._step is not None
+    old_capacity = model.table.capacity
+    model.load(path)
+    assert model.table.capacity > old_capacity   # load grew the table
+    assert model._step is None                   # stale step invalidated
+    losses = model.train(wide, niters=2)
+    assert np.isfinite(losses).all()
+    assert losses[-1] <= losses[0]

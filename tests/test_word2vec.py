@@ -329,3 +329,28 @@ def test_w2v_cli(tmp_path, devices8):
     assert main(["w2v", "-config", str(conf), "-data", str(data),
                  "-niters", "1", "-output", out]) == 0
     assert len(open(out).readlines()) == 30
+
+
+def test_w2v_resume_after_grow_invalidates_step(tmp_path, devices8):
+    """resume() loading a post-grow() checkpoint must rebuild the jitted
+    step: the old one bakes the smaller capacity into its mean-scale
+    scatter, silently mis-normalizing rows in the grown region."""
+    corpus = synthetic_corpus(30, vocab_size=60, length=12, seed=9)
+    donor = make_model()
+    donor.train(corpus, niters=1, batch_size=64)
+    donor.table.grow()
+    path = str(tmp_path / "ckpt")
+    from swiftmpi_tpu.io.checkpoint import save_checkpoint
+    save_checkpoint(donor.table, path, extra={"iter": np.int64(1)})
+
+    model = make_model()
+    model.build(corpus)
+    model.train(corpus, niters=1, batch_size=64)
+    assert model._step is not None
+    old_cap = model.table.capacity
+    assert model.resume(path) == 1
+    assert model.table.capacity > old_cap    # checkpoint grew the table
+    assert model._step is None               # stale step invalidated
+    losses = model.train(corpus, niters=1, batch_size=64,
+                         start_iter=1)
+    assert np.isfinite(losses).all()
