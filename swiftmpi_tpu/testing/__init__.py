@@ -1,0 +1,13 @@
+"""Golden-model oracles for numerical parity testing.
+
+The reference framework can't run in this image (no MPI toolchain), so its
+training semantics are preserved here as sequential numpy oracles that
+tests — and benchmark baselines — compare against.
+"""
+
+from swiftmpi_tpu.testing.w2v_oracle import (W2VOracle, cbow_batch_grads,
+                                             exp_table_sigmoid,
+                                             gen_unigram_table)
+
+__all__ = ["W2VOracle", "cbow_batch_grads", "exp_table_sigmoid",
+           "gen_unigram_table"]
