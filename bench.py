@@ -61,6 +61,16 @@ FAST_FAIL_S = 90       # a child dying this fast is worth one retry
 # child: actually measure, on whichever platform the env selects
 # --------------------------------------------------------------------------
 
+def _fence(state, scalar):
+    """D2H timing fence: block_until_ready is NOT reliable through the
+    axon tunnel — it returned after 0.6ms while the remote TPU was still
+    executing (round-2 postmortem: 693M "words/s", 20x above the HBM
+    roofline).  Fetch both the step's scalar AND a state element so the
+    final table update is inside the fence (the scalar alone depends on
+    the last gradient phase but not its push)."""
+    return float(scalar) + float(next(iter(state.values()))[0, 0])
+
+
 def _build_w2v(device):
     import jax
     import jax.numpy as jnp
@@ -127,13 +137,15 @@ def _bench_w2v(device, timed_calls, built=None):
                                  masks, sub)
             return state, key, es
 
+        # the donated-state chain serializes the calls; one _fence after
+        # the loop forces the whole timed sequence (see _fence)
         for _ in range(WARMUP_CALLS):
             state, key, es = one(state, key)
-        jax.block_until_ready(state)
+        _fence(state, es)
         t0 = time.perf_counter()
         for _ in range(timed_calls):
             state, key, es = one(state, key)
-        jax.block_until_ready(state)
+        _fence(state, es)
         dt = time.perf_counter() - t0
         # the step donates (deletes) its input buffers — which may BE the
         # model's own (device_put to the same device is a no-op); repoint
@@ -184,12 +196,12 @@ def _bench_lr(device, timed_calls):
                 state, loss, n = step(state, slots, vals, mask, targets)
             return state, loss
 
-        state, _ = epoch(state)                       # warmup/compile
-        jax.block_until_ready(state)
+        state, loss = epoch(state)                    # warmup/compile
+        _fence(state, loss)
         t0 = time.perf_counter()
         for _ in range(timed_calls):
             state, loss = epoch(state)
-        jax.block_until_ready(state)
+        _fence(state, loss)
         dt = time.perf_counter() - t0
     rows = len(prepared) * LR_BATCH * timed_calls
     return {"rows_per_sec": rows / dt, "loss": float(loss)}
@@ -264,9 +276,14 @@ def _run_child(which: str, timeout_s: float):
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""   # flaky tunnel: never touch it
     else:
-        # the accelerator child must not inherit a cpu pin from a dev
-        # shell using the documented axon workaround
-        env.pop("JAX_PLATFORMS", None)
+        # Pin the accelerator child to the TPU plugin EXPLICITLY.  Left
+        # unset, the sitecustomize default "axon,cpu" silently falls back
+        # to cpu when the tunnel hiccups at init — the child then burns
+        # its whole run measuring the wrong platform (round-2 postmortem:
+        # both attempts landed on cpu while a direct axon probe minutes
+        # later succeeded).  Pinned, a tunnel hiccup dies in seconds and
+        # the parent's retry ladder gets a real second chance.
+        env["JAX_PLATFORMS"] = "axon"
         env.pop("PALLAS_AXON_POOL_IPS", None)
     t0 = time.time()
     try:
@@ -305,10 +322,14 @@ def parent_main() -> None:
     # measurement on this host and must not share cores with the TPU
     # child's host-side dispatch, or vs_baseline is inflated.
     tpu_res, tpu_err, dt = _run_child("tpu", TPU_TIMEOUT_S)
-    if tpu_res is None and dt < FAST_FAIL_S:
-        # fast failure (e.g. transient UNAVAILABLE at plugin init): retry
-        time.sleep(10)
-        tpu_res, retry_err, _ = _run_child("tpu", TPU_RETRY_TIMEOUT_S)
+    # transient UNAVAILABLE at plugin init dies in seconds (the child is
+    # pinned to axon, no silent cpu fallback): a backoff ladder rides out
+    # tunnel flakiness without blowing the overall budget
+    for backoff in (10, 45, 90):
+        if tpu_res is not None or dt >= FAST_FAIL_S:
+            break
+        time.sleep(backoff)
+        tpu_res, retry_err, dt = _run_child("tpu", TPU_RETRY_TIMEOUT_S)
         if tpu_res is None:
             tpu_err = f"{tpu_err}; retry: {retry_err}"
     if tpu_res is None:
