@@ -80,14 +80,16 @@ def _mean_scale(slots_flat, capacity):
 
 
 def _assemble_push(tf, cf, h_flat, v_flat, capacity):
-    """Mean-normalize per-key contributions and lay out the combined
-    (targets ++ contexts) slot/grad arrays for one transfer push."""
+    """Mean-normalize per-key contributions and lay out one push per
+    gradient family: h-grads keyed by target slots, v-grads keyed by
+    context slots.  (Round 1 concatenated both families into a single
+    zero-padded batch — which doubled every downstream push array and made
+    the transfer layer sort/gather/scatter 2x the rows, half of them
+    zeros.  Per-family pushes carry only real contributions; apply_push
+    handles partial grad dicts.)"""
     h_flat = h_flat * _mean_scale(tf, capacity)[:, None]
     v_flat = v_flat * _mean_scale(cf, capacity)[:, None]
-    slots = jnp.concatenate([tf, cf])
-    grads = {"h": jnp.concatenate([h_flat, jnp.zeros_like(v_flat)]),
-             "v": jnp.concatenate([jnp.zeros_like(h_flat), v_flat])}
-    return slots, grads
+    return ((tf, {"h": h_flat}), (cf, {"v": v_flat}))
 
 
 def w2v_formatter(row: Dict[str, np.ndarray]) -> str:
@@ -120,6 +122,10 @@ class Word2Vec:
             "word2vec", "min_sentence_length", 1).to_int32()
         self.minibatch = g("worker", "minibatch", 5000).to_int32()
         self.local_steps = g("word2vec", "local_steps", 1).to_int32()
+        # "" /"snapshot" (bounded-staleness via local_steps) / "hogwild"
+        # (genuinely unsynchronized per-device replicas, see
+        # _build_hogwild_step)
+        self.async_mode = g("word2vec", "async_mode", "").to_string()
         server_lr = g("server", "initial_learning_rate", 0.7).to_float()
 
         self.cluster = cluster or Cluster(self.config).initialize()
@@ -168,10 +174,10 @@ class Word2Vec:
         @partial(jax.jit, donate_argnums=0)
         def step(state, slot_of_vocab, alias_prob, alias_idx,
                  centers, contexts, ctx_mask, key):
-            slots, grads, es, ec = grads_fn(
+            pushes, es, ec = grads_fn(
                 state, slot_of_vocab, alias_prob, alias_idx,
                 centers, contexts, ctx_mask, key)
-            return apply_fn(state, slots, grads), es, ec
+            return apply_fn(state, pushes), es, ec
 
         return step
 
@@ -190,15 +196,95 @@ class Word2Vec:
 
             def body(state, xs):
                 c, x, m, k = xs
-                slots, grads, es, ec = grads_fn(
+                pushes, es, ec = grads_fn(
                     state, slot_of_vocab, alias_prob, alias_idx, c, x, m, k)
-                return apply_fn(state, slots, grads), (es, ec)
+                return apply_fn(state, pushes), (es, ec)
 
             state, (es, ec) = jax.lax.scan(
                 body, state, (centers_s, contexts_s, masks_s, keys))
             return state, es.sum(), ec.sum()
 
         return multi
+
+    def _build_hogwild_step(self, n_inner: int):
+        """Genuinely unsynchronized async SGD — the TPU rendering of the
+        reference's async/global variant (word2vec_global.h:577-651),
+        where worker threads pull/push against the server with NO barrier
+        and gradients are arbitrarily stale.
+
+        SPMD can't express literal thread races, but it can express their
+        semantics: every device becomes an independent worker with a FULL
+        replica of the table (the reference's LocalParamCache, taken to
+        its limit), trains ``n_inner`` batches on its own stream — own
+        negatives, own AdaGrad accumulation, zero cross-device traffic —
+        then all replicas' deltas are psum-reconciled into the base, so
+        every worker's pushes land exactly once, none serialized against
+        another's (the server summing pushes as they arrive).  Staleness
+        bound = ``n_inner`` batches x ``n_devices`` workers (the
+        reference's is unbounded only by thread scheduling).
+
+        Trades the row-sharded layout for replication during the async
+        phase (a vocab-scale table fits one device by orders of
+        magnitude); the ``data``/``model`` sharded layout is the sync
+        path's concern."""
+        if getattr(self.transfer, "name", "") == "tpu":
+            raise ValueError(
+                "async_mode=hogwild requires the gather/scatter 'xla' "
+                "transfer: each worker replica trains locally, and the "
+                "'tpu' backend's shard_map routing cannot nest inside the "
+                "per-worker mesh (set [cluster] transfer: xla)")
+        if jax.process_count() > 1:
+            raise NotImplementedError(
+                "async_mode=hogwild is a single-process SPMD mode (the "
+                "worker axis spans this process's devices); combine it "
+                "with multi-process dp by running sync dp across hosts "
+                "instead")
+        grads_fn = self._build_grads()
+        apply_fn = self._build_apply()
+        mesh = self.cluster.mesh
+        workers = mesh.devices.reshape(-1)
+        wmesh = jax.sharding.Mesh(workers, ("worker",))
+        n_workers = len(workers)
+
+        from jax.sharding import PartitionSpec as P
+
+        @partial(jax.shard_map, mesh=wmesh,
+                 in_specs=(P(), P(), P(), P(),
+                           P("worker"), P("worker"), P("worker"), P()),
+                 out_specs=(P(), P(), P()), check_vma=False)
+        def _workers(state, slot_of_vocab, alias_prob, alias_idx,
+                     centers_s, contexts_s, masks_s, key):
+            wid = jax.lax.axis_index("worker")
+            keys = jax.random.split(jax.random.fold_in(key, wid), n_inner)
+            # local batch-stack view is already (n_inner, B, ...): the
+            # global (n_workers * n_inner, ...) leading axis is sharded
+            centers_l, contexts_l, masks_l = centers_s, contexts_s, masks_s
+
+            def body(local, xs):
+                c, x, m, k = xs
+                pushes, es, ec = grads_fn(
+                    local, slot_of_vocab, alias_prob, alias_idx, c, x, m, k)
+                return apply_fn(local, pushes), (es, ec)
+
+            local, (es, ec) = jax.lax.scan(
+                body, state, (centers_l, contexts_l, masks_l, keys))
+            # reconcile: sum every worker's deltas into the shared base —
+            # params AND optimizer accumulators (the server saw all
+            # pushes); psum over the replicated base is divided back out.
+            new_state = {
+                f: state[f] + (jax.lax.psum(local[f], "worker")
+                               - n_workers * state[f])
+                for f in state}
+            return new_state, jax.lax.psum(es.sum(), "worker"), \
+                jax.lax.psum(ec.sum(), "worker")
+
+        @partial(jax.jit, donate_argnums=0)
+        def step(state, slot_of_vocab, alias_prob, alias_idx,
+                 centers_s, contexts_s, masks_s, key):
+            return _workers(state, slot_of_vocab, alias_prob, alias_idx,
+                            centers_s, contexts_s, masks_s, key)
+
+        return step, n_workers
 
     def _build_grads(self):
         """Gradient phase of the step: pull rows, CBOW- or skip-gram-NS
@@ -248,14 +334,14 @@ class Word2Vec:
             v_contrib = jnp.where(ctx_mask[..., None],
                                   neu1e[:, None, :], 0.0)         # (B,2W,d)
 
-            all_slots, grads = _assemble_push(
+            pushes = _assemble_push(
                 t_slots.reshape(-1), ctx_slots.reshape(-1),
                 h_contrib.reshape(-1, d), v_contrib.reshape(-1, d),
                 capacity)
 
             err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
             err_cnt = t_valid.sum()
-            return all_slots, grads, err_sum, err_cnt
+            return pushes, err_sum, err_cnt
 
         return grads_fn
 
@@ -307,14 +393,14 @@ class Word2Vec:
             v_contrib = jnp.einsum("bwk,bwkd->bwd", g, h_t)   # (B, W2, d)
             v_contrib = jnp.where(ctx_mask[..., None], v_contrib, 0.0)
 
-            all_slots, grads = _assemble_push(
+            pushes = _assemble_push(
                 t_slots.reshape(-1), ctx_slots.reshape(-1),
                 h_contrib.reshape(-1, d), v_contrib.reshape(-1, d),
                 capacity)
 
             err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
             err_cnt = t_valid.sum()
-            return all_slots, grads, err_sum, err_cnt
+            return pushes, err_sum, err_cnt
 
         return grads_fn
 
@@ -322,8 +408,10 @@ class Word2Vec:
         access = self.access
         transfer = self.transfer
 
-        def apply_fn(state, slots, grads):
-            return transfer.push(state, slots, grads, access)
+        def apply_fn(state, pushes):
+            for slots, grads in pushes:
+                state = transfer.push(state, slots, grads, access)
+            return state
 
         return apply_fn
 
@@ -362,9 +450,13 @@ class Word2Vec:
                 raise RuntimeError(
                     "call build()/build_from_vocab() before train() with a "
                     "vocab-less batcher")
-        sync = self.local_steps <= 1
+        hogwild = self.async_mode == "hogwild"
+        sync = self.local_steps <= 1 and not hogwild
         if self._step is None:
-            if sync:
+            if hogwild:
+                self._step = self._build_hogwild_step(
+                    max(self.local_steps, 1))
+            elif sync:
                 self._step = self._build_step()
             else:
                 self._step = (jax.jit(self._build_grads()),
@@ -394,33 +486,39 @@ class Word2Vec:
         step_i = 0
         for it in range(niters):
             err_sum, err_cnt = 0.0, 0
-            for batch in batcher.epoch(batch_size):
-                self._key, sub = jax.random.split(self._key)
-                args = (self._slot_of_vocab, self._alias_prob,
-                        self._alias_idx, _dev(batch.centers),
-                        _dev(batch.contexts), _dev(batch.ctx_mask), sub)
-                if sync:
-                    state, es, ec = self._step(state, *args)
-                    # the step donates (deletes) the input state buffers;
-                    # repoint the table at the live ones immediately so an
-                    # abnormal exit (raise, Ctrl-C) never strands the model
-                    # with deleted arrays
-                    self.table.state = state
-                else:
-                    # async/global variant semantics (word2vec_global.h:
-                    # 577-651): grads computed against a stale snapshot,
-                    # pushes land immediately; snapshot refreshes every
-                    # local_steps batches => bounded staleness.
-                    grads_fn, apply_fn = self._step
-                    slots, grads, es, ec = grads_fn(frozen, *args)
-                    state = apply_fn(state, slots, grads)
-                    self.table.state = state
-                    step_i += 1
-                    if step_i % self.local_steps == 0:
-                        frozen = state
-                err_sum += float(es)
-                err_cnt += int(ec)
-                meter.record(batch.n_words)
+            if hogwild:
+                err_sum, err_cnt = self._hogwild_epoch(
+                    batcher, batch_size, meter)
+                state = self.table.state
+            else:
+                for batch in batcher.epoch(batch_size):
+                    self._key, sub = jax.random.split(self._key)
+                    args = (self._slot_of_vocab, self._alias_prob,
+                            self._alias_idx, _dev(batch.centers),
+                            _dev(batch.contexts), _dev(batch.ctx_mask), sub)
+                    if sync:
+                        state, es, ec = self._step(state, *args)
+                        # the step donates (deletes) the input state
+                        # buffers; repoint the table at the live ones
+                        # immediately so an abnormal exit (raise, Ctrl-C)
+                        # never strands the model with deleted arrays
+                        self.table.state = state
+                    else:
+                        # async/global variant, bounded-staleness flavor
+                        # (word2vec_global.h:577-651): grads computed
+                        # against a stale snapshot, pushes land
+                        # immediately; snapshot refreshes every
+                        # local_steps batches => bounded staleness.
+                        grads_fn, apply_fn = self._step
+                        pushes, es, ec = grads_fn(frozen, *args)
+                        state = apply_fn(state, pushes)
+                        self.table.state = state
+                        step_i += 1
+                        if step_i % self.local_steps == 0:
+                            frozen = state
+                    err_sum += float(es)
+                    err_cnt += int(ec)
+                    meter.record(batch.n_words)
             loss = err_sum / max(err_cnt, 1)
             losses.append(loss)
             log.info("iter %d: error %.5f  (%.0f words/s)",
@@ -437,6 +535,53 @@ class Word2Vec:
                          checkpoint_path)
         self.table.state = state
         return losses
+
+    def _hogwild_epoch(self, batcher, batch_size: int, meter) -> tuple:
+        """One epoch in hogwild mode: group ``n_workers * local_steps``
+        fixed-shape batches per dispatch, one per worker-step.  A tail
+        too short for a full group is dropped and logged (workers in the
+        reference's async mode likewise end an iteration unevenly —
+        word2vec_global.h:630-651 joins threads wherever they ran out)."""
+        step, n_workers = self._step
+        group = n_workers * max(self.local_steps, 1)
+        state = self.table.state
+        err_sum, err_cnt = 0.0, 0
+        buf = []
+        dropped = 0
+        for batch in batcher.epoch(batch_size):
+            if len(batch.centers) != batch_size:
+                dropped += batch.n_words
+                continue
+            buf.append(batch)
+            if len(buf) < group:
+                continue
+            self._key, sub = jax.random.split(self._key)
+            c = jnp.stack([jnp.asarray(b.centers) for b in buf])
+            x = jnp.stack([jnp.asarray(b.contexts) for b in buf])
+            m = jnp.stack([jnp.asarray(b.ctx_mask) for b in buf])
+            state, es, ec = step(state, self._slot_of_vocab,
+                                 self._alias_prob, self._alias_idx,
+                                 c, x, m, sub)
+            self.table.state = state
+            err_sum += float(es)
+            err_cnt += int(ec)
+            meter.record(sum(b.n_words for b in buf))
+            buf = []
+        if buf:
+            dropped += sum(b.n_words for b in buf)
+        if err_cnt == 0:
+            raise RuntimeError(
+                f"hogwild epoch dispatched NO group: the corpus yielded "
+                f"fewer than {group} full batches of {batch_size} centers "
+                f"(group = {group // max(self.local_steps, 1)} workers x "
+                f"{max(self.local_steps, 1)} local_steps).  Lower "
+                f"batch_size/local_steps or use more data — otherwise the "
+                f"run would silently train nothing")
+        if dropped:
+            log.info("hogwild: %d tail words skipped this iter (need "
+                     "full groups of %d batches x %d centers)",
+                     dropped, group, batch_size)
+        return err_sum, err_cnt
 
     def resume(self, checkpoint_path: str) -> int:
         """Restore a mid-training checkpoint; returns the iteration it was

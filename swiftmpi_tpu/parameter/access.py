@@ -68,8 +68,21 @@ class AccessMethod:
 
     def apply_push(self, params: Dict[str, jax.Array],
                    grads: Dict[str, jax.Array]) -> Dict[str, jax.Array]:
-        """Pure row-batch update: (fields, grads) -> new fields."""
+        """Pure row-batch update: (fields, grads) -> the UPDATED fields
+        only.  ``grads`` may carry a subset of ``grad_fields`` — rules
+        whose grad is absent are skipped, so a caller can push gradient
+        families independently (e.g. word2vec h-grads keyed by target
+        slots and v-grads keyed by context slots in separate pushes,
+        rather than zero-padding both into one combined batch)."""
         raise NotImplementedError
+
+    def touched_fields(self, grad_fields) -> Tuple[str, ...]:
+        """Fields ``apply_push`` READS OR WRITES given these grad entries
+        — sparse push paths gather exactly these rows and re-scatter the
+        written subset.  An access method whose rule reads a field it
+        does not update must include it here, or the row-batched
+        ``params`` handed to ``apply_push`` will be missing it."""
+        return tuple(self.fields)
 
 
 @dataclass
@@ -104,8 +117,10 @@ class AdaGradAccess(AccessMethod):
                 raise ValueError(f"rule {r} references unknown field")
 
     def apply_push(self, params, grads):
-        out = dict(params)
+        out = {}
         for r in self.rules:
+            if r.grad not in grads:
+                continue
             g = grads[r.grad].astype(jnp.float32)
             accum = params[r.accum] + jnp.square(g)
             out[r.accum] = accum
@@ -113,6 +128,14 @@ class AdaGradAccess(AccessMethod):
                 self.learning_rate * g
                 * jax.lax.rsqrt(accum + self.fudge_factor))
         return out
+
+    def touched_fields(self, grad_fields):
+        gf = set(grad_fields)
+        out = []
+        for r in self.rules:
+            if r.grad in gf:
+                out += [r.param, r.accum]
+        return tuple(out)
 
 
 class PallasAdaGradAccess(AdaGradAccess):
@@ -127,8 +150,10 @@ class PallasAdaGradAccess(AdaGradAccess):
         from swiftmpi_tpu.ops.pallas_kernels import (adagrad_update,
                                                      default_interpret)
         interpret = default_interpret()
-        out = dict(params)
+        out = {}
         for r in self.rules:
+            if r.grad not in grads:
+                continue
             g = grads[r.grad].astype(jnp.float32)
             p2, a2 = adagrad_update(
                 params[r.param], params[r.accum], g,
@@ -179,7 +204,11 @@ class SGDAccess(AccessMethod):
         self.grad_fields = tuple(grad_fields)
 
     def apply_push(self, params, grads):
-        out = dict(params)
+        out = {}
         for name in self.grad_fields:
-            out[name] = params[name] + self.learning_rate * grads[name]
+            if name in grads:
+                out[name] = params[name] + self.learning_rate * grads[name]
         return out
+
+    def touched_fields(self, grad_fields):
+        return tuple(f for f in self.grad_fields if f in set(grad_fields))

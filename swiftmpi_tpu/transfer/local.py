@@ -34,16 +34,17 @@ class LocalTransfer(Transfer):
         valid = slots >= 0
         uniq = np.unique(slots[valid])
         combined = {}
-        for f in access.grad_fields:
+        for f in grads:
             g = np.asarray(grads[f], np.float32)
             width = g.shape[1]
             acc = np.zeros((len(uniq), width), np.float32)
             pos = np.searchsorted(uniq, slots[valid])
             np.add.at(acc, pos, g[valid])
             combined[f] = acc
-        current = {f: np.asarray(state[f])[uniq] for f in access.fields}
+        current = {f: np.asarray(state[f])[uniq]
+                   for f in access.touched_fields(grads)}
         updated = access.apply_push(current, combined)
         out = {f: np.asarray(state[f]).copy() for f in state}
-        for f in access.fields:
+        for f in updated:
             out[f][uniq] = np.asarray(updated[f])
         return out

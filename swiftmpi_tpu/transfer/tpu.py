@@ -66,20 +66,81 @@ class TpuTransfer(Transfer):
     name = "tpu"
 
     def __init__(self, mesh: Mesh, axis: str = SHARD_AXIS,
-                 bucket_capacity: Optional[int] = None):
+                 bucket_capacity: Optional[int] = None,
+                 debug_overflow: bool = False):
         """``bucket_capacity``: per-destination request slots; defaults to
         the full local batch (no overflow possible).  Smaller values cut
         all_to_all volume ~proportionally but drop overflow requests —
         only safe when keys are known to spread (reference demo configs
-        rely on the same spread via frag_num >> server_num)."""
+        rely on the same spread via frag_num >> server_num).
+
+        When a capacity is set, every pull/push also counts globally how
+        many valid requests overflowed their bucket; the running total is
+        readable via :meth:`overflow_count` (and mirrored into ``metrics``
+        if one is attached).  With ``debug_overflow=True`` each call
+        synchronously checks the count and raises — slow, but turns silent
+        training corruption into an immediate failure."""
         self.mesh = mesh
         self.axis = axis
         self.n = int(mesh.shape[axis])
         self.bucket_capacity = bucket_capacity
+        self.debug_overflow = debug_overflow
+        self.metrics = None              # optional utils.timers.Metrics
+        self._overflow_total = 0
+        self._overflow_pending: list = []   # eager-path device scalars
         # jitted shard_map closures, keyed by static shape signature —
         # without this every pull/push call would re-trace and recompile.
         self._pull_cache: Dict = {}
         self._push_cache: Dict = {}
+
+    # -- overflow accounting ----------------------------------------------
+    def _accum_overflow(self, op: str, count) -> None:
+        c = int(count)
+        self._overflow_total += c
+        if self.debug_overflow and c:
+            raise RuntimeError(
+                f"TpuTransfer.{op}: {c} request(s) overflowed "
+                f"bucket_capacity={self.bucket_capacity} and were "
+                "DROPPED — raise bucket_capacity (or leave it unset "
+                "for the overflow-free default)")
+
+    def _record_overflow(self, op: str, count) -> None:
+        """Accumulate a per-call overflow count on the host.
+
+        Under an outer trace (the model's jitted/scanned training step)
+        the count is a tracer: it is staged via ``jax.debug.callback`` so
+        it fires on every compiled execution — a plain Python side effect
+        would leak the tracer and count only the trace-time call.  Called
+        eagerly, the concrete device scalar is queued and materialized
+        only in :meth:`overflow_count`, so the async-dispatch pipeline is
+        never stalled by a per-push D2H sync.  ``debug_overflow`` opts
+        into the synchronous (slow, loud) eager check; from compiled code
+        its raise surfaces at the next sync point."""
+        if isinstance(count, jax.core.Tracer):
+            jax.debug.callback(partial(self._accum_overflow, op), count)
+        elif self.debug_overflow:
+            self._accum_overflow(op, count)     # synchronous, documented slow
+        else:
+            self._overflow_pending.append(count)
+            if len(self._overflow_pending) >= 1024:
+                # drain so the list (and its pinned device scalars) can't
+                # grow unboundedly when overflow_count() is never called;
+                # by now these executions have long completed, so the
+                # int() materialization is not a pipeline stall
+                pending, self._overflow_pending = self._overflow_pending, []
+                self._overflow_total += sum(int(c) for c in pending)
+
+    def overflow_count(self) -> int:
+        """Total requests dropped by bucket overflow since construction
+        (flushes queued eager counts and pending traced callbacks); 0 when
+        no capacity is set (overflow impossible by construction)."""
+        jax.effects_barrier()
+        pending, self._overflow_pending = self._overflow_pending, []
+        self._overflow_total += sum(int(c) for c in pending)
+        total = self._overflow_total
+        if self.metrics is not None:
+            self.metrics.set("transfer_overflow_dropped", total)
+        return total
 
     def _signature(self, state, slots, grads=None):
         sig = (tuple(sorted((f, v.shape, str(v.dtype))
@@ -98,17 +159,23 @@ class TpuTransfer(Transfer):
         if fn is None:
             fn = self._pull_cache.setdefault(
                 sig, jax.jit(self._build_pull(state, access)))
-        return fn(state, slots)
+        if self.bucket_capacity is None:
+            return fn(state, slots)
+        out, ovf = fn(state, slots)
+        self._record_overflow("pull", ovf)
+        return out
 
     def _build_pull(self, state, access):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
         state_specs = {f: P(self.axis) for f in state}
         pull_specs = {f: P(self.axis) for f in access.pull_fields}
+        counted = self.bucket_capacity is not None
+        out_specs = (pull_specs, P()) if counted else pull_specs
 
         @partial(jax.shard_map, mesh=self.mesh,
                  in_specs=(state_specs, P(self.axis)),
-                 out_specs=pull_specs, check_vma=False)
+                 out_specs=out_specs, check_vma=False)
         def _pull(state_l, slots_l):
             B = slots_l.shape[0]
             C = self.bucket_capacity or B
@@ -127,7 +194,11 @@ class TpuTransfer(Transfer):
                 vals = vals * ((so < self.n) & (idx < C))[:, None]
                 out[f] = jnp.zeros((B, vals.shape[1]),
                                    vals.dtype).at[order].set(vals)
-            return out
+            if not counted:
+                return out
+            ovf = jax.lax.psum(
+                jnp.sum((so < self.n) & (idx >= C)), self.axis)
+            return out, ovf
 
         return _pull
 
@@ -138,18 +209,25 @@ class TpuTransfer(Transfer):
         fn = self._push_cache.get(sig)
         if fn is None:
             fn = self._push_cache.setdefault(
-                sig, jax.jit(self._build_push(state, access)))
-        return fn(state, slots, grads)
+                sig, jax.jit(self._build_push(state, access,
+                                              tuple(sorted(grads)))))
+        if self.bucket_capacity is None:
+            return fn(state, slots, grads)
+        out, ovf = fn(state, slots, grads)
+        self._record_overflow("push", ovf)
+        return out
 
-    def _build_push(self, state, access):
+    def _build_push(self, state, access, grad_fields):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
         state_specs = {f: P(self.axis) for f in state}
-        grad_specs = {f: P(self.axis) for f in access.grad_fields}
+        grad_specs = {f: P(self.axis) for f in grad_fields}
+        counted = self.bucket_capacity is not None
+        out_specs = (state_specs, P()) if counted else state_specs
 
         @partial(jax.shard_map, mesh=self.mesh,
                  in_specs=(state_specs, P(self.axis), grad_specs),
-                 out_specs=state_specs, check_vma=False)
+                 out_specs=out_specs, check_vma=False)
         def _push(state_l, slots_l, grads_l):
             B = slots_l.shape[0]
             C = self.bucket_capacity or B
@@ -161,7 +239,7 @@ class TpuTransfer(Transfer):
             # untouched rows get exact zero and the access rule is a no-op.
             safe_rows = jnp.where(ok, got, cap_per_shard).reshape(-1)
             dense = {}
-            for f in access.grad_fields:
+            for f in grad_fields:
                 g = jnp.asarray(grads_l[f])
                 width = g.shape[1]
                 # forward my buckets' grads in the same (n, C) layout
@@ -178,6 +256,10 @@ class TpuTransfer(Transfer):
             new_fields = access.apply_push(state_l, dense)
             out = dict(state_l)
             out.update(new_fields)
-            return out
+            if not counted:
+                return out
+            ovf = jax.lax.psum(
+                jnp.sum((so < self.n) & (idx >= C)), self.axis)
+            return out, ovf
 
         return _push

@@ -40,8 +40,15 @@ def _masked_gather(arr: jax.Array, slots: jax.Array,
 class XlaTransfer(Transfer):
     name = "xla"
 
-    def __init__(self, dense_apply: bool = False):
-        self.dense_apply = bool(dense_apply)
+    def __init__(self, dense_apply: bool | None = None):
+        """``dense_apply``: True forces the dense full-table push, False
+        forces the sort-based sparse push, None (default) picks per call —
+        dense when the push batch is at least half the table capacity.
+        At that point the sparse path's sort + per-row gather/scatter
+        irregularity costs more than sweeping the table once (the
+        crossover is measured in docs/ARCHITECTURE.md; word2vec-scale
+        batches over demo-conf-scale tables land far on the dense side)."""
+        self.dense_apply = dense_apply
 
     # -- pull (global_pull_access.h:28-43 equivalent) ----------------------
     def pull(self, state, slots, access):
@@ -53,7 +60,11 @@ class XlaTransfer(Transfer):
     # -- push (global_push_access.h:26-43 + server.h:159-176) --------------
     def push(self, state, slots, grads, access):
         slots = jnp.asarray(slots, jnp.int32)
-        if self.dense_apply:
+        capacity = next(iter(state.values())).shape[0]
+        dense = self.dense_apply
+        if dense is None:
+            dense = slots.shape[0] >= capacity // 2
+        if dense:
             return self._push_dense(state, slots, grads, access)
         return self._push_sparse(state, slots, grads, access)
 
@@ -63,7 +74,7 @@ class XlaTransfer(Transfer):
         # OOB scatter indices are dropped by XLA; route padding there.
         safe = jnp.where(valid, slots, capacity)
         dense_grads = {}
-        for f in access.grad_fields:
+        for f in grads:
             g = jnp.asarray(grads[f])
             width = state[f].shape[1]
             acc = jnp.zeros((capacity, width), g.dtype)
@@ -96,18 +107,21 @@ class XlaTransfer(Transfer):
         safe_rep = jnp.where(rep_valid, rep_slots, 0)
 
         combined = {}
-        for f in access.grad_fields:
+        for f in grads:
             g = jnp.asarray(grads[f])[order]
             width = g.shape[1]
             acc = jnp.zeros((B, width), g.dtype)
             combined[f] = acc.at[seg_ids].add(g, mode="drop")
 
-        current = {f: jnp.take(state[f], safe_rep, axis=0)
-                   for f in access.fields}
+        # only the fields this push's grad families actually update are
+        # gathered and re-scattered (a partial push must not round-trip
+        # the untouched fields' rows through HBM for nothing)
+        touched = access.touched_fields(grads)
+        current = {f: jnp.take(state[f], safe_rep, axis=0) for f in touched}
         updated = access.apply_push(current, combined)
 
         out = dict(state)
-        for f in access.fields:
+        for f in updated:
             # Unused segments' representatives stay == capacity: OOB, dropped.
             out[f] = state[f].at[rep_slots].set(updated[f], mode="drop")
         return out

@@ -80,10 +80,10 @@ def test_multi_step_scan_matches_single_steps(devices8):
     s = dict(model.table.state)
     keys = jax.random.split(key, 2)
     for i in range(2):
-        slots, grads, _, _ = grads_fn(
+        pushes, _, _ = grads_fn(
             s, model._slot_of_vocab, model._alias_prob, model._alias_idx,
             centers[i], contexts[i], masks[i], keys[i])
-        s = apply_fn(s, slots, grads)
+        s = apply_fn(s, pushes)
     for f in s:
         np.testing.assert_allclose(np.asarray(s[f]),
                                    np.asarray(s_multi[f]),

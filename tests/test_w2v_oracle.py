@@ -70,13 +70,14 @@ def test_unigram_table_proportions():
 
 def _dense_grads_from_step(model, state, centers, contexts, ctx_mask, key):
     """Run the model's gradient phase and scatter its per-contribution
-    grads into dense vocab-id space for comparison."""
+    grads into dense vocab-id space for comparison.  The gradient phase
+    emits one push per family: (target_slots, {"h": ...}) and
+    (context_slots, {"v": ...})."""
     grads_fn = model._build_grads()
-    all_slots, grads, es, ec = grads_fn(
+    pushes, es, ec = grads_fn(
         state, model._slot_of_vocab, model._alias_prob, model._alias_idx,
         jnp.asarray(centers), jnp.asarray(contexts), jnp.asarray(ctx_mask),
         key)
-    slots = np.asarray(all_slots)
     # invert slot -> vocab id (key); slots are unique per vocab entry
     slot_to_key = {}
     for k, i in zip(model.vocab.keys.tolist(),
@@ -85,11 +86,12 @@ def _dense_grads_from_step(model, state, centers, contexts, ctx_mask, key):
     V = int(model.vocab.keys.max()) + 1
     d = model.len_vec
     dense = {f: np.zeros((V, d), np.float64) for f in ("h", "v")}
-    for f in ("h", "v"):
-        g = np.asarray(grads[f], np.float64)
-        for j, s in enumerate(slots.tolist()):
-            if s >= 0:
-                dense[f][slot_to_key[s]] += g[j]
+    for slots_j, grads in pushes:
+        for f, g in grads.items():
+            g = np.asarray(g, np.float64)
+            for j, s in enumerate(np.asarray(slots_j).tolist()):
+                if s >= 0:
+                    dense[f][slot_to_key[s]] += g[j]
     return dense["h"], dense["v"], float(es), int(ec)
 
 

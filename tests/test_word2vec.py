@@ -180,19 +180,21 @@ def test_w2v_skipgram_grads_match_numpy():
     batch = next(batcher.epoch(16))
     grads_fn = jax.jit(model._build_grads())
     key = jax.random.key(7)
-    slots, grads, es, ec = grads_fn(
+    pushes, es, ec = grads_fn(
         model.table.state, model._slot_of_vocab, model._alias_prob,
         model._alias_idx, jnp.asarray(batch.centers),
         jnp.asarray(batch.contexts), jnp.asarray(batch.ctx_mask), key)
-    slots, es, ec = np.asarray(slots), float(es), int(ec)
-    gh, gv = np.asarray(grads["h"]), np.asarray(grads["v"])
+    es, ec = float(es), int(ec)
+    (tslots_flat, hgrads), (cslots_flat, vgrads) = pushes
+    tslots_flat, cslots_flat = np.asarray(tslots_flat), np.asarray(cslots_flat)
+    gh, gv = np.asarray(hgrads["h"]), np.asarray(vgrads["v"])
 
-    # numpy reference: recompute from the same sampled negatives (first
-    # B*W2*K of the slots tensor layout: [center|negs] per pair)
+    # numpy reference: recompute from the same sampled negatives
+    # (target-slot layout: [center|negs] per pair)
     B, W2 = batch.contexts.shape
     K = model.negative
     d = model.len_vec
-    t_slots = slots[:B * W2 * (K + 1)].reshape(B, W2, K + 1)
+    t_slots = tslots_flat.reshape(B, W2, K + 1)
     sov = np.asarray(model._slot_of_vocab)
     h_tab = np.asarray(model.table.state["h"])
     v_tab = np.asarray(model.table.state["v"])
@@ -229,14 +231,15 @@ def test_w2v_skipgram_grads_match_numpy():
 
     assert n_valid == ec
     np.testing.assert_allclose(exp_err, es, rtol=2e-3)
-    # scatter-summed device grads per slot
+    # scatter-summed device grads per slot, one push per family
     dev_h = {}
     dev_v = {}
-    for i, s in enumerate(slots):
-        if s < 0:
-            continue
-        dev_h[s] = dev_h.get(s, 0) + gh[i]
-        dev_v[s] = dev_v.get(s, 0) + gv[i]
+    for i, s in enumerate(tslots_flat):
+        if s >= 0:
+            dev_h[s] = dev_h.get(s, 0) + gh[i]
+    for i, s in enumerate(cslots_flat):
+        if s >= 0:
+            dev_v[s] = dev_v.get(s, 0) + gv[i]
     for s, a in acc_h.items():
         np.testing.assert_allclose(dev_h[s], a / cnt_h[s],
                                    rtol=2e-3, atol=1e-6)
@@ -354,3 +357,62 @@ def test_w2v_resume_after_grow_invalidates_step(tmp_path, devices8):
     losses = model.train(corpus, niters=1, batch_size=64,
                          start_iter=1)
     assert np.isfinite(losses).all()
+
+
+# -- async modes (word2vec_global.h:577-651) ------------------------------
+
+def test_w2v_hogwild_trains_and_matches_sync_loss(devices8):
+    """Genuinely unsynchronized mode: 8 independent worker replicas,
+    delta-sum reconciliation.  Must converge, and land near the sync
+    mode's final loss on the same corpus."""
+    corpus = synthetic_corpus(150, vocab_size=50, length=12, seed=4)
+
+    sync = make_model()
+    sync_losses = sync.train(corpus, niters=3, batch_size=16)
+
+    hw = make_model(word2vec={"async_mode": "hogwild"})
+    hw_losses = hw.train(corpus, niters=3, batch_size=16)
+
+    assert hw_losses[-1] < hw_losses[0]
+    assert abs(hw_losses[-1] - sync_losses[-1]) / sync_losses[-1] < 0.3, (
+        hw_losses, sync_losses)
+    # the reconciled table must actually have moved every field family
+    st = hw.table.state
+    assert float(jnp.abs(st["h2sum"]).sum()) > 0
+    assert float(jnp.abs(st["v2sum"]).sum()) > 0
+
+
+def test_w2v_staleness_sweep(devices8):
+    """VERDICT round-1 item 5: loss vs staleness.  local_steps in
+    {1, 4, 16} (snapshot mode) and hogwild: all variants must converge
+    on the same corpus, with final losses in a band around sync —
+    demonstrating where bounded staleness matches the reference's
+    unsynchronized semantics."""
+    corpus = synthetic_corpus(150, vocab_size=50, length=12, seed=11)
+    finals = {}
+    for name, overrides in (
+            ("sync", {}),
+            ("stale4", {"local_steps": 4}),
+            ("stale16", {"local_steps": 16}),
+            ("hogwild4", {"async_mode": "hogwild", "local_steps": 4})):
+        m = make_model(word2vec=overrides)
+        losses = m.train(corpus, niters=3, batch_size=16)
+        assert losses[-1] < losses[0], (name, losses)
+        finals[name] = losses[-1]
+    base = finals["sync"]
+    for name, f in finals.items():
+        assert abs(f - base) / base < 0.35, finals
+
+
+def test_w2v_hogwild_guards(devices8):
+    corpus = synthetic_corpus(150, vocab_size=50, length=12, seed=4)
+    # transfer=tpu cannot nest inside the per-worker mesh: clear error
+    m = make_model(word2vec={"async_mode": "hogwild"},
+                   cluster={"transfer": "tpu"})
+    with pytest.raises(ValueError, match="transfer: xla"):
+        m.train(corpus, niters=1, batch_size=16)
+    # an epoch that can't fill one worker group must raise, not silently
+    # report 0.0 loss
+    m2 = make_model(word2vec={"async_mode": "hogwild", "local_steps": 64})
+    with pytest.raises(RuntimeError, match="dispatched NO group"):
+        m2.train(corpus, niters=1, batch_size=64)
