@@ -64,16 +64,22 @@ class Cluster:
         backend = (self.config.get("cluster", "transfer").to_string()
                    if self.config.has("cluster", "transfer") else "xla")
         if backend == "tpu":
-            # explicit routing wants the 1-D both-roles mesh: every device
-            # is worker+server, so shard count == device count.
-            if n_servers != len(devices):
+            # explicit routing wants the both-roles mesh: every device is
+            # worker+server.  Single-process: 1-D, shard count == device
+            # count.  Multi-process: hybrid (data x shard) — the shard
+            # routing axis stays within each process (ICI), data groups
+            # replicate the table and reconcile via one dense psum per
+            # push (the only DCN traffic).  See ps_mesh/TpuTransfer.
+            self.mesh = ps_mesh(devices=devices, hybrid=multi_process)
+            shard_size = int(self.mesh.shape[SHARD_AXIS])
+            if (n_servers != shard_size
+                    and self.config.has("cluster", "server_num")):
                 log.warning(
-                    "transfer=tpu runs every device as a server; "
-                    "overriding server_num=%d -> %d", n_servers,
-                    len(devices))
-            self.mesh = ps_mesh(devices=devices)
+                    "transfer=tpu sizes the server count by its shard "
+                    "axis; overriding server_num=%d -> %d", n_servers,
+                    shard_size)
             self.table_axis = SHARD_AXIS
-            n_servers = len(devices)
+            n_servers = shard_size
         else:
             if len(devices) % n_servers:
                 raise ValueError(

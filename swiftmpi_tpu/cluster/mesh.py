@@ -117,12 +117,33 @@ def build_mesh(spec: Optional[MeshSpec] = None,
 
 
 def ps_mesh(n: Optional[int] = None,
-            devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    """1-D ``shard`` mesh: every device is both worker and server, the
-    reference's default deployment (cluster/cluster.h:65-71)."""
+            devices: Optional[Sequence[jax.Device]] = None,
+            hybrid: bool = False) -> Mesh:
+    """``shard`` mesh: every device is both worker and server, the
+    reference's default deployment (cluster/cluster.h:65-71).
+
+    Single-host: 1-D ``(shard,)`` over all devices.  With ``hybrid`` and
+    multiple processes: 2-D ``(data, shard)`` — the shard axis (which
+    carries the all_to_all request/response routing every step) stays
+    WITHIN each process so it rides ICI; each process group holds a full
+    table replica and only the push's dense gradient psum crosses DCN
+    (the reference's multi-node deployment instead sent every pull/push
+    over TCP, cluster.h:63-110)."""
     devices = list(jax.devices() if devices is None else devices)
     if n is not None:
         devices = devices[:n]
+    if hybrid and jax.process_count() > 1:
+        n_proc = jax.process_count()
+        if len(devices) % n_proc:
+            raise ValueError(
+                f"{len(devices)} devices not divisible by {n_proc} "
+                "processes for the hybrid shard mesh")
+        local = len(devices) // n_proc
+        n_slices = len({getattr(d, "slice_index", None) for d in devices})
+        dev_array = mesh_utils.create_hybrid_device_mesh(
+            mesh_shape=(1, local), dcn_mesh_shape=(n_proc, 1),
+            devices=devices, process_is_granule=n_slices != n_proc)
+        return Mesh(dev_array, (DATA_AXIS, SHARD_AXIS))
     return Mesh(np.asarray(devices), (SHARD_AXIS,))
 
 

@@ -34,7 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
-from swiftmpi_tpu.cluster.mesh import SHARD_AXIS
+from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
 from swiftmpi_tpu.transfer.api import Transfer
 
 
@@ -83,6 +83,12 @@ class TpuTransfer(Transfer):
         self.mesh = mesh
         self.axis = axis
         self.n = int(mesh.shape[axis])
+        # hybrid multi-host mesh (ps_mesh(hybrid=True)): a leading data
+        # axis across processes/DCN.  Each data group holds a full table
+        # replica and routes requests over its own shard axis (ICI); the
+        # groups are reconciled by one dense-grad psum per push — the only
+        # traffic that crosses DCN.
+        self.dp_axis = DATA_AXIS if DATA_AXIS in mesh.axis_names else None
         self.bucket_capacity = bucket_capacity
         self.debug_overflow = debug_overflow
         self.metrics = None              # optional utils.timers.Metrics
@@ -165,16 +171,23 @@ class TpuTransfer(Transfer):
         self._record_overflow("pull", ovf)
         return out
 
+    def _batch_spec(self):
+        """Request/response arrays: sharded over every device (the data
+        groups each carry their own slice of the global batch)."""
+        return P((self.dp_axis, self.axis)) if self.dp_axis \
+            else P(self.axis)
+
     def _build_pull(self, state, access):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
+        bspec = self._batch_spec()
         state_specs = {f: P(self.axis) for f in state}
-        pull_specs = {f: P(self.axis) for f in access.pull_fields}
+        pull_specs = {f: bspec for f in access.pull_fields}
         counted = self.bucket_capacity is not None
         out_specs = (pull_specs, P()) if counted else pull_specs
 
         @partial(jax.shard_map, mesh=self.mesh,
-                 in_specs=(state_specs, P(self.axis)),
+                 in_specs=(state_specs, bspec),
                  out_specs=out_specs, check_vma=False)
         def _pull(state_l, slots_l):
             B = slots_l.shape[0]
@@ -196,8 +209,10 @@ class TpuTransfer(Transfer):
                                    vals.dtype).at[order].set(vals)
             if not counted:
                 return out
+            axes = (self.dp_axis, self.axis) if self.dp_axis \
+                else (self.axis,)
             ovf = jax.lax.psum(
-                jnp.sum((so < self.n) & (idx >= C)), self.axis)
+                jnp.sum((so < self.n) & (idx >= C)), axes)
             return out, ovf
 
         return _pull
@@ -220,13 +235,14 @@ class TpuTransfer(Transfer):
     def _build_push(self, state, access, grad_fields):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
+        bspec = self._batch_spec()
         state_specs = {f: P(self.axis) for f in state}
-        grad_specs = {f: P(self.axis) for f in grad_fields}
+        grad_specs = {f: bspec for f in grad_fields}
         counted = self.bucket_capacity is not None
         out_specs = (state_specs, P()) if counted else state_specs
 
         @partial(jax.shard_map, mesh=self.mesh,
-                 in_specs=(state_specs, P(self.axis), grad_specs),
+                 in_specs=(state_specs, bspec, grad_specs),
                  out_specs=out_specs, check_vma=False)
         def _push(state_l, slots_l, grads_l):
             B = slots_l.shape[0]
@@ -251,15 +267,23 @@ class TpuTransfer(Transfer):
                 recv = jax.lax.all_to_all(bucket, self.axis, 0, 0,
                                           tiled=True)
                 acc = jnp.zeros((cap_per_shard, width), g.dtype)
-                dense[f] = acc.at[safe_rows].add(
+                acc = acc.at[safe_rows].add(
                     recv.reshape(-1, width), mode="drop")
+                if self.dp_axis:
+                    # reconcile the data groups' table replicas: sum their
+                    # dense grads (the one cross-DCN collective per push)
+                    # so every group applies the identical global update
+                    acc = jax.lax.psum(acc, self.dp_axis)
+                dense[f] = acc
             new_fields = access.apply_push(state_l, dense)
             out = dict(state_l)
             out.update(new_fields)
             if not counted:
                 return out
+            axes = (self.dp_axis, self.axis) if self.dp_axis \
+                else (self.axis,)
             ovf = jax.lax.psum(
-                jnp.sum((so < self.n) & (idx >= C)), self.axis)
+                jnp.sum((so < self.n) & (idx >= C)), axes)
             return out, ovf
 
         return _push

@@ -246,3 +246,38 @@ def test_tpu_backend_overflow_counted_and_loud(devices8):
     pull_sum(table.state, sl).block_until_ready()
     pull_sum(table.state, sl).block_until_ready()
     assert t4.overflow_count() == 16
+
+
+def test_tpu_backend_hybrid_data_shard_mesh(devices8):
+    """Multi-host layout, single-process rendering: a (data=2, shard=4)
+    mesh — each data group holds a full table replica, requests route
+    over the shard axis only, and push reconciles the groups with one
+    dense-grad psum.  Results must match the LocalTransfer oracle on the
+    flat global batch."""
+    from jax.sharding import Mesh
+    from swiftmpi_tpu.cluster.mesh import DATA_AXIS
+
+    devs = np.asarray(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devs, (DATA_AXIS, SHARD_AXIS))
+    access = w2v_access(learning_rate=0.3, len_vec=8)
+    ki = KeyIndex(num_shards=4, capacity_per_shard=32)
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS)
+    slots = slots_with_padding(ki, 64)
+    rng = np.random.default_rng(5)
+    grads = {f: rng.normal(size=(64, 8)).astype(np.float32)
+             for f in access.grad_fields}
+    state_np = {f: np.asarray(v) for f, v in table.state.items()}
+
+    t = TpuTransfer(mesh)
+    assert t.dp_axis == DATA_AXIS and t.n == 4
+
+    got = t.pull(table.state, slots, access)
+    want = LocalTransfer().pull(state_np, slots, access)
+    for f in want:
+        np.testing.assert_allclose(np.asarray(got[f]), want[f], rtol=1e-6)
+
+    new = t.push(table.state, slots, grads, access)
+    want_new = LocalTransfer().push(state_np, slots, grads, access)
+    for f in want_new:
+        np.testing.assert_allclose(np.asarray(new[f]), want_new[f],
+                                   rtol=1e-5, atol=1e-6)
