@@ -71,6 +71,27 @@ def _fence(state, scalar):
     return float(scalar) + float(next(iter(state.values()))[0, 0])
 
 
+def _timed_steps(step, state, args, timed_calls, key):
+    """Shared w2v timing harness: warmup + timed loop over the fused
+    multi-step, fenced by _fence (donated-state chain serializes calls).
+    Returns (final_state, dt_seconds, last_loss)."""
+    import jax
+
+    def one(state, key):
+        key, sub = jax.random.split(key)
+        state, es, ec = step(state, *args, sub)
+        return state, key, es
+
+    for _ in range(WARMUP_CALLS):
+        state, key, es = one(state, key)
+    _fence(state, es)
+    t0 = time.perf_counter()
+    for _ in range(timed_calls):
+        state, key, es = one(state, key)
+    _fence(state, es)
+    return state, time.perf_counter() - t0, float(es)
+
+
 def _build_w2v(device):
     import jax
     import jax.numpy as jnp
@@ -121,7 +142,6 @@ def _bench_w2v(device, timed_calls, built=None):
         sov = jax.device_put(model._slot_of_vocab, device)
         ap = jax.device_put(model._alias_prob, device)
         ai = jax.device_put(model._alias_idx, device)
-        key = jax.random.key(0)
         # one dispatch = INNER_STEPS scanned steps over stacked batches
         centers = jax.device_put(jnp.stack(
             [jnp.asarray(b.centers) for b in batches]), device)
@@ -130,30 +150,16 @@ def _bench_w2v(device, timed_calls, built=None):
         masks = jax.device_put(jnp.stack(
             [jnp.asarray(b.ctx_mask) for b in batches]), device)
         words_per_call = sum(b.n_words for b in batches)
-
-        def one(state, key):
-            key, sub = jax.random.split(key)
-            state, es, ec = step(state, sov, ap, ai, centers, contexts,
-                                 masks, sub)
-            return state, key, es
-
-        # the donated-state chain serializes the calls; one _fence after
-        # the loop forces the whole timed sequence (see _fence)
-        for _ in range(WARMUP_CALLS):
-            state, key, es = one(state, key)
-        _fence(state, es)
-        t0 = time.perf_counter()
-        for _ in range(timed_calls):
-            state, key, es = one(state, key)
-        _fence(state, es)
-        dt = time.perf_counter() - t0
+        state, dt, loss = _timed_steps(
+            step, state, (sov, ap, ai, centers, contexts, masks),
+            timed_calls, jax.random.key(0))
         # the step donates (deletes) its input buffers — which may BE the
         # model's own (device_put to the same device is a no-op); repoint
         # the model at the live final state so later benches can reuse it
         model.table.state = state
     return {"words_per_sec": words_per_call * timed_calls / dt,
             "step_ms": dt / (timed_calls * INNER_STEPS) * 1e3,
-            "loss": float(es)}
+            "loss": loss}
 
 
 def _bench_lr(device, timed_calls):
@@ -231,6 +237,56 @@ def _bench_s2v(device, timed_calls, model):
     return {"sents_per_sec": len(lines) * timed_calls / dt}
 
 
+def _bench_w2v_1m(device, timed_calls):
+    """BASELINE config #3 shape: the same fused step over a ~1M-word
+    vocabulary (1.3M-row table).  Batches are synthesized directly in
+    vocab-index space (uniform centers/contexts, Zipf counts for the
+    sampler) — this measures the DEVICE pipeline at scale; the host
+    pipeline at 1M vocab is exercised by tests/test_scale.py."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.data.text import Vocab
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+
+    V = 1_000_000
+    rng = np.random.default_rng(0)
+    counts = np.maximum((rng.zipf(1.3, size=V) % 1000), 1).astype(np.int64)
+    vocab = Vocab(keys=np.arange(1, V + 1, dtype=np.uint64),
+                  counts=counts, index={})
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
+                     "sample": -1, "learning_rate": 0.05},
+        "server": {"initial_learning_rate": 0.7, "frag_num": 1000},
+        "worker": {"minibatch": 5000},
+    })
+    with jax.default_device(device):
+        model = Word2Vec(
+            config=cfg, cluster=Cluster(cfg, devices=[device]).initialize())
+        model.build_from_vocab(vocab)
+        step = model._build_multi_step(INNER_STEPS)
+        B, W2 = BATCH, 2 * model.window
+        centers = jnp.asarray(rng.integers(0, V, size=(INNER_STEPS, B)),
+                              jnp.int32)
+        contexts = jnp.asarray(rng.integers(0, V,
+                                            size=(INNER_STEPS, B, W2)),
+                               jnp.int32)
+        masks = jnp.asarray(rng.random((INNER_STEPS, B, W2)) < 0.8)
+        state = {f: jax.device_put(v, device)
+                 for f, v in model.table.state.items()}
+        args = tuple(jax.device_put(x, device) for x in
+                     (model._slot_of_vocab, model._alias_prob,
+                      model._alias_idx, centers, contexts, masks))
+        state, dt, _ = _timed_steps(step, state, args, timed_calls,
+                                    jax.random.key(0))
+    return {"words_per_sec": B * INNER_STEPS * timed_calls / dt,
+            "step_ms": dt / (timed_calls * INNER_STEPS) * 1e3,
+            "vocab": V, "capacity": model.table.capacity}
+
+
 def child_main(which: str) -> None:
     import jax
 
@@ -248,8 +304,12 @@ def child_main(which: str) -> None:
     model, step, batches = _build_w2v(device)
     out["w2v"] = _bench_w2v(device, timed, (model, step, batches))
     print("BENCH_CHILD " + json.dumps(out), flush=True)
-    for name, fn in (("lr", lambda: _bench_lr(device, max(timed // 4, 1))),
-                     ("s2v", lambda: _bench_s2v(device, 1, model))):
+    secondaries = [("lr", lambda: _bench_lr(device, max(timed // 4, 1))),
+                   ("s2v", lambda: _bench_s2v(device, 1, model))]
+    if os.environ.get("BENCH_SCALE"):
+        secondaries.append(
+            ("w2v_1m", lambda: _bench_w2v_1m(device, max(timed // 2, 1))))
+    for name, fn in secondaries:
         try:
             out[name] = fn()
         except Exception as e:
@@ -368,13 +428,17 @@ def parent_main() -> None:
         "secondary": {},
     }
     for name, field, unit in (("lr_a9a", "rows_per_sec", "rows/s"),
-                              ("sent2vec", "sents_per_sec", "sents/s")):
-        key = {"lr_a9a": "lr", "sent2vec": "s2v"}[name]
+                              ("sent2vec", "sents_per_sec", "sents/s"),
+                              ("w2v_1m_vocab", "words_per_sec", "words/s")):
+        key = {"lr_a9a": "lr", "sent2vec": "s2v",
+               "w2v_1m_vocab": "w2v_1m"}[name]
         entry = {"unit": unit}
         if tpu_res and key in tpu_res:
             entry["tpu"] = round(tpu_res[key][field], 1)
         if cpu_res and key in cpu_res:
             entry["cpu"] = round(cpu_res[key][field], 1)
+        if len(entry) == 1:
+            continue                  # bench not run (e.g. BENCH_SCALE off)
         if "tpu" in entry and "cpu" in entry and entry["cpu"]:
             entry["vs_baseline"] = round(entry["tpu"] / entry["cpu"], 2)
         out["secondary"][name] = entry

@@ -583,6 +583,19 @@ class Word2Vec:
                      dropped, group, batch_size)
         return err_sum, err_cnt
 
+    def grow(self, new_capacity_per_shard: int) -> None:
+        """Mid-run table growth (reference dense_hash_map self-growth,
+        sparsetable.h:17-149 — here an explicit HBM re-layout).  Owns the
+        post-grow fixups a bare ``table.grow()`` would leave stale: the
+        jitted step bakes in the old capacity (its _mean_scale scatter
+        bounds), and the cached vocab->slot map holds old-layout slots —
+        either one silently corrupts scatters if kept."""
+        self.table.grow(new_capacity_per_shard)
+        self._step = None
+        if self.vocab is not None:
+            slots = self.table.key_index.lookup(self.vocab.keys)
+            self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
+
     def resume(self, checkpoint_path: str) -> int:
         """Restore a mid-training checkpoint; returns the iteration it was
         taken at.  The cached vocab->slot map is rebuilt against the
