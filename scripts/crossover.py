@@ -1,0 +1,120 @@
+#!/usr/bin/env python
+"""Backend crossover study: xla-sparse vs xla-dense vs tpu(all_to_all)
+push/pull cost across table capacity x push-batch size (SURVEY §7 hard
+part (a); VERDICT round-1 'next' #7).
+
+Times one pull + one push (w2v access, d=100) per (backend, capacity, B)
+cell on the current default platform, using the same D2H fence as
+bench.py.  Emits one JSON line per cell plus a summary table and the
+measured sparse->dense crossover ratio per capacity; the numbers behind
+docs/ARCHITECTURE.md's "push backend selection" section and
+XlaTransfer's auto heuristic.
+
+Run CPU: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+           XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+           python scripts/crossover.py
+Run TPU: JAX_PLATFORMS=axon python scripts/crossover.py --single-device
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--single-device", action="store_true",
+                    help="skip the 8-device tpu backend (1 real chip)")
+    ap.add_argument("--d", type=int, default=100)
+    ap.add_argument("--reps", type=int, default=5)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+    from swiftmpi_tpu.cluster import ps_mesh
+    from swiftmpi_tpu.parameter import KeyIndex, SparseTable, w2v_access
+    from swiftmpi_tpu.transfer.tpu import TpuTransfer
+    from swiftmpi_tpu.transfer.xla import XlaTransfer
+
+    d = args.d
+    access = w2v_access(0.7, d)
+    n_dev = len(jax.devices())
+    backends = [("xla_sparse", XlaTransfer(dense_apply=False)),
+                ("xla_dense", XlaTransfer(dense_apply=True))]
+    if not args.single_device and n_dev >= 2:
+        backends.append(("tpu_a2a", TpuTransfer(ps_mesh())))
+
+    def fence(x):
+        return float(jax.tree_util.tree_leaves(x)[0].reshape(-1)[0])
+
+    results = []
+    for cap_total in (32_768, 262_144, 1_048_576):
+        shards = n_dev if any(n == "tpu_a2a" for n, _ in backends) else 1
+        ki = KeyIndex(num_shards=shards, capacity_per_shard=cap_total
+                      // shards)
+        mesh = ps_mesh() if shards > 1 else None
+        table = SparseTable(access, ki, mesh=mesh,
+                            axis="shard" if mesh else "model")
+        rng = np.random.default_rng(0)
+        for B in (4096, 65_536, 524_288):
+            slots = (rng.integers(0, cap_total, size=B)).astype(np.int32)
+            grads = {f: jnp.asarray(
+                rng.normal(size=(B, d)).astype(np.float32))
+                for f in access.grad_fields}
+            sj = jnp.asarray(slots)
+            for name, backend in backends:
+                # fresh state copy per cell: push donates nothing but
+                # mutating paths must not skew later cells
+                state = {f: jnp.array(v) for f, v in table.state.items()}
+                try:
+                    out = backend.push(state, sj, grads, access)
+                    fence(out)                       # compile + settle
+                    t0 = time.perf_counter()
+                    for _ in range(args.reps):
+                        out = backend.push(state, sj, grads, access)
+                    fence(out)
+                    push_ms = (time.perf_counter() - t0) / args.reps * 1e3
+                    rows = backend.pull(state, sj, access)
+                    fence(rows)
+                    t0 = time.perf_counter()
+                    for _ in range(args.reps):
+                        rows = backend.pull(state, sj, access)
+                    fence(rows)
+                    pull_ms = (time.perf_counter() - t0) / args.reps * 1e3
+                    cell = {"backend": name, "capacity": cap_total,
+                            "batch": B, "push_ms": round(push_ms, 3),
+                            "pull_ms": round(pull_ms, 3)}
+                except Exception as e:
+                    cell = {"backend": name, "capacity": cap_total,
+                            "batch": B,
+                            "error": f"{type(e).__name__}: {e}"}
+                results.append(cell)
+                print(json.dumps(cell), flush=True)
+
+    # crossover summary: smallest B/capacity where dense beats sparse
+    print("\n== sparse vs dense push crossover ==")
+    for cap in sorted({r["capacity"] for r in results}):
+        line = [f"cap={cap:>9}"]
+        for B in sorted({r["batch"] for r in results}):
+            sp = next((r for r in results
+                       if r["backend"] == "xla_sparse"
+                       and r["capacity"] == cap and r["batch"] == B), {})
+            de = next((r for r in results
+                       if r["backend"] == "xla_dense"
+                       and r["capacity"] == cap and r["batch"] == B), {})
+            if "push_ms" in sp and "push_ms" in de:
+                win = "dense" if de["push_ms"] < sp["push_ms"] else "sparse"
+                line.append(f"B={B}: {win} "
+                            f"({de['push_ms']:.1f} vs {sp['push_ms']:.1f})")
+        print("  ".join(line))
+
+
+if __name__ == "__main__":
+    main()
