@@ -1,0 +1,135 @@
+#!/usr/bin/env python
+"""On-device phase ablation of the fused w2v CBOW step (VERDICT round-1
+'next' #2: profile before optimizing).
+
+Times progressively larger slices of the step at bench.py's shapes so the
+per-phase cost falls out by subtraction:
+
+  a. gathers only            (pull h_t + v_ctx, reduce to scalar)
+  b. + einsum/grad math      (neu1, f, g, contribs, err)
+  c. + mean-scale            (_assemble_push counts)
+  d. full step               (+ transfer.push dense/sparse + AdaGrad)
+
+plus the roofline context (bytes moved per phase at fp32) printed next to
+each measurement.  Run: JAX_PLATFORMS=axon python scripts/profile_step.py
+(or PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu ... for the host baseline).
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import bench
+
+    dev = jax.devices()[0]
+    print(f"device: {dev}", flush=True)
+    model, step, batches = bench._build_w2v(dev)
+    d = model.len_vec
+    K = model.negative
+    B = bench.BATCH
+    W2 = 2 * model.window
+    cap = model.table.capacity
+
+    state = {f: jax.device_put(v, dev) for f, v in model.table.state.items()}
+    sov = jax.device_put(model._slot_of_vocab, dev)
+    ap = jax.device_put(model._alias_prob, dev)
+    ai = jax.device_put(model._alias_idx, dev)
+    b0 = batches[0]
+    centers = jax.device_put(jnp.asarray(b0.centers), dev)
+    contexts = jax.device_put(jnp.asarray(b0.contexts), dev)
+    mask = jax.device_put(jnp.asarray(b0.ctx_mask), dev)
+    key = jax.random.key(3)
+
+    from swiftmpi_tpu.models.word2vec import _assemble_push, _mean_scale
+    from swiftmpi_tpu.ops.sampling import sample_alias
+    from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
+
+    def phase_a(state, key):
+        negs = sample_alias(key, ap, ai, (B, K))
+        targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
+        t_slots = sov[targets_v]
+        ctx_slots = jnp.where(mask, sov[contexts], -1)
+        h_t = jnp.take(state["h"], jnp.clip(t_slots.reshape(-1), 0, cap - 1),
+                       axis=0)
+        v_ctx = jnp.take(state["v"],
+                         jnp.clip(ctx_slots.reshape(-1), 0, cap - 1), axis=0)
+        return h_t.sum() + v_ctx.sum()
+
+    def _grads(state, key):
+        negs = sample_alias(key, ap, ai, (B, K))
+        targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
+        t_slots = sov[targets_v]
+        ctx_slots = jnp.where(mask, sov[contexts], -1)
+        row_valid = mask.any(axis=1)
+        t_valid = jnp.concatenate(
+            [jnp.ones((B, 1), bool), negs != centers[:, None]], axis=1)
+        t_valid = t_valid & row_valid[:, None]
+        t_slots = jnp.where(t_valid, t_slots, -1)
+        h_t = jnp.take(state["h"], jnp.clip(t_slots.reshape(-1), 0, cap - 1),
+                       axis=0).reshape(B, K + 1, d)
+        v_ctx = jnp.take(
+            state["v"], jnp.clip(ctx_slots.reshape(-1), 0, cap - 1),
+            axis=0).reshape(B, W2, d)
+        neu1 = jnp.sum(v_ctx * mask[..., None], axis=1)
+        f = jnp.einsum("bd,bkd->bk", neu1, h_t)
+        g = (jnp.concatenate([jnp.ones((B, 1)), jnp.zeros((B, K))], axis=1)
+             - sigmoid_clipped(f)) * model.alpha
+        g = jnp.where(t_valid, g, 0.0)
+        h_contrib = g[..., None] * neu1[:, None, :]
+        neu1e = jnp.einsum("bk,bkd->bd", g, h_t)
+        v_contrib = jnp.where(mask[..., None], neu1e[:, None, :], 0.0)
+        return (t_slots, ctx_slots, h_contrib, v_contrib,
+                jnp.sum(1e4 * g * g))
+
+    def phase_b(state, key):
+        t_slots, ctx_slots, h_c, v_c, err = _grads(state, key)
+        return h_c.sum() + v_c.sum() + err
+
+    def phase_c(state, key):
+        t_slots, ctx_slots, h_c, v_c, err = _grads(state, key)
+        pushes = _assemble_push(t_slots.reshape(-1), ctx_slots.reshape(-1),
+                                h_c.reshape(-1, d), v_c.reshape(-1, d), cap)
+        return sum(g.sum() for _, gr in pushes for g in gr.values()) + err
+
+    def phase_d(state, key):
+        t_slots, ctx_slots, h_c, v_c, err = _grads(state, key)
+        pushes = _assemble_push(t_slots.reshape(-1), ctx_slots.reshape(-1),
+                                h_c.reshape(-1, d), v_c.reshape(-1, d), cap)
+        for slots, grads in pushes:
+            state = model.transfer.push(state, slots, grads, model.access)
+        return state["h"].sum() + err
+
+    nt, nc = B * (K + 1), B * W2
+    mb = 1e-6 * 4
+    notes = {
+        "a_gathers": f"~{(nt + nc) * d * mb:.0f} MB gathered",
+        "b_+gradmath": f"+{(nt + nc) * d * mb:.0f} MB contribs",
+        "c_+meanscale": f"+{(nt + nc) * 2 * 4e-6:.0f} MB counts",
+        "d_full_step": f"+scatter {(nt + nc) * d * mb:.0f} MB + "
+                       f"AdaGrad sweep {cap * d * 4 * 2 * mb:.0f} MB",
+    }
+    reps = int(os.environ.get("PROFILE_REPS", "8"))
+    for name, fn in (("a_gathers", phase_a), ("b_+gradmath", phase_b),
+                     ("c_+meanscale", phase_c), ("d_full_step", phase_d)):
+        jf = jax.jit(fn)
+        out = jf(state, key)
+        float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
+        t0 = time.perf_counter()
+        for i in range(reps):
+            out = jf(state, jax.random.fold_in(key, i))
+        float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
+        dt = (time.perf_counter() - t0) / reps
+        print(f"{name:14s} {dt * 1e3:8.2f} ms   ({notes[name]})", flush=True)
+
+
+if __name__ == "__main__":
+    main()
