@@ -92,7 +92,7 @@ def _timed_steps(step, state, args, timed_calls, key):
     return state, time.perf_counter() - t0, float(es)
 
 
-def _build_w2v(device):
+def _build_w2v(device, w2v_overrides=None):
     import jax
     import jax.numpy as jnp
     from swiftmpi_tpu.data.text import CBOWBatcher, synthetic_corpus
@@ -103,7 +103,8 @@ def _build_w2v(device):
     cfg = ConfigParser().update({
         "cluster": {"transfer": "xla", "server_num": 1},
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
-                     "sample": 1e-4, "learning_rate": 0.05},
+                     "sample": 1e-4, "learning_rate": 0.05,
+                     **(w2v_overrides or {})},
         "server": {"initial_learning_rate": 0.7, "frag_num": 1000},
         "worker": {"minibatch": 5000},
     })
@@ -304,8 +305,17 @@ def child_main(which: str) -> None:
     model, step, batches = _build_w2v(device)
     out["w2v"] = _bench_w2v(device, timed, (model, step, batches))
     print("BENCH_CHILD " + json.dumps(out), flush=True)
+    def _shared():
+        # TPU-first shared-negative-pool mode (docs/ARCHITECTURE.md):
+        # same shapes, different NS sampling — labeled separately, never
+        # the primary (the primary stays reference-parity math)
+        built = _build_w2v(device, {"shared_negatives": 1,
+                                    "shared_pool": 4096})
+        return _bench_w2v(device, timed, built)
+
     secondaries = [("lr", lambda: _bench_lr(device, max(timed // 4, 1))),
-                   ("s2v", lambda: _bench_s2v(device, 1, model))]
+                   ("s2v", lambda: _bench_s2v(device, 1, model)),
+                   ("w2v_shared", _shared)]
     if os.environ.get("BENCH_SCALE"):
         secondaries.append(
             ("w2v_1m", lambda: _bench_w2v_1m(device, max(timed // 2, 1))))
@@ -429,8 +439,11 @@ def parent_main() -> None:
     }
     for name, field, unit in (("lr_a9a", "rows_per_sec", "rows/s"),
                               ("sent2vec", "sents_per_sec", "sents/s"),
+                              ("w2v_shared_negatives", "words_per_sec",
+                               "words/s"),
                               ("w2v_1m_vocab", "words_per_sec", "words/s")):
         key = {"lr_a9a": "lr", "sent2vec": "s2v",
+               "w2v_shared_negatives": "w2v_shared",
                "w2v_1m_vocab": "w2v_1m"}[name]
         entry = {"unit": unit}
         if tpu_res and key in tpu_res:

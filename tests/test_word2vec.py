@@ -416,3 +416,106 @@ def test_w2v_hogwild_guards(devices8):
     m2 = make_model(word2vec={"async_mode": "hogwild", "local_steps": 64})
     with pytest.raises(RuntimeError, match="dispatched NO group"):
         m2.train(corpus, niters=1, batch_size=64)
+
+
+def test_w2v_shared_negatives_trains(devices8):
+    """TPU-first opt-in (shared_negatives: 1): one weighted pool of
+    negatives shared by the batch, MXU-matmul NS math.  A different
+    sampling of the same objective, so its per-pair error is not
+    numerically comparable to parity mode — assert convergence here and
+    embedding quality in the co-occurrence test below."""
+    corpus = synthetic_corpus(150, vocab_size=50, length=12, seed=9)
+    fast = make_model(word2vec={"shared_negatives": 1, "shared_pool": 256})
+    fast_losses = fast.train(corpus, niters=4, batch_size=128)
+    assert fast_losses[-1] < fast_losses[0], fast_losses
+    assert fast_losses[-1] < 0.8 * fast_losses[0], fast_losses
+
+
+def test_w2v_shared_negatives_cooccurrence(devices8):
+    rng = np.random.default_rng(0)
+    topic_a = list(range(1, 21))
+    topic_b = list(range(21, 41))
+    corpus = [[int(w) for w in rng.choice(
+        topic_a if i % 2 == 0 else topic_b, size=12)] for i in range(120)]
+    model = make_model(word2vec={"shared_negatives": 1,
+                                 "shared_pool": 256})
+    model.train(corpus, niters=8, batch_size=128)
+
+    def vec(k):
+        v = model.embedding(k)
+        return v / (np.linalg.norm(v) + 1e-9)
+
+    within = np.mean([vec(topic_a[i]) @ vec(topic_a[j])
+                      for i in range(5) for j in range(5) if i != j])
+    across = np.mean([vec(topic_a[i]) @ vec(topic_b[j])
+                      for i in range(5) for j in range(5)])
+    assert within > across, (within, across)
+
+
+def test_w2v_shared_negatives_grads_match_numpy(devices8):
+    """Golden check of the shared-pool gradient phase, including the
+    center/pool overlap case: a key that appears many times as a center
+    AND in the pool must get its full summed negative row (sum
+    semantics), not one attenuated by the center occurrence count."""
+    from swiftmpi_tpu.ops.sampling import sample_alias
+
+    model = make_model(word2vec={"shared_negatives": 1, "shared_pool": 16,
+                                 "negative": 4, "len_vec": 8, "window": 2})
+    corpus = synthetic_corpus(10, vocab_size=30, length=10, seed=5)
+    model.build(corpus)
+    B, W2 = 24, 4
+    V = len(model.vocab)
+    rng = np.random.default_rng(2)
+    # one dominant center (vocab idx 0) repeated: the overlap trap
+    centers = np.zeros(B, np.int32)
+    centers[12:] = rng.integers(0, V, size=12)
+    contexts = rng.integers(0, V, size=(B, W2)).astype(np.int32)
+    mask = np.ones((B, W2), bool)
+    key = jax.random.key(11)
+
+    grads_fn = jax.jit(model._build_grads())
+    pushes, es, ec = grads_fn(
+        model.table.state, model._slot_of_vocab, model._alias_prob,
+        model._alias_idx, jnp.asarray(centers), jnp.asarray(contexts),
+        jnp.asarray(mask), key)
+    (pos_slots, pos_g), (neg_slots, neg_g), (ctx_slots, ctx_g) = pushes
+
+    # numpy recomputation with the same drawn pool
+    K = model.shared_pool
+    negs = np.asarray(sample_alias(key, model._alias_prob,
+                                   model._alias_idx, (K,)))
+    sov = np.asarray(model._slot_of_vocab)
+    h = np.asarray(model.table.state["h"])
+    v = np.asarray(model.table.state["v"])
+    alpha, ratio = model.alpha, model.negative / K
+    neu1 = v[sov[contexts]].sum(axis=1)                      # (B, d)
+    sig = lambda f: 1.0 / (1.0 + np.exp(-np.clip(f, -6, 6)))
+
+    want_neg = np.zeros((K, 8))
+    for k in range(K):
+        gsum = np.zeros(8)
+        for b in range(B):
+            if negs[k] == centers[b]:
+                continue
+            f = float(neu1[b] @ h[sov[negs[k]]])
+            f = np.clip(f, -6.0, 6.0)
+            g = (0.0 - (0.0 if f < -6 else sig(f))) * alpha
+            gsum += g * ratio * neu1[b]
+        want_neg[k] = gsum
+    np.testing.assert_allclose(np.asarray(neg_g["h"]), want_neg,
+                               rtol=2e-3, atol=1e-6)
+    # the dominant center's pool row (if drawn) must be the raw sum —
+    # no 1/center_count attenuation
+    np.testing.assert_array_equal(np.asarray(neg_slots),
+                                  np.where((negs != 0) | True,
+                                           sov[negs], -1))
+
+    # positive rows: mean over the center's occurrences
+    want_pos = np.zeros((B, 8))
+    cnt = np.bincount(sov[centers], minlength=h.shape[0])
+    for b in range(B):
+        f = np.clip(float(neu1[b] @ h[sov[centers[b]]]), -6, 6)
+        g = (1.0 - sig(f)) * alpha
+        want_pos[b] = g * neu1[b] / cnt[sov[centers[b]]]
+    np.testing.assert_allclose(np.asarray(pos_g["h"]), want_pos,
+                               rtol=2e-3, atol=1e-6)
