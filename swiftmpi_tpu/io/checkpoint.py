@@ -262,8 +262,15 @@ def save_checkpoint(table: SparseTable, path: str,
                        count=len(table.key_index))
     slots = np.fromiter((table.key_index.slot(int(k)) for k in keys),
                         dtype=np.int64, count=len(keys))
-    payload = {f"field__{f}": host_array(v)
-               for f, v in table.state.items()}
+    payload = {}
+    for f, v in table.state.items():
+        arr = host_array(v)
+        if arr.dtype.name == "bfloat16":
+            # np.savez has no bfloat16: it round-trips as raw '|V2' and
+            # load explodes.  fp32 is an exact superset of bf16, so
+            # upcast here and cast back at load — bit-identical.
+            arr = arr.astype(np.float32)
+        payload[f"field__{f}"] = arr
     payload["keys"] = keys
     payload["slots"] = slots
     payload["num_shards"] = np.int64(table.key_index.num_shards)
@@ -301,8 +308,13 @@ def load_checkpoint(table: SparseTable, path: str) -> Dict[str, np.ndarray]:
                 f"than the table's {table.key_index.capacity_per_shard}; "
                 "shrinking on load is not supported")
         state = {}
-        for name in table.access.fields:
-            state[name] = _replace(table, name, z[f"field__{name}"])
+        for name, fs in table.access.fields.items():
+            arr = z[f"field__{name}"]
+            if arr.dtype != fs.dtype:
+                # bf16 fields were saved upcast to fp32 (npz has no
+                # bfloat16); restore the table's storage dtype exactly
+                arr = arr.astype(fs.dtype)
+            state[name] = _replace(table, name, arr)
         table.state = state
         table.key_index.restore(z["keys"], z["slots"])
         return {k[len("extra__"):]: z[k] for k in z.files

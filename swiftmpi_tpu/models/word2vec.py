@@ -135,9 +135,19 @@ class Word2Vec:
         # _build_hogwild_step)
         self.async_mode = g("word2vec", "async_mode", "").to_string()
         server_lr = g("server", "initial_learning_rate", 0.7).to_float()
+        # [server] dtype: bfloat16 halves the embedding fields' HBM
+        # gather/scatter bytes (the measured TPU bottleneck); math stays
+        # fp32 (upcast on pull, round once on store), accumulators fp32
+        dtype_s = g("server", "dtype", "float32").to_string()
+        if dtype_s not in ("float32", "bfloat16"):
+            raise ValueError(f"[server] dtype must be float32 or "
+                             f"bfloat16, got {dtype_s!r}")
+        self.param_dtype = jnp.bfloat16 if dtype_s == "bfloat16" \
+            else jnp.float32
 
         self.cluster = cluster or Cluster(self.config).initialize()
-        self.access = w2v_access(server_lr, self.len_vec)
+        self.access = w2v_access(server_lr, self.len_vec,
+                                 param_dtype=self.param_dtype)
         self._capacity_per_shard = capacity_per_shard
         self.table = None
         self.transfer = self.cluster.transfer
@@ -299,6 +309,11 @@ class Word2Vec:
         math, per-key mean normalization — no push.  Split out so the async
         (``local_steps``) mode can compute grads against a *stale* state
         snapshot while pushes land on the live state."""
+        if self.sg and self.shared_negatives:
+            raise ValueError(
+                "shared_negatives is a CBOW-only mode; with sg: 1 the "
+                "per-pair skip-gram sampler would silently ignore it — "
+                "drop one of the two flags")
         if self.sg:
             return self._build_grads_sg()
         if self.shared_negatives:
@@ -324,13 +339,16 @@ class Word2Vec:
             t_valid = t_valid & row_valid[:, None]
             t_slots = jnp.where(t_valid, t_slots, -1)
 
-            pulled = transfer.pull(
-                state,
-                jnp.concatenate([t_slots.reshape(-1),
-                                 ctx_slots.reshape(-1)]),
-                access)
-            h_t = pulled["h"][:B * (K + 1)].reshape(B, K + 1, d)
-            v_ctx = pulled["v"][B * (K + 1):].reshape(B, W2, d)
+            # split pulls: targets need only h, contexts only v —
+            # pulling both fields for the union of slots would gather
+            # twice the bytes and discard half (fp32 upcast restores
+            # precision when the table stores bf16)
+            h_t = transfer.pull(
+                state, t_slots.reshape(-1), access, fields=("h",)
+            )["h"].reshape(B, K + 1, d).astype(jnp.float32)
+            v_ctx = transfer.pull(
+                state, ctx_slots.reshape(-1), access, fields=("v",)
+            )["v"].reshape(B, W2, d).astype(jnp.float32)
 
             neu1 = jnp.sum(v_ctx * ctx_mask[..., None], axis=1)   # (B, d)
             f = jnp.einsum("bd,bkd->bk", neu1, h_t)
@@ -394,13 +412,14 @@ class Word2Vec:
             ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
             row_valid = ctx_mask.any(axis=1)
 
-            pulled = transfer.pull(
-                state,
-                jnp.concatenate([c_slots, n_slots, ctx_slots.reshape(-1)]),
-                access)
-            h_pos = pulled["h"][:B]                           # (B, d)
-            h_neg = pulled["h"][B:B + K]                      # (K, d)
-            v_ctx = pulled["v"][B + K:].reshape(B, W2, d)
+            pulled_h = transfer.pull(
+                state, jnp.concatenate([c_slots, n_slots]), access,
+                fields=("h",))["h"].astype(jnp.float32)
+            h_pos = pulled_h[:B]                              # (B, d)
+            h_neg = pulled_h[B:B + K]                         # (K, d)
+            v_ctx = transfer.pull(
+                state, ctx_slots.reshape(-1), access, fields=("v",)
+            )["v"].reshape(B, W2, d).astype(jnp.float32)
 
             neu1 = jnp.sum(v_ctx * ctx_mask[..., None], axis=1)
             f_pos = jnp.einsum("bd,bd->b", neu1, h_pos)       # (B,)
@@ -482,14 +501,12 @@ class Word2Vec:
             t_slots = jnp.where(t_valid, slot_of_vocab[targets_v], -1)
             ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
 
-            pulled = transfer.pull(
-                state,
-                jnp.concatenate([t_slots.reshape(-1),
-                                 ctx_slots.reshape(-1)]),
-                access)
-            n_t = B * W2 * (K + 1)
-            h_t = pulled["h"][:n_t].reshape(B, W2, K + 1, d)
-            v_in = pulled["v"][n_t:].reshape(B, W2, d)
+            h_t = transfer.pull(
+                state, t_slots.reshape(-1), access, fields=("h",)
+            )["h"].reshape(B, W2, K + 1, d).astype(jnp.float32)
+            v_in = transfer.pull(
+                state, ctx_slots.reshape(-1), access, fields=("v",)
+            )["v"].reshape(B, W2, d).astype(jnp.float32)
 
             f = jnp.einsum("bwd,bwkd->bwk", v_in, h_t)
             labels = jnp.concatenate(

@@ -124,9 +124,11 @@ class AdaGradAccess(AccessMethod):
             g = grads[r.grad].astype(jnp.float32)
             accum = params[r.accum] + jnp.square(g)
             out[r.accum] = accum
-            out[r.param] = params[r.param] + (
+            p = params[r.param]
+            out[r.param] = (p.astype(jnp.float32) + (
                 self.learning_rate * g
                 * jax.lax.rsqrt(accum + self.fudge_factor))
+            ).astype(p.dtype)      # fp32 math, one rounding on store
         return out
 
     def touched_fields(self, grad_fields):
@@ -176,15 +178,22 @@ def lr_access(learning_rate: float) -> AdaGradAccess:
     )
 
 
-def w2v_access(learning_rate: float, len_vec: int) -> AdaGradAccess:
+def w2v_access(learning_rate: float, len_vec: int,
+               param_dtype=jnp.float32) -> AdaGradAccess:
     """word2vec row: h,v embeddings + per-element AdaGrad sums
-    (reference WParam, word2vec.h:32-46,167-191)."""
+    (reference WParam, word2vec.h:32-46,167-191).
+
+    ``param_dtype=bfloat16`` stores the embedding fields at half width —
+    on TPU the row gathers/scatters are the measured bottleneck and move
+    half the HBM bytes; pulls are upcast to fp32 before any math and the
+    AdaGrad accumulators stay fp32 (the update rule computes in fp32 and
+    rounds once on store)."""
     return AdaGradAccess(
         learning_rate,
         rules=(AdaGradRule("h", "h2sum", "h"),
                AdaGradRule("v", "v2sum", "v")),
-        fields={"h": FieldSpec(len_vec, vec_rand_init),
-                "v": FieldSpec(len_vec, vec_rand_init),
+        fields={"h": FieldSpec(len_vec, vec_rand_init, param_dtype),
+                "v": FieldSpec(len_vec, vec_rand_init, param_dtype),
                 "h2sum": FieldSpec(len_vec, zeros_init),
                 "v2sum": FieldSpec(len_vec, zeros_init)},
         pull_fields=("h", "v"),

@@ -53,8 +53,13 @@ class Transfer:
 
     name: str = "?"
 
-    def pull(self, state: TableState, slots, access: AccessMethod
-             ) -> TableState:
+    def pull(self, state: TableState, slots, access: AccessMethod,
+             fields=None) -> TableState:
+        """Gather rows for ``slots``.  ``fields`` restricts the pull to a
+        subset of ``access.pull_fields`` — a caller whose slot groups
+        need different fields (w2v: h for targets, v for contexts)
+        splits its pulls rather than gathering every field for every
+        slot and discarding half the bytes."""
         raise NotImplementedError
 
     def push(self, state: TableState, slots, grads: TableState,

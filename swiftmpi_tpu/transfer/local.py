@@ -18,11 +18,11 @@ from swiftmpi_tpu.transfer.api import Transfer
 class LocalTransfer(Transfer):
     name = "local"
 
-    def pull(self, state, slots, access):
+    def pull(self, state, slots, access, fields=None):
         slots = np.asarray(slots, np.int64)
         valid = slots >= 0
         out = {}
-        for f in access.pull_fields:
+        for f in (fields or access.pull_fields):
             arr = np.asarray(state[f])
             rows = arr[np.where(valid, slots, 0)]
             rows[~valid] = 0

@@ -158,13 +158,14 @@ class TpuTransfer(Transfer):
         return sig
 
     # -- pull --------------------------------------------------------------
-    def pull(self, state, slots, access):
+    def pull(self, state, slots, access, fields=None):
+        fields = tuple(fields or access.pull_fields)
         slots = jnp.asarray(slots, jnp.int32)
-        sig = self._signature(state, slots)
+        sig = self._signature(state, slots) + (fields,)
         fn = self._pull_cache.get(sig)
         if fn is None:
             fn = self._pull_cache.setdefault(
-                sig, jax.jit(self._build_pull(state, access)))
+                sig, jax.jit(self._build_pull(state, access, fields)))
         if self.bucket_capacity is None:
             return fn(state, slots)
         out, ovf = fn(state, slots)
@@ -177,12 +178,13 @@ class TpuTransfer(Transfer):
         return P((self.dp_axis, self.axis)) if self.dp_axis \
             else P(self.axis)
 
-    def _build_pull(self, state, access):
+    def _build_pull(self, state, access, fields=None):
+        fields = tuple(fields or access.pull_fields)
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
         bspec = self._batch_spec()
         state_specs = {f: P(self.axis) for f in state}
-        pull_specs = {f: bspec for f in access.pull_fields}
+        pull_specs = {f: bspec for f in fields}
         counted = self.bucket_capacity is not None
         out_specs = (pull_specs, P()) if counted else pull_specs
 
@@ -198,7 +200,7 @@ class TpuTransfer(Transfer):
             ok = got >= 0
             safe = jnp.where(ok, got, 0)
             out = {}
-            for f in access.pull_fields:
+            for f in fields:
                 rows = jnp.take(state_l[f], safe.reshape(-1), axis=0)
                 rows = rows.reshape(self.n, C, -1) * ok[..., None]
                 resp = jax.lax.all_to_all(rows, self.axis, 0, 0, tiled=True)

@@ -51,11 +51,11 @@ class XlaTransfer(Transfer):
         self.dense_apply = dense_apply
 
     # -- pull (global_pull_access.h:28-43 equivalent) ----------------------
-    def pull(self, state, slots, access):
+    def pull(self, state, slots, access, fields=None):
         slots = jnp.asarray(slots, jnp.int32)
         valid = slots >= 0
         return {f: _masked_gather(state[f], slots, valid)
-                for f in access.pull_fields}
+                for f in (fields or access.pull_fields)}
 
     # -- push (global_push_access.h:26-43 + server.h:159-176) --------------
     def push(self, state, slots, grads, access):
