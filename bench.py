@@ -39,8 +39,10 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 VOCAB = 30_000
 SENTENCES = 600
 SENT_LEN = 500
-BATCH = 16384          # centers/step; reference minibatch is 5000 *lines*
-INNER_STEPS = 8        # steps fused per dispatch (lax.scan)
+# BENCH_BATCH / BENCH_SCAN env overrides make on-chip shape tuning a
+# one-liner; defaults are the recorded configuration
+BATCH = int(os.environ.get("BENCH_BATCH", 16384))
+INNER_STEPS = int(os.environ.get("BENCH_SCAN", 8))
 WARMUP_CALLS = 2
 TIMED_CALLS = {"tpu": 8, "cpu": 1}
 
@@ -288,6 +290,26 @@ def _bench_w2v_1m(device, timed_calls):
             "vocab": V, "capacity": model.table.capacity}
 
 
+def _bench_oracle():
+    """Sequential numpy oracle words/s — the reference-faithful
+    single-threaded loop (testing/w2v_oracle.py), measured on a corpus
+    slice at bench hyperparameters.  Supplements the CPU-backend
+    baseline with a second, independently-derived reference point (the
+    oracle is the same math the reference executes per thread)."""
+    import numpy as np
+    from swiftmpi_tpu.data.text import synthetic_corpus
+    from swiftmpi_tpu.testing import W2VOracle
+
+    sents = [list(map(int, np.asarray(s)))
+             for s in synthetic_corpus(12, VOCAB, 200, seed=11)]
+    oracle = W2VOracle(len_vec=100, window=4, negative=20, alpha=0.05,
+                       server_lr=0.7, sample=-1.0, minibatch_lines=5000)
+    t0 = time.perf_counter()
+    oracle.train(sents, niters=1)
+    dt = time.perf_counter() - t0
+    return {"words_per_sec": 12 * 200 / dt}
+
+
 def child_main(which: str) -> None:
     import jax
 
@@ -316,6 +338,8 @@ def child_main(which: str) -> None:
     secondaries = [("lr", lambda: _bench_lr(device, max(timed // 4, 1))),
                    ("s2v", lambda: _bench_s2v(device, 1, model)),
                    ("w2v_shared", _shared)]
+    if which == "cpu":
+        secondaries.append(("oracle", _bench_oracle))
     if os.environ.get("BENCH_SCALE"):
         secondaries.append(
             ("w2v_1m", lambda: _bench_w2v_1m(device, max(timed // 2, 1))))
@@ -434,6 +458,13 @@ def parent_main() -> None:
                 "baseline = same fused step on the multithreaded JAX CPU "
                 "backend (reference publishes no numbers; no MPI toolchain "
                 "in image to run its 8-rank deployment)"),
+            "oracle_words_per_sec": (
+                round(cpu_res["oracle"]["words_per_sec"], 1)
+                if cpu_res and "oracle" in cpu_res else None),
+            "oracle_note": (
+                "sequential numpy port of the reference per-thread loop "
+                "(testing/w2v_oracle.py) at bench hyperparameters — the "
+                "single-thread reference-math rate"),
         },
         "secondary": {},
     }
