@@ -126,10 +126,34 @@ def main():
     np.testing.assert_allclose(host_array(new_state["h"]),
                                want_new["h"], rtol=1e-5, atol=1e-6)
 
+    # one REAL w2v training step through the explicit tpu backend on the
+    # hybrid mesh: per-family pushes, all_to_all routing on the local
+    # shard axis, the dp psum reconciling the table replicas
+    tcfg.update({"word2vec": {"len_vec": 8, "window": 2, "negative": 2,
+                              "sample": -1, "learning_rate": 0.05},
+                 "server": {"initial_learning_rate": 0.3, "frag_num": 64},
+                 "worker": {"minibatch": 32}})
+    tmodel = Word2Vec(config=tcfg, cluster=tcluster)
+    tmodel.build(corpus)
+    tb = next(CBOWBatcher(corpus, tmodel.vocab, tmodel.window).epoch(
+        2 * n))
+    tstep = tmodel._build_step()
+    tstate, tes, tec = tstep(
+        tmodel.table.state, tmodel._slot_of_vocab, tmodel._alias_prob,
+        tmodel._alias_idx, jnp.asarray(tb.centers),
+        jnp.asarray(tb.contexts), jnp.asarray(tb.ctx_mask),
+        jax.random.key(5))
+    tmodel.table.state = tstate
+    tloss = float(tes) / max(int(tec), 1)
+    assert np.isfinite(tloss), f"tpu-transfer step loss {tloss}"
+    changed = host_array(tstate["h"])
+    assert np.abs(changed).sum() > 0
+
     barrier("mp_child_done")
     print(f"MP_OK proc={process_index()}/{nprocs} devices={n} "
           f"sum={float(total)} loss={loss:.4f} "
-          f"epoch_err={losses[0]:.4f} tpu_transfer_ok=1", flush=True)
+          f"epoch_err={losses[0]:.4f} tpu_transfer_ok=1 "
+          f"tpu_step_loss={tloss:.4f}", flush=True)
     shutdown_distributed()
 
 
