@@ -610,12 +610,20 @@ class Word2Vec:
         meter = Throughput()
         step_i = 0
         for it in range(niters):
-            err_sum, err_cnt = 0.0, 0
             if hogwild:
                 err_sum, err_cnt = self._hogwild_epoch(
                     batcher, batch_size, meter)
                 state = self.table.state
             else:
+                # Per-batch loss scalars are QUEUED as device arrays
+                # and fetched once at epoch end: a float(es) per batch
+                # is a blocking round trip that serializes dispatch
+                # (through the axon tunnel that is ~5ms/batch of pure
+                # stall).  Summed host-side in Python ints at the end —
+                # an on-device int32 accumulator would wrap at ~2.1e9
+                # target pairs, i.e. exactly the corpus sizes this
+                # optimization targets.
+                es_q, ec_q = [], []
                 for batch in batcher.epoch(batch_size):
                     self._key, sub = jax.random.split(self._key)
                     args = (self._slot_of_vocab, self._alias_prob,
@@ -641,9 +649,11 @@ class Word2Vec:
                         step_i += 1
                         if step_i % self.local_steps == 0:
                             frozen = state
-                    err_sum += float(es)
-                    err_cnt += int(ec)
+                    es_q.append(es)
+                    ec_q.append(ec)
                     meter.record(batch.n_words)
+                err_sum = sum(float(x) for x in es_q)
+                err_cnt = sum(int(x) for x in ec_q)
             loss = err_sum / max(err_cnt, 1)
             losses.append(loss)
             log.info("iter %d: error %.5f  (%.0f words/s)",
@@ -670,7 +680,7 @@ class Word2Vec:
         step, n_workers = self._step
         group = n_workers * max(self.local_steps, 1)
         state = self.table.state
-        err_sum, err_cnt = 0.0, 0
+        es_q, ec_q = [], []
         buf = []
         dropped = 0
         for batch in batcher.epoch(batch_size):
@@ -688,12 +698,14 @@ class Word2Vec:
                                  self._alias_prob, self._alias_idx,
                                  c, x, m, sub)
             self.table.state = state
-            err_sum += float(es)
-            err_cnt += int(ec)
+            es_q.append(es)
+            ec_q.append(ec)
             meter.record(sum(b.n_words for b in buf))
             buf = []
         if buf:
             dropped += sum(b.n_words for b in buf)
+        err_sum = sum(float(x) for x in es_q)
+        err_cnt = sum(int(x) for x in ec_q)
         if err_cnt == 0:
             raise RuntimeError(
                 f"hogwild epoch dispatched NO group: the corpus yielded "
