@@ -570,3 +570,48 @@ def test_w2v_bfloat16_npz_checkpoint_resume(tmp_path, devices8):
     # and training continues from the restored state
     losses = model2.train(corpus, niters=1, batch_size=64)
     assert np.isfinite(losses[0])
+
+
+class _ShortTailBatcher:
+    """Wraps CBOWBatcher but truncates the final batch to an odd shape —
+    the in-repo batchers always pad to batch_size, so this is the only
+    way to exercise the fused loop's mid-epoch single-dispatch fallback."""
+
+    def __init__(self, inner):
+        self.inner = inner
+
+    def epoch(self, batch_size):
+        batches = list(self.inner.epoch(batch_size))
+        for b in batches[:-1]:
+            yield b
+        last = batches[-1]
+        n = max(1, batch_size // 2)
+        import swiftmpi_tpu.data.text as text
+        yield text.CBOWBatch(last.centers[:n], last.contexts[:n],
+                             last.ctx_mask[:n], min(last.n_words, n))
+
+
+def test_w2v_fused_inner_steps_trains_like_per_batch(devices8):
+    """[worker] inner_steps: N sync steps fused per dispatch via
+    lax.scan.  Same math and update order as the per-batch loop (only
+    the RNG key schedule differs), so the loss trajectory must track the
+    unfused run closely — including a genuinely odd-shaped tail batch,
+    which flushes the pending group through single dispatches."""
+    corpus = synthetic_corpus(90, vocab_size=60, length=12, seed=8)
+    base = make_model()
+    base_losses = base.train(corpus, niters=3, batch_size=64)
+
+    fused = make_model(worker={"inner_steps": 4})
+    fused_losses = fused.train(corpus, niters=3, batch_size=64)
+    assert fused_losses[-1] < fused_losses[0]
+    for a, b in zip(fused_losses, base_losses):
+        assert abs(a - b) / b < 0.2, (fused_losses, base_losses)
+
+    odd = make_model(worker={"inner_steps": 4})
+    odd.build(corpus)
+    batcher = _ShortTailBatcher(
+        CBOWBatcher(corpus, odd.vocab, odd.window, seed=2008))
+    odd_losses = odd.train(batcher=batcher, niters=3, batch_size=64)
+    assert odd_losses[-1] < odd_losses[0]
+    for a, b in zip(odd_losses, base_losses):
+        assert abs(a - b) / b < 0.25, (odd_losses, base_losses)
