@@ -27,7 +27,9 @@ def main(argv=None) -> int:
     cmd.registerParameter("data", "path of dataset")
     cmd.registerParameter("niters", "number of iterations")
     cmd.registerParameter("output", "path to output the embeddings")
-    cmd.registerParameter("variant", "sync (int keys) | async (hashed keys)")
+    cmd.registerParameter("variant", "sync (int keys) | async (hashed "
+                          "keys, bounded staleness) | hogwild (hashed "
+                          "keys, unsynchronized device replicas)")
     cmd.registerParameter("checkpoint",
                           "checkpoint path: save every iteration and "
                           "auto-resume if present (re-run the same "
@@ -39,12 +41,15 @@ def main(argv=None) -> int:
     if cmd.hasParameter("config"):
         global_config().load_conf(cmd.getValue("config")).parse()
     variant = cmd.getValue("variant", "sync")
-    if variant not in ("sync", "async"):
-        log.error("unknown -variant %r (expected sync|async)", variant)
+    if variant not in ("sync", "async", "hogwild"):
+        log.error("unknown -variant %r (expected sync|async|hogwild)",
+                  variant)
         return 1
     if variant == "async":
         global_config().set("word2vec", "local_steps", 4)
-    mode = "bkdr" if variant == "async" else "int"
+    elif variant == "hogwild":
+        global_config().set("word2vec", "async_mode", "hogwild")
+    mode = "int" if variant == "sync" else "bkdr"
 
     model = Word2Vec()
     niters = int(cmd.getValue("niters", "1"))

@@ -615,3 +615,24 @@ def test_w2v_fused_inner_steps_trains_like_per_batch(devices8):
     assert odd_losses[-1] < odd_losses[0]
     for a, b in zip(odd_losses, base_losses):
         assert abs(a - b) / b < 0.25, (odd_losses, base_losses)
+
+
+def test_w2v_cli_hogwild_variant(tmp_path, devices8):
+    from swiftmpi_tpu.apps.w2v_main import main
+    from swiftmpi_tpu.utils.config import global_config
+    corpus = synthetic_corpus(300, vocab_size=40, length=10, seed=6)
+    data = tmp_path / "corpus.txt"
+    with open(data, "w") as f:
+        for sent in corpus:
+            f.write(" ".join(map(str, sent)) + "\n")
+    conf = tmp_path / "w2v.conf"
+    conf.write_text("[word2vec]\nlen_vec: 8\nwindow: 2\nnegative: 3\n"
+                    "min_sentence_length: 2\n[worker]\nminibatch: 128\n")
+    out = str(tmp_path / "embhw.txt")
+    try:
+        assert main(["w2v", "-config", str(conf), "-data", str(data),
+                     "-variant", "hogwild", "-niters", "1",
+                     "-output", out]) == 0
+    finally:
+        global_config().clear()
+    assert len(open(out).readlines()) == 40
