@@ -107,7 +107,9 @@ def _build_w2v(device, w2v_overrides=None):
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
                      "sample": 1e-4, "learning_rate": 0.05,
                      **(w2v_overrides or {})},
-        "server": {"initial_learning_rate": 0.7, "frag_num": 1000},
+        # BENCH_DTYPE=bfloat16 measures the half-width-storage mode
+        "server": {"initial_learning_rate": 0.7, "frag_num": 1000,
+                   "dtype": os.environ.get("BENCH_DTYPE", "float32")},
         "worker": {"minibatch": 5000},
     })
     with jax.default_device(device):
