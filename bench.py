@@ -366,6 +366,24 @@ def _parse_child_stdout(stdout):
     return None
 
 
+def _tpu_alive(timeout_s: float = 75) -> bool:
+    """Cheap liveness probe before committing to a full TPU child: when
+    the tunnel is down, backend INIT hangs (it does not error), so an
+    unprobed child burns its entire timeout producing nothing — and if
+    the driver's own guard around bench.py is shorter than
+    hang + cpu-baseline time, the round records NO number at all."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "axon"
+    try:
+        p = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('AXON_OK')"],
+            capture_output=True, text=True, timeout=timeout_s, env=env)
+        return p.returncode == 0 and "AXON_OK" in (p.stdout or "")
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def _run_child(which: str, timeout_s: float):
     env = dict(os.environ)
     if which == "cpu":
@@ -417,17 +435,23 @@ def parent_main() -> None:
     # Children run SEQUENTIALLY: the CPU baseline is itself a multithreaded
     # measurement on this host and must not share cores with the TPU
     # child's host-side dispatch, or vs_baseline is inflated.
-    tpu_res, tpu_err, dt = _run_child("tpu", TPU_TIMEOUT_S)
-    # transient UNAVAILABLE at plugin init dies in seconds (the child is
-    # pinned to axon, no silent cpu fallback): a backoff ladder rides out
-    # tunnel flakiness without blowing the overall budget
-    for backoff in (10, 45, 90):
-        if tpu_res is not None or dt >= FAST_FAIL_S:
-            break
-        time.sleep(backoff)
-        tpu_res, retry_err, dt = _run_child("tpu", TPU_RETRY_TIMEOUT_S)
-        if tpu_res is None:
-            tpu_err = f"{tpu_err}; retry: {retry_err}"
+    if _tpu_alive():
+        tpu_res, tpu_err, dt = _run_child("tpu", TPU_TIMEOUT_S)
+        # transient UNAVAILABLE at plugin init dies in seconds (the child
+        # is pinned to axon, no silent cpu fallback): a backoff ladder
+        # rides out flakiness without blowing the overall budget
+        for backoff in (10, 45, 90):
+            if tpu_res is not None or dt >= FAST_FAIL_S:
+                break
+            time.sleep(backoff)
+            tpu_res, retry_err, dt = _run_child("tpu", TPU_RETRY_TIMEOUT_S)
+            if tpu_res is None:
+                tpu_err = f"{tpu_err}; retry: {retry_err}"
+    else:
+        tpu_res = None
+        tpu_err = ("liveness probe: axon backend init hung/failed within "
+                   "75s — tunnel down; skipped the TPU child to protect "
+                   "the overall bench budget")
     if tpu_res is None:
         degraded.append(f"tpu_unavailable: {tpu_err}")
 
