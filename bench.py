@@ -191,21 +191,25 @@ def _bench_lr(device, timed_calls):
         # pad rows must not count toward rows/s
         batches = list(iter_minibatches(data, LR_BATCH, F,
                                         drop_remainder=True))
-        step = model._build_step()
+        # whole epoch = ONE dispatch (lax.scan over the stacked batches):
+        # per-batch dispatches cost ~5ms each through the tunnel, which
+        # swamps a9a-scale step compute and made TPU lose to CPU 16x in
+        # round 2's first on-chip run
+        multi = model._build_multi_step()
         prepared = []
         for b in batches:
             slots = model.table.key_index.lookup(
                 np.where(b.mask, b.feat_ids, 0))
-            prepared.append(tuple(jax.device_put(jnp.asarray(x), device)
-                                  for x in (slots, b.feat_vals, b.mask,
-                                            b.targets)))
+            prepared.append((slots, b.feat_vals, b.mask, b.targets))
+        stacked = tuple(
+            jax.device_put(jnp.asarray(np.stack(col)), device)
+            for col in zip(*prepared))
         state = {f: jax.device_put(v, device)
                  for f, v in model.table.state.items()}
 
         def epoch(state):
-            for slots, vals, mask, targets in prepared:
-                state, loss, n = step(state, slots, vals, mask, targets)
-            return state, loss
+            state, losses, ns = multi(state, *stacked)
+            return state, losses[-1]
 
         state, loss = epoch(state)                    # warmup/compile
         _fence(state, loss)
@@ -366,14 +370,28 @@ def _parse_child_stdout(stdout):
     return None
 
 
+def _tpu_env() -> dict:
+    """Environment for anything that must reach the real chip: pinned to
+    the axon PJRT plugin (no silent cpu fallback), with the plugin's
+    registration precondition guaranteed — the sitecustomize hook only
+    registers axon when PALLAS_AXON_POOL_IPS is set.  Shared by the
+    liveness probe and the TPU child so they cannot diverge (a round-2
+    bug: the child cleared the pool var and died at init while the
+    probe, inheriting it, succeeded)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "axon"
+    if not env.get("PALLAS_AXON_POOL_IPS"):
+        env["PALLAS_AXON_POOL_IPS"] = "127.0.0.1"
+    return env
+
+
 def _tpu_alive(timeout_s: float = 75) -> bool:
     """Cheap liveness probe before committing to a full TPU child: when
     the tunnel is down, backend INIT hangs (it does not error), so an
     unprobed child burns its entire timeout producing nothing — and if
     the driver's own guard around bench.py is shorter than
     hang + cpu-baseline time, the round records NO number at all."""
-    env = dict(os.environ)
-    env["JAX_PLATFORMS"] = "axon"
+    env = _tpu_env()
     try:
         p = subprocess.run(
             [sys.executable, "-c",
@@ -385,8 +403,8 @@ def _tpu_alive(timeout_s: float = 75) -> bool:
 
 
 def _run_child(which: str, timeout_s: float):
-    env = dict(os.environ)
     if which == "cpu":
+        env = dict(os.environ)
         env["JAX_PLATFORMS"] = "cpu"
         env["PALLAS_AXON_POOL_IPS"] = ""   # flaky tunnel: never touch it
     else:
@@ -397,8 +415,7 @@ def _run_child(which: str, timeout_s: float):
         # both attempts landed on cpu while a direct axon probe minutes
         # later succeeded).  Pinned, a tunnel hiccup dies in seconds and
         # the parent's retry ladder gets a real second chance.
-        env["JAX_PLATFORMS"] = "axon"
-        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env = _tpu_env()
     t0 = time.time()
     try:
         proc = subprocess.run(

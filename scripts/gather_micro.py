@@ -1,0 +1,95 @@
+#!/usr/bin/env python
+"""Microbench: XLA row-gather / scatter-add throughput on the live chip.
+
+The w2v step is gather/scatter bound (profile_step.py: the fused
+gather+math phase dominates at ~12ms for ~475K row accesses).  This asks
+what the hardware path can actually sustain under layouts we control:
+
+  * row width 100 (demo.conf len_vec) vs 128 (lane-aligned)
+  * fp32 vs bf16 rows
+  * table capacity 17K vs 256K (cache/locality effect)
+  * gather vs scatter-add vs sort+segment-sum
+
+Run: JAX_PLATFORMS=axon python scripts/gather_micro.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+
+
+def timeit(fn, *args, reps=16):
+    import jax
+    out = fn(*args)
+    jax.tree_util.tree_leaves(out)[0].block_until_ready()
+    np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[:1]  # D2H fence
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
+    return (time.perf_counter() - t0) / reps
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    N = 344_064          # bench gather count: B*(K+1) at B=16384, K=20
+    rng = np.random.default_rng(0)
+
+    print(f"device: {jax.devices()[0]}", flush=True)
+    for cap in (17_314, 262_144):
+        idx = jnp.asarray(rng.integers(0, cap, N), jnp.int32)
+        for d in (100, 128):
+            for dt in (jnp.float32, jnp.bfloat16):
+                table = jnp.asarray(
+                    rng.standard_normal((cap, d)), dt)
+                take = jax.jit(lambda t, i: jnp.take(t, i, axis=0).sum())
+                ms = timeit(take, table, idx) * 1e3
+                gb = N * d * table.dtype.itemsize / 1e9
+                print(f"gather  cap={cap:7d} d={d} {table.dtype.name:9s}"
+                      f" {ms:7.2f} ms  {gb / ms * 1e3:6.1f} GB/s", flush=True)
+
+        # scatter-add and sort+segment paths at d=100 fp32
+        d = 100
+        table = jnp.asarray(rng.standard_normal((cap, d)), jnp.float32)
+        g = jnp.asarray(rng.standard_normal((N, d)), jnp.float32)
+
+        scat = jax.jit(lambda t, i, g: t.at[i].add(g))
+        ms = timeit(scat, table, idx, g) * 1e3
+        print(f"scatter+ cap={cap:7d} d={d} float32   {ms:7.2f} ms",
+              flush=True)
+
+        def sort_seg(i, g):
+            order = jnp.argsort(i)
+            si = i[order]
+            sg = g[order]
+            new = jnp.concatenate([jnp.ones((1,), jnp.int32),
+                                   (si[1:] != si[:-1]).astype(jnp.int32)])
+            seg = jnp.cumsum(new) - 1
+            acc = jnp.zeros((N, d), jnp.float32).at[seg].add(sg)
+            return acc.sum()
+        ms = timeit(jax.jit(sort_seg), idx, g) * 1e3
+        print(f"sort+seg cap={cap:7d} d={d} float32   {ms:7.2f} ms",
+              flush=True)
+
+    # one-hot matmul gather-equivalent at bench shape (MXU alternative)
+    cap = 17_314
+    B, K1 = 16_384, 21
+    table = jnp.asarray(rng.standard_normal((cap, 100)), jnp.bfloat16)
+    idx2 = jnp.asarray(rng.integers(0, cap, (B, K1)), jnp.int32)
+
+    def onehot_mm(t, i):
+        oh = jax.nn.one_hot(i.reshape(-1), cap, dtype=jnp.bfloat16)
+        return (oh @ t).sum()
+    ms = timeit(jax.jit(onehot_mm), table, idx2) * 1e3
+    print(f"onehot-matmul gather (bf16, cap=17314): {ms:7.2f} ms", flush=True)
+
+
+if __name__ == "__main__":
+    main()

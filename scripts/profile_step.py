@@ -7,7 +7,7 @@ per-phase cost falls out by subtraction:
 
   a. gathers only            (pull h_t + v_ctx, reduce to scalar)
   b. + einsum/grad math      (neu1, f, g, contribs, err)
-  c. + mean-scale            (_assemble_push counts)
+  c. + push assembly         (family layout; mean-norm now lives in push)
   d. full step               (+ transfer.push dense/sparse + AdaGrad)
 
 plus the roofline context (bytes moved per phase at fp32) printed next to
@@ -49,7 +49,7 @@ def main():
     mask = jax.device_put(jnp.asarray(b0.ctx_mask), dev)
     key = jax.random.key(3)
 
-    from swiftmpi_tpu.models.word2vec import _assemble_push, _mean_scale
+    from swiftmpi_tpu.models.word2vec import _assemble_push
     from swiftmpi_tpu.ops.sampling import sample_alias
     from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
 
@@ -97,15 +97,17 @@ def main():
     def phase_c(state, key):
         t_slots, ctx_slots, h_c, v_c, err = _grads(state, key)
         pushes = _assemble_push(t_slots.reshape(-1), ctx_slots.reshape(-1),
-                                h_c.reshape(-1, d), v_c.reshape(-1, d), cap)
-        return sum(g.sum() for _, gr in pushes for g in gr.values()) + err
+                                h_c.reshape(-1, d), v_c.reshape(-1, d))
+        return sum(g.sum() for _, gr, _m in pushes
+                   for g in gr.values()) + err
 
     def phase_d(state, key):
         t_slots, ctx_slots, h_c, v_c, err = _grads(state, key)
         pushes = _assemble_push(t_slots.reshape(-1), ctx_slots.reshape(-1),
-                                h_c.reshape(-1, d), v_c.reshape(-1, d), cap)
-        for slots, grads in pushes:
-            state = model.transfer.push(state, slots, grads, model.access)
+                                h_c.reshape(-1, d), v_c.reshape(-1, d))
+        for slots, grads, mean in pushes:
+            state = model.transfer.push(state, slots, grads, model.access,
+                                        mean=mean)
         return state["h"].sum() + err
 
     nt, nc = B * (K + 1), B * W2

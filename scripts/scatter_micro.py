@@ -1,0 +1,28 @@
+import time, numpy as np, jax, jax.numpy as jnp
+
+def timeit(fn, *a, reps=16):
+    out = fn(*a); float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
+    t0 = time.perf_counter()
+    for _ in range(reps): out = fn(*a)
+    float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
+    return (time.perf_counter()-t0)/reps*1e3
+
+rng = np.random.default_rng(0)
+N = 114688          # LR bench: 8192 rows x 14 nnz
+g = jnp.asarray(rng.standard_normal((N,1)), jnp.float32)
+for cap in (512, 65536):
+    idx = jnp.asarray(rng.integers(0, min(cap,124), N), jnp.int32)
+    scat = jax.jit(lambda i, g: jnp.zeros((cap,1), jnp.float32).at[i].add(g).sum())
+    print(f"cap={cap:6d} scatter : {timeit(scat, idx, g):7.2f} ms", flush=True)
+    if cap <= 4096:
+        def oh(i, g):
+            o = jax.nn.one_hot(i, cap, dtype=jnp.float32)   # (N, cap)
+            return (o.T @ g).sum()
+        print(f"cap={cap:6d} onehot  : {timeit(jax.jit(oh), idx, g):7.2f} ms", flush=True)
+capw, Nw, d = 17314, 344064, 100
+gi = jnp.asarray(rng.integers(0, capw, Nw), jnp.int32)
+gw = jnp.asarray(rng.standard_normal((Nw,d)), jnp.float32)
+scat2 = jax.jit(lambda i, g: jnp.zeros((capw,d), jnp.float32).at[i].add(g).sum())
+print(f"w2v dense scatter (344K x 100 -> 17314): {timeit(scat2, gi, gw):7.2f} ms", flush=True)
+cnt = jax.jit(lambda i: jnp.zeros((capw,), jnp.float32).at[i].add(1.0).sum())
+print(f"w2v counts scatter (344K scalars)      : {timeit(cnt, gi):7.2f} ms", flush=True)

@@ -68,38 +68,59 @@ class LogisticRegression:
         self.table = self.cluster.create_table(
             "lr", self.access, capacity_per_shard, seed=seed)
         self.transfer = self.cluster.transfer
+        # [worker] inner_steps: fuse N minibatches per dispatch via
+        # lax.scan, as in word2vec — through the axon tunnel one dispatch
+        # costs ~5ms, which dwarfs an a9a-scale step
+        self.inner_steps = (
+            self.config.get("worker", "inner_steps").to_int32()
+            if self.config.has("worker", "inner_steps") else 1)
         self._step = None
+        self._multi = None
 
     # -- fused minibatch step ---------------------------------------------
-    def _build_step(self):
+    def _step_core(self, state, slots, vals, mask, targets):
         access = self.access
         transfer = self.transfer
-        capacity = self.table.capacity
+        B, F = slots.shape
+        flat = jnp.where(mask, slots, -1).reshape(-1)
+        rows = transfer.pull(state, flat, access)["val"]
+        w = rows.reshape(B, F)
+        logits = jnp.sum(w * vals * mask, axis=1)
+        predict = jax.nn.sigmoid(logits)
+        row_valid = mask.any(axis=1)
+        err = jnp.where(row_valid, targets - predict, 0.0)
+        # mean=True: the reference's grad.val/grad.count normalization at
+        # push serialization (lr.cpp:32-38), folded into the transfer's
+        # dedup pass
+        contrib = (err[:, None] * vals * mask).reshape(-1)
+        new_state = transfer.push(
+            state, flat, {"val": contrib[:, None]}, access, mean=True)
+        loss = jnp.sum(err * err) / jnp.maximum(row_valid.sum(), 1)
+        return new_state, loss, row_valid.sum()
+
+    def _build_step(self):
+        return jax.jit(self._step_core)
+
+    def _build_multi_step(self):
+        """Scan the fused step over a stack of minibatches in ONE dispatch.
+
+        The reference amortizes per-batch overhead with 13 worker threads
+        per rank (lr.cpp:225); on TPU the equivalent lever is fusing the
+        per-batch host->device round-trip away — through a tunnel each
+        dispatch costs ~5ms, which dwarfs the a9a-scale step compute.
+        Inputs carry a leading ``n_batches`` axis; returns per-batch
+        losses/counts so the training-error log stays per-minibatch."""
 
         @jax.jit
-        def step(state, slots, vals, mask, targets):
-            B, F = slots.shape
-            flat = jnp.where(mask, slots, -1).reshape(-1)
-            rows = transfer.pull(state, flat, access)["val"]
-            w = rows.reshape(B, F)
-            logits = jnp.sum(w * vals * mask, axis=1)
-            predict = jax.nn.sigmoid(logits)
-            row_valid = mask.any(axis=1)
-            err = jnp.where(row_valid, targets - predict, 0.0)
-            # per-key contribution counts -> mean-normalized grads
-            # (reference grad.val/grad.count at push serialization)
-            safe = jnp.where(mask, slots, capacity).reshape(-1)
-            counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
-                1.0, mode="drop")
-            scale = 1.0 / jnp.maximum(counts, 1.0)
-            contrib = (err[:, None] * vals * mask).reshape(-1)
-            contrib = contrib * scale[jnp.clip(flat, 0, capacity - 1)]
-            new_state = transfer.push(
-                state, flat, {"val": contrib[:, None]}, access)
-            loss = jnp.sum(err * err) / jnp.maximum(row_valid.sum(), 1)
-            return new_state, loss, row_valid.sum()
+        def multi(state, slots, vals, mask, targets):
+            def body(state, xs):
+                state, loss, n = self._step_core(state, *xs)
+                return state, (loss, n)
+            state, (losses, ns) = jax.lax.scan(
+                body, state, (slots, vals, mask, targets))
+            return state, losses, ns
 
-        return step
+        return multi
 
     # -- training (lr.cpp:157-240) ----------------------------------------
     def train(self, data, niters: int = 1,
@@ -112,9 +133,36 @@ class LogisticRegression:
             data = load_data(data)
         if self._step is None:
             self._step = self._build_step()
+        inner = max(1, self.inner_steps)
+        if inner > 1 and self._multi is None:
+            self._multi = self._build_multi_step()
         F = max_feats or _max_feats(data)
         losses = []
         state = self.table.state
+        # deferred per-batch loss scalars: fetched once per epoch (a
+        # float() per batch is a blocking device round trip)
+        pending = []
+        group = []
+
+        def flush_group():
+            nonlocal state
+            if not group:
+                return
+            if len(group) == inner and inner > 1:
+                stacked = tuple(
+                    jnp.asarray(np.stack(col)) for col in zip(*group))
+                state, ls, ns = self._multi(state, *stacked)
+                pending.append((ls, ns))
+            else:
+                # tail (or pre-grow flush) smaller than a full group:
+                # per-batch dispatch avoids a recompile per distinct size
+                for slots, vals, mask, targets in group:
+                    state, loss, n = self._step(
+                        state, jnp.asarray(slots), jnp.asarray(vals),
+                        jnp.asarray(mask), jnp.asarray(targets))
+                    pending.append((loss, n))
+            group.clear()
+
         for it in range(niters):
             total, count = 0.0, 0
             for batch in iter_minibatches(data, self.minibatch, F):
@@ -127,20 +175,29 @@ class LogisticRegression:
                         # unlike the reference's self-growing
                         # dense_hash_map, dense HBM arrays grow by explicit
                         # re-layout; the jitted step bakes in capacity, so
-                        # rebuild it (loop: one batch may need >1 doubling)
+                        # rebuild it (loop: one batch may need >1 doubling).
+                        # Queued batches hold OLD-layout slots — flush them
+                        # through the old step first.
+                        flush_group()
                         self.table.state = state   # sync the live buffers
                         self.table.grow()
                         log.info("table grown to %d rows",
                                  self.table.capacity)
                         self._step = self._build_step()
+                        self._multi = (self._build_multi_step()
+                                       if inner > 1 else None)
                         state = self.table.state
-                state, loss, n = self._step(
-                    state, jnp.asarray(slots),
-                    jnp.asarray(batch.feat_vals),
-                    jnp.asarray(batch.mask),
-                    jnp.asarray(batch.targets))
-                total += float(loss) * int(n)
-                count += int(n)
+                group.append((slots, batch.feat_vals, batch.mask,
+                              batch.targets))
+                if len(group) == inner:
+                    flush_group()
+            flush_group()
+            for loss, n in pending:
+                loss, n = np.asarray(loss), np.asarray(n)
+                # scanned groups return per-batch vectors
+                total += float((loss * n).sum())
+                count += int(n.sum())
+            pending.clear()
             mean_err = total / max(count, 1)
             losses.append(mean_err)
             log.info("iter %d: %d records  error: %.6f", it, count, mean_err)
@@ -181,8 +238,9 @@ class LogisticRegression:
 
     def load(self, path: str) -> int:
         n = load_table_text(self.table, path, fields=("val",))
-        # loading may have grown the table; the jitted step bakes in the
-        # old capacity (count-normalization scatter bounds), so force a
-        # rebuild on next train()
+        # loading may have grown the table; the jitted steps bake in the
+        # old capacity (push scatter bounds), so force a rebuild on next
+        # train()
         self._step = None
+        self._multi = None
         return n

@@ -53,6 +53,7 @@ from swiftmpi_tpu.io.checkpoint import dump_table_text, load_table_text
 from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
 from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
 from swiftmpi_tpu.parameter import w2v_access
+from swiftmpi_tpu.transfer import PushSpec
 from swiftmpi_tpu.utils.config import ConfigParser, global_config
 from swiftmpi_tpu.utils.logger import get_logger
 from swiftmpi_tpu.utils.timers import Throughput
@@ -100,29 +101,20 @@ def _stack_group(batches):
     return c, x, m
 
 
-def _mean_scale(slots_flat, capacity):
-    """Reciprocal per-key contribution count (the reference's grad/count
-    mean normalization at push serialization, word2vec.h:120-132).
-    Invalid (-1) slots get a scale against a clipped index; their
-    contributions are already zeroed by the caller's masks."""
-    safe = jnp.where(slots_flat >= 0, slots_flat, capacity)
-    counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
-        1.0, mode="drop")
-    return 1.0 / jnp.maximum(
-        counts[jnp.clip(slots_flat, 0, capacity - 1)], 1.0)
-
-
-def _assemble_push(tf, cf, h_flat, v_flat, capacity):
-    """Mean-normalize per-key contributions and lay out one push per
-    gradient family: h-grads keyed by target slots, v-grads keyed by
-    context slots.  (Round 1 concatenated both families into a single
-    zero-padded batch — which doubled every downstream push array and made
-    the transfer layer sort/gather/scatter 2x the rows, half of them
-    zeros.  Per-family pushes carry only real contributions; apply_push
-    handles partial grad dicts.)"""
-    h_flat = h_flat * _mean_scale(tf, capacity)[:, None]
-    v_flat = v_flat * _mean_scale(cf, capacity)[:, None]
-    return ((tf, {"h": h_flat}), (cf, {"v": v_flat}))
+def _assemble_push(tf, cf, h_flat, v_flat):
+    """Lay out one push per gradient family: h-grads keyed by target
+    slots, v-grads keyed by context slots, both with ``mean=True`` — the
+    reference's per-key grad/count normalization (word2vec.h:120-132)
+    now happens inside the transfer's own dedup pass, where the counts
+    come free with the segment/scatter sums.  (Round 1 concatenated both
+    families into a single zero-padded batch — which doubled every
+    downstream push array; round 2's worker-side pre-scaling cost a
+    capacity scatter + batch gather + (B, d) multiply per family, ~25%
+    of the measured step — both folded away here.)  Per-family pushes
+    carry only real contributions; apply_push handles partial grad
+    dicts."""
+    return (PushSpec(tf, {"h": h_flat}, mean=True),
+            PushSpec(cf, {"v": v_flat}, mean=True))
 
 
 def w2v_formatter(row: Dict[str, np.ndarray]) -> str:
@@ -358,7 +350,6 @@ class Word2Vec:
             return self._build_grads_shared()
         access = self.access
         transfer = self.transfer
-        capacity = self.table.capacity
         K = self.negative
         alpha = self.alpha
         d = self.len_vec
@@ -402,8 +393,7 @@ class Word2Vec:
 
             pushes = _assemble_push(
                 t_slots.reshape(-1), ctx_slots.reshape(-1),
-                h_contrib.reshape(-1, d), v_contrib.reshape(-1, d),
-                capacity)
+                h_contrib.reshape(-1, d), v_contrib.reshape(-1, d))
 
             err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
             err_cnt = t_valid.sum()
@@ -436,7 +426,6 @@ class Word2Vec:
         the oracle tests pin it."""
         access = self.access
         transfer = self.transfer
-        capacity = self.table.capacity
         K = self.shared_pool
         alpha = self.alpha
         d = self.len_vec
@@ -494,14 +483,12 @@ class Word2Vec:
             # Duplicate pool draws of one key sum too — each draw is a
             # sample, as in the reference's per-center draws.
             pos_slots = jnp.where(row_valid, c_slots, -1)
-            gh_pos = gh_pos * _mean_scale(pos_slots, capacity)[:, None]
             neg_slots = jnp.where(n_valid.any(axis=0), n_slots, -1)
             cslots_flat = ctx_slots.reshape(-1)
-            v_flat = v_contrib.reshape(-1, d) \
-                * _mean_scale(cslots_flat, capacity)[:, None]
-            pushes = ((pos_slots, {"h": gh_pos}),
-                      (neg_slots, {"h": gh_neg}),
-                      (cslots_flat, {"v": v_flat}))
+            v_flat = v_contrib.reshape(-1, d)
+            pushes = (PushSpec(pos_slots, {"h": gh_pos}, mean=True),
+                      PushSpec(neg_slots, {"h": gh_neg}),
+                      PushSpec(cslots_flat, {"v": v_flat}, mean=True))
 
             err_sum = jnp.sum(1e4 * g_pos * g_pos) \
                 + jnp.sum(1e4 * g_neg * g_neg)
@@ -518,7 +505,6 @@ class Word2Vec:
         padding) contribute nothing."""
         access = self.access
         transfer = self.transfer
-        capacity = self.table.capacity
         K = self.negative
         alpha = self.alpha
         d = self.len_vec
@@ -558,8 +544,7 @@ class Word2Vec:
 
             pushes = _assemble_push(
                 t_slots.reshape(-1), ctx_slots.reshape(-1),
-                h_contrib.reshape(-1, d), v_contrib.reshape(-1, d),
-                capacity)
+                h_contrib.reshape(-1, d), v_contrib.reshape(-1, d))
 
             err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
             err_cnt = t_valid.sum()
@@ -572,8 +557,9 @@ class Word2Vec:
         transfer = self.transfer
 
         def apply_fn(state, pushes):
-            for slots, grads in pushes:
-                state = transfer.push(state, slots, grads, access)
+            for slots, grads, mean in pushes:
+                state = transfer.push(state, slots, grads, access,
+                                      mean=mean)
             return state
 
         return apply_fn
@@ -800,7 +786,7 @@ class Word2Vec:
         """Mid-run table growth (reference dense_hash_map self-growth,
         sparsetable.h:17-149 — here an explicit HBM re-layout).  Owns the
         post-grow fixups a bare ``table.grow()`` would leave stale: the
-        jitted step bakes in the old capacity (its _mean_scale scatter
+        jitted step bakes in the old capacity (the push scatter
         bounds), and the cached vocab->slot map holds old-layout slots —
         either one silently corrupts scatters if kept."""
         self.table.grow(new_capacity_per_shard)
@@ -819,7 +805,7 @@ class Word2Vec:
             raise RuntimeError("build() or load() the model before resume()")
         extra = load_checkpoint(self.table, checkpoint_path)
         # load_checkpoint grows the table for post-grow() checkpoints; any
-        # cached jitted step baked in the old capacity (the _mean_scale
+        # cached jitted step baked in the old capacity (the push
         # scatter bounds), so force a rebuild
         self._step = None
         if self.vocab is not None:

@@ -4,6 +4,7 @@ TPU-native equivalent of `/root/reference/src/transfer/` +
 `/root/reference/src/parameter/global_{pull,push}_access.h` — see api.py.
 """
 
-from swiftmpi_tpu.transfer.api import Transfer, get_transfer
+from swiftmpi_tpu.transfer.api import (PushSpec, Transfer,
+                                       get_transfer)
 
-__all__ = ["Transfer", "get_transfer"]
+__all__ = ["PushSpec", "Transfer", "get_transfer"]

@@ -43,9 +43,36 @@ from __future__ import annotations
 
 from typing import Optional
 
+import jax
+
 from swiftmpi_tpu.parameter.access import AccessMethod
 from swiftmpi_tpu.parameter.sparse_table import TableState
 from swiftmpi_tpu.utils.config import ConfigParser
+
+
+@jax.tree_util.register_pytree_node_class
+class PushSpec:
+    """One gradient-family push: ``(slots, grads, mean)``.
+
+    A pytree whose ``mean`` flag is static aux data, so a jitted function
+    taking pushes as an argument (e.g. the async snapshot mode's
+    ``jit(apply_fn)(state, pushes)``) sees a concrete Python bool, not a
+    traced scalar.  Iterates like the plain 3-tuple it replaces."""
+
+    def __init__(self, slots, grads, mean: bool = False):
+        self.slots = slots
+        self.grads = grads
+        self.mean = bool(mean)
+
+    def __iter__(self):
+        return iter((self.slots, self.grads, self.mean))
+
+    def tree_flatten(self):
+        return (self.slots, self.grads), self.mean
+
+    @classmethod
+    def tree_unflatten(cls, mean, children):
+        return cls(children[0], children[1], mean)
 
 
 class Transfer:
@@ -63,7 +90,17 @@ class Transfer:
         raise NotImplementedError
 
     def push(self, state: TableState, slots, grads: TableState,
-             access: AccessMethod) -> TableState:
+             access: AccessMethod, mean: bool = False) -> TableState:
+        """Apply ``grads`` at ``slots``.  ``mean=True`` divides each
+        unique key's gradient sum by its contribution count before the
+        access rule runs — the reference's ``grad /= count``
+        normalization at push serialization (word2vec.h:120-132,
+        lr.cpp:32-38), folded into the backend's own dedup pass.  Doing
+        it here instead of pre-scaling each contribution saves a
+        capacity-sized scatter + a batch-sized gather + a (B, d)
+        multiply per push on the worker side (measured at ~25% of the
+        w2v step, docs/ARCHITECTURE.md), and matches the reference's
+        sum-then-divide order of operations bit-for-bit."""
         raise NotImplementedError
 
 

@@ -29,10 +29,10 @@ class LocalTransfer(Transfer):
             out[f] = rows
         return out
 
-    def push(self, state, slots, grads, access):
+    def push(self, state, slots, grads, access, mean=False):
         slots = np.asarray(slots, np.int64)
         valid = slots >= 0
-        uniq = np.unique(slots[valid])
+        uniq, counts = np.unique(slots[valid], return_counts=True)
         combined = {}
         for f in grads:
             g = np.asarray(grads[f], np.float32)
@@ -40,6 +40,8 @@ class LocalTransfer(Transfer):
             acc = np.zeros((len(uniq), width), np.float32)
             pos = np.searchsorted(uniq, slots[valid])
             np.add.at(acc, pos, g[valid])
+            if mean:
+                acc /= np.maximum(counts, 1)[:, None]
             combined[f] = acc
         current = {f: np.asarray(state[f])[uniq]
                    for f in access.touched_fields(grads)}

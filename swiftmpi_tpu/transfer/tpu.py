@@ -220,21 +220,21 @@ class TpuTransfer(Transfer):
         return _pull
 
     # -- push --------------------------------------------------------------
-    def push(self, state, slots, grads, access):
+    def push(self, state, slots, grads, access, mean=False):
         slots = jnp.asarray(slots, jnp.int32)
-        sig = self._signature(state, slots, grads)
+        sig = self._signature(state, slots, grads) + (mean,)
         fn = self._push_cache.get(sig)
         if fn is None:
             fn = self._push_cache.setdefault(
                 sig, jax.jit(self._build_push(state, access,
-                                              tuple(sorted(grads)))))
+                                              tuple(sorted(grads)), mean)))
         if self.bucket_capacity is None:
             return fn(state, slots, grads)
         out, ovf = fn(state, slots, grads)
         self._record_overflow("push", ovf)
         return out
 
-    def _build_push(self, state, access, grad_fields):
+    def _build_push(self, state, access, grad_fields, mean=False):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
         bspec = self._batch_spec()
@@ -256,6 +256,16 @@ class TpuTransfer(Transfer):
             # received (slot, grad) pairs -> dense per-shard grad sums;
             # untouched rows get exact zero and the access rule is a no-op.
             safe_rows = jnp.where(ok, got, cap_per_shard).reshape(-1)
+            inv = None
+            if mean:
+                # contribution counts accumulate at the owning shard from
+                # the received requests themselves — no extra collective
+                counts = jnp.zeros((cap_per_shard,), jnp.float32).at[
+                    safe_rows].add(ok.reshape(-1).astype(jnp.float32),
+                                   mode="drop")
+                if self.dp_axis:
+                    counts = jax.lax.psum(counts, self.dp_axis)
+                inv = (1.0 / jnp.maximum(counts, 1.0))[:, None]
             dense = {}
             for f in grad_fields:
                 g = jnp.asarray(grads_l[f])
@@ -276,7 +286,7 @@ class TpuTransfer(Transfer):
                     # dense grads (the one cross-DCN collective per push)
                     # so every group applies the identical global update
                     acc = jax.lax.psum(acc, self.dp_axis)
-                dense[f] = acc
+                dense[f] = acc * inv if mean else acc
             new_fields = access.apply_push(state_l, dense)
             out = dict(state_l)
             out.update(new_fields)

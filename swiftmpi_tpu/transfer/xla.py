@@ -58,33 +58,39 @@ class XlaTransfer(Transfer):
                 for f in (fields or access.pull_fields)}
 
     # -- push (global_push_access.h:26-43 + server.h:159-176) --------------
-    def push(self, state, slots, grads, access):
+    def push(self, state, slots, grads, access, mean=False):
         slots = jnp.asarray(slots, jnp.int32)
         capacity = next(iter(state.values())).shape[0]
         dense = self.dense_apply
         if dense is None:
             dense = slots.shape[0] >= capacity // 2
         if dense:
-            return self._push_dense(state, slots, grads, access)
-        return self._push_sparse(state, slots, grads, access)
+            return self._push_dense(state, slots, grads, access, mean)
+        return self._push_sparse(state, slots, grads, access, mean)
 
-    def _push_dense(self, state, slots, grads, access):
+    def _push_dense(self, state, slots, grads, access, mean=False):
         capacity = next(iter(state.values())).shape[0]
         valid = slots >= 0
         # OOB scatter indices are dropped by XLA; route padding there.
         safe = jnp.where(valid, slots, capacity)
+        inv = None
+        if mean:
+            counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
+                1.0, mode="drop")
+            inv = (1.0 / jnp.maximum(counts, 1.0))[:, None]
         dense_grads = {}
         for f in grads:
             g = jnp.asarray(grads[f])
             width = state[f].shape[1]
             acc = jnp.zeros((capacity, width), g.dtype)
-            dense_grads[f] = acc.at[safe].add(g, mode="drop")
+            acc = acc.at[safe].add(g, mode="drop")
+            dense_grads[f] = acc * inv if mean else acc
         new_fields = access.apply_push(state, dense_grads)
         out = dict(state)
         out.update(new_fields)
         return out
 
-    def _push_sparse(self, state, slots, grads, access):
+    def _push_sparse(self, state, slots, grads, access, mean=False):
         capacity = next(iter(state.values())).shape[0]
         B = slots.shape[0]
         if B == 0:
@@ -106,12 +112,18 @@ class XlaTransfer(Transfer):
         rep_valid = rep_slots < capacity
         safe_rep = jnp.where(rep_valid, rep_slots, 0)
 
+        inv = None
+        if mean:
+            seg_counts = jnp.zeros((B,), jnp.float32).at[seg_ids].add(
+                valid[order].astype(jnp.float32), mode="drop")
+            inv = (1.0 / jnp.maximum(seg_counts, 1.0))[:, None]
         combined = {}
         for f in grads:
             g = jnp.asarray(grads[f])[order]
             width = g.shape[1]
             acc = jnp.zeros((B, width), g.dtype)
-            combined[f] = acc.at[seg_ids].add(g, mode="drop")
+            acc = acc.at[seg_ids].add(g, mode="drop")
+            combined[f] = acc * inv if mean else acc
 
         # only the fields this push's grad families actually update are
         # gathered and re-scattered (a partial push must not round-trip

@@ -57,6 +57,18 @@ def test_lr_converges_on_separable_data(devices8):
     assert model.error_rate(data) < 0.15
 
 
+def test_lr_inner_steps_matches_per_batch_training(devices8):
+    """[worker] inner_steps fuses N minibatches per dispatch (lax.scan);
+    update order is preserved, so per-iteration losses must match the
+    per-batch path to float tolerance — including a tail group smaller
+    than inner_steps (400 rows / 50 = 8 batches, inner_steps=3 -> 3+3+2)."""
+    data = synthetic_dataset(400, dim=50, nnz=5, seed=3)
+    want = make_model().train(data, niters=3)
+    got = make_model(worker={"minibatch": 50, "inner_steps": 3}).train(
+        data, niters=3)
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+
+
 def test_lr_predict_range_and_shape(devices8):
     data = synthetic_dataset(60, dim=30, nnz=4, seed=1)
     model = make_model()

@@ -70,6 +70,32 @@ def test_push_equivalence(w2v_setup):
                 err_msg=f"{backend.name}:{f}")
 
 
+def test_push_mean_equivalence(w2v_setup):
+    """mean=True: every backend divides each unique key's gradient sum by
+    its contribution count before the access rule — equivalent to the
+    caller pre-scaling each contribution by 1/count (the reference's
+    grad/count at push serialization), minus the worker-side scatters."""
+    mesh, access, table, slots, grads, state_np = w2v_setup
+    # oracle: explicit pre-scaled contributions through the plain push
+    valid = slots >= 0
+    uniq, counts = np.unique(slots[valid], return_counts=True)
+    count_of = dict(zip(uniq.tolist(), counts.tolist()))
+    scale = np.array([1.0 / count_of[s] if s >= 0 else 0.0
+                      for s in slots], np.float32)
+    prescaled = {f: g * scale[:, None] for f, g in grads.items()}
+    oracle = LocalTransfer().push(state_np, slots, prescaled, access)
+
+    backends = (LocalTransfer(), XlaTransfer(),
+                XlaTransfer(dense_apply=True), TpuTransfer(mesh))
+    for backend in backends:
+        st = state_np if backend.name == "local" else table.state
+        got = backend.push(st, slots, grads, access, mean=True)
+        for f in access.fields:
+            np.testing.assert_allclose(
+                oracle[f], np.asarray(got[f]), rtol=1e-5, atol=1e-6,
+                err_msg=f"{backend.name}:{f}")
+
+
 def test_push_sums_duplicate_slots(devices8):
     # Two pushes of the same slot in one batch must combine by SUM before a
     # single AdaGrad application (api.py semantics).

@@ -86,12 +86,20 @@ def _dense_grads_from_step(model, state, centers, contexts, ctx_mask, key):
     V = int(model.vocab.keys.max()) + 1
     d = model.len_vec
     dense = {f: np.zeros((V, d), np.float64) for f in ("h", "v")}
-    for slots_j, grads in pushes:
+    for slots_j, grads, mean in pushes:
+        slots_np = np.asarray(slots_j).tolist()
+        counts = {}
+        for s in slots_np:
+            if s >= 0:
+                counts[s] = counts.get(s, 0) + 1
         for f, g in grads.items():
             g = np.asarray(g, np.float64)
-            for j, s in enumerate(np.asarray(slots_j).tolist()):
+            for j, s in enumerate(slots_np):
                 if s >= 0:
-                    dense[f][slot_to_key[s]] += g[j]
+                    # mean=True pushes carry raw sums; the transfer
+                    # divides by the key's contribution count
+                    dense[f][slot_to_key[s]] += (
+                        g[j] / counts[s] if mean else g[j])
     return dense["h"], dense["v"], float(es), int(ec)
 
 

@@ -185,7 +185,8 @@ def test_w2v_skipgram_grads_match_numpy():
         model._alias_idx, jnp.asarray(batch.centers),
         jnp.asarray(batch.contexts), jnp.asarray(batch.ctx_mask), key)
     es, ec = float(es), int(ec)
-    (tslots_flat, hgrads), (cslots_flat, vgrads) = pushes
+    (tslots_flat, hgrads, hmean), (cslots_flat, vgrads, vmean) = pushes
+    assert hmean and vmean     # families carry raw sums + mean-norm flag
     tslots_flat, cslots_flat = np.asarray(tslots_flat), np.asarray(cslots_flat)
     gh, gv = np.asarray(hgrads["h"]), np.asarray(vgrads["v"])
 
@@ -240,12 +241,12 @@ def test_w2v_skipgram_grads_match_numpy():
     for i, s in enumerate(cslots_flat):
         if s >= 0:
             dev_v[s] = dev_v.get(s, 0) + gv[i]
+    # device grads are RAW per-contribution values now; the 1/count mean
+    # normalization happens inside transfer.push (mean=True flag above)
     for s, a in acc_h.items():
-        np.testing.assert_allclose(dev_h[s], a / cnt_h[s],
-                                   rtol=2e-3, atol=1e-6)
+        np.testing.assert_allclose(dev_h[s], a, rtol=2e-3, atol=1e-6)
     for s, a in acc_v.items():
-        np.testing.assert_allclose(dev_v[s], a / cnt_v[s],
-                                   rtol=2e-3, atol=1e-6)
+        np.testing.assert_allclose(dev_v[s], a, rtol=2e-3, atol=1e-6)
 
 
 def test_w2v_table_survives_mid_train_abort(devices8):
@@ -478,7 +479,10 @@ def test_w2v_shared_negatives_grads_match_numpy(devices8):
         model.table.state, model._slot_of_vocab, model._alias_prob,
         model._alias_idx, jnp.asarray(centers), jnp.asarray(contexts),
         jnp.asarray(mask), key)
-    (pos_slots, pos_g), (neg_slots, neg_g), (ctx_slots, ctx_g) = pushes
+    ((pos_slots, pos_g, pos_mean), (neg_slots, neg_g, neg_mean),
+     (ctx_slots, ctx_g, ctx_mean)) = pushes
+    # positives/contexts mean-normalize in the push; the pool keeps SUM
+    assert pos_mean and ctx_mean and not neg_mean
 
     # numpy recomputation with the same drawn pool
     K = model.shared_pool
@@ -511,13 +515,13 @@ def test_w2v_shared_negatives_grads_match_numpy(devices8):
     np.testing.assert_array_equal(np.asarray(neg_slots),
                                   np.where(k_alive, sov[negs], -1))
 
-    # positive rows: mean over the center's occurrences
+    # positive rows: raw per-contribution grads (the 1/center_count mean
+    # lands inside transfer.push via the mean=True flag)
     want_pos = np.zeros((B, 8))
-    cnt = np.bincount(sov[centers], minlength=h.shape[0])
     for b in range(B):
         f = np.clip(float(neu1[b] @ h[sov[centers[b]]]), -6, 6)
         g = (1.0 - sig(f)) * alpha
-        want_pos[b] = g * neu1[b] / cnt[sov[centers[b]]]
+        want_pos[b] = g * neu1[b]
     np.testing.assert_allclose(np.asarray(pos_g["h"]), want_pos,
                                rtol=2e-3, atol=1e-6)
 
