@@ -90,6 +90,23 @@ def main():
     ms = timeit(jax.jit(onehot_mm), table, idx2) * 1e3
     print(f"onehot-matmul gather (bf16, cap=17314): {ms:7.2f} ms", flush=True)
 
+    # Pallas VMEM-resident gather (ops/pallas_gather.py) vs XLA's HBM
+    # gather at the bench shape — the "does XLA fall short?" experiment
+    from swiftmpi_tpu.ops.pallas_gather import fits_vmem, vmem_gather
+    tf32 = jnp.asarray(rng.standard_normal((cap, 100)), jnp.float32)
+    N = 344_064
+    idx3 = jnp.asarray(rng.integers(0, cap, N), jnp.int32)
+    if fits_vmem(tf32):
+        try:
+            pg = jax.jit(lambda t, i: vmem_gather(t, i).sum())
+            ms = timeit(pg, tf32, idx3) * 1e3
+            gb = N * 100 * 4 / 1e9
+            print(f"pallas vmem gather (fp32, cap=17314): {ms:7.2f} ms  "
+                  f"{gb / ms * 1e3:6.1f} GB/s", flush=True)
+        except Exception as e:       # Mosaic may reject dynamic gather
+            print(f"pallas vmem gather: UNSUPPORTED ({type(e).__name__}: "
+                  f"{str(e)[:200]})", flush=True)
+
 
 if __name__ == "__main__":
     main()

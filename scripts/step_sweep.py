@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""One-command on-chip tuning sweep for the headline w2v step.
+
+Runs the bench TPU child across a BATCH x SCAN grid (each cell its own
+pinned subprocess, so a tunnel wedge costs one cell, not the sweep) and
+prints a words/s table plus the best cell as a BENCH_* env suggestion.
+The tunnel is scarce — this packs the whole tuning session into one
+command for the next live window.
+
+Run: python scripts/step_sweep.py            (probes, then sweeps)
+     SWEEP_CELLS="16384:8,32768:8" python scripts/step_sweep.py
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+DEFAULT_CELLS = [(8192, 16), (16384, 8), (24576, 8), (32768, 8),
+                 (49152, 4), (65536, 4)]
+
+
+def run_cell(batch, scan, timeout_s=360):
+    """One grid cell through bench._run_child — shares its subprocess,
+    partial-result recovery, and error-tail logic (a cell whose child
+    emits a w2v number then wedges on a later bench still yields the
+    number)."""
+    res, err, _dt = bench._run_child(
+        "tpu", timeout_s,
+        extra_env={"BENCH_BATCH": str(batch), "BENCH_SCAN": str(scan)})
+    return res, err
+
+
+def main():
+    if not bench._tpu_alive():
+        print("tunnel down (probe failed) — nothing to sweep", flush=True)
+        sys.exit(1)
+    cells = DEFAULT_CELLS
+    if os.environ.get("SWEEP_CELLS"):
+        cells = [tuple(int(x) for x in c.split(":"))
+                 for c in os.environ["SWEEP_CELLS"].split(",")]
+    best = None
+    print(f"{'batch':>7} {'scan':>5} {'words/s':>12} {'step_ms':>9} "
+          f"{'shared w/s':>12}", flush=True)
+    for batch, scan in cells:
+        res, err = run_cell(batch, scan)
+        w2v = (res or {}).get("w2v")
+        if w2v is None:
+            why = err or "; ".join(
+                f"{k}: {v}" for k, v in (res or {}).get("errors", {}).items())
+            print(f"{batch:7d} {scan:5d}   FAILED: {why}", flush=True)
+            continue
+        w = w2v["words_per_sec"]
+        s = w2v["step_ms"]
+        sh = res.get("w2v_shared", {}).get("words_per_sec", float("nan"))
+        print(f"{batch:7d} {scan:5d} {w:12.0f} {s:9.2f} {sh:12.0f}",
+              flush=True)
+        if best is None or w > best[2]:
+            best = (batch, scan, w)
+    if best:
+        print(f"\nbest: BENCH_BATCH={best[0]} BENCH_SCAN={best[1]} "
+              f"-> {best[2]:.0f} words/s", flush=True)
+        print(json.dumps({"best_batch": best[0], "best_scan": best[1],
+                          "best_words_per_sec": round(best[2], 1)}),
+              flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -151,7 +151,23 @@ class Sent2Vec:
         dropped = len(lines) - len(kept)
         if dropped:
             log.warning("sent2vec: skipped %d all-OOV sentence(s)", dropped)
+        # Bounded dispatch pipeline: keep a window of batches in flight
+        # and fetch the oldest as new ones are dispatched — a float(err)
+        # + np.asarray(vecs) per batch is two blocking device round trips
+        # (~5ms each through the axon tunnel) that serialize what XLA
+        # would otherwise pipeline, while an unbounded queue would hold
+        # every batch's output on the device at once (O(input) HBM).
+        MAX_IN_FLIGHT = 16
+        queued = []
         out: List[Tuple[int, np.ndarray]] = []
+
+        def drain_one():
+            chunk, vecs, err = queued.pop(0)
+            self.error.accu(float(err), len(chunk))
+            vecs = np.asarray(vecs)
+            for i, (ln, _) in enumerate(chunk):
+                out.append((bkdr_hash(ln), vecs[i]))
+
         for start in range(0, len(kept), self.batchsize):
             chunk = kept[start:start + self.batchsize]
             S = self.batchsize          # pad tail: one compiled shape per L
@@ -170,10 +186,11 @@ class Sent2Vec:
                 jnp.asarray(prob), jnp.asarray(alias),
                 wm._slot_of_vocab, jnp.asarray(vocab_pos),
                 niters, sub)
-            self.error.accu(float(err), len(chunk))
-            vecs = np.asarray(vecs)
-            for i, (ln, _) in enumerate(chunk):
-                out.append((bkdr_hash(ln), vecs[i]))
+            queued.append((chunk, vecs, err))
+            if len(queued) > MAX_IN_FLIGHT:
+                drain_one()
+        while queued:
+            drain_one()
         log.info("sent2vec: %d sentences, error %.5f",
                  len(out), self.error.norm())
         return out

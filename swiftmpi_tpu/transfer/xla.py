@@ -74,17 +74,34 @@ class XlaTransfer(Transfer):
         # OOB scatter indices are dropped by XLA; route padding there.
         safe = jnp.where(valid, slots, capacity)
         inv = None
+        fuse_count = False
         if mean:
-            counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
-                1.0, mode="drop")
-            inv = (1.0 / jnp.maximum(counts, 1.0))[:, None]
+            # Single fp32 grad family: fold the contribution counts into
+            # the grads scatter as one extra column — one scatter pass
+            # over the batch instead of two.  (fp32 only: a bf16 count
+            # column goes inexact past 256 occurrences of one key.)
+            gs = list(grads.values())
+            fuse_count = (len(gs) == 1
+                          and jnp.asarray(gs[0]).dtype == jnp.float32)
+            if not fuse_count:
+                counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
+                    1.0, mode="drop")
+                inv = (1.0 / jnp.maximum(counts, 1.0))[:, None]
         dense_grads = {}
         for f in grads:
             g = jnp.asarray(grads[f])
             width = state[f].shape[1]
-            acc = jnp.zeros((capacity, width), g.dtype)
-            acc = acc.at[safe].add(g, mode="drop")
-            dense_grads[f] = acc * inv if mean else acc
+            if fuse_count:
+                g1 = jnp.concatenate(
+                    [g, jnp.ones((g.shape[0], 1), g.dtype)], axis=1)
+                acc = jnp.zeros((capacity, width + 1), g.dtype)
+                acc = acc.at[safe].add(g1, mode="drop")
+                dense_grads[f] = acc[:, :width] / jnp.maximum(
+                    acc[:, width:], 1.0)
+            else:
+                acc = jnp.zeros((capacity, width), g.dtype)
+                acc = acc.at[safe].add(g, mode="drop")
+                dense_grads[f] = acc * inv if mean else acc
         new_fields = access.apply_push(state, dense_grads)
         out = dict(state)
         out.update(new_fields)

@@ -88,3 +88,20 @@ def test_multi_step_scan_matches_single_steps(devices8):
         np.testing.assert_allclose(np.asarray(s[f]),
                                    np.asarray(s_multi[f]),
                                    rtol=1e-5, atol=1e-6)
+
+
+def test_vmem_gather_matches_take(devices8):
+    """ops/pallas_gather.py: VMEM-resident gather == jnp.take (interpret
+    mode on CPU; the on-chip A/B lives in scripts/gather_micro.py)."""
+    from swiftmpi_tpu.ops.pallas_gather import fits_vmem, vmem_gather
+
+    rng = np.random.default_rng(4)
+    table = jnp.asarray(rng.standard_normal((777, 36)), jnp.float32)
+    idx = jnp.asarray(rng.integers(-1, 777, 4096), jnp.int32)  # incl. -1
+    got = vmem_gather(table, idx, idx_block=1024)
+    want = jnp.take(table, jnp.clip(idx, 0, 776), axis=0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+    assert fits_vmem(table)
+    assert not fits_vmem(jnp.zeros((1 << 20, 100), jnp.float32))
+    with pytest.raises(ValueError):
+        vmem_gather(table, idx[:1000], idx_block=1024)
