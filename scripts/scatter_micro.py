@@ -26,3 +26,18 @@ scat2 = jax.jit(lambda i, g: jnp.zeros((capw,d), jnp.float32).at[i].add(g).sum()
 print(f"w2v dense scatter (344K x 100 -> 17314): {timeit(scat2, gi, gw):7.2f} ms", flush=True)
 cnt = jax.jit(lambda i: jnp.zeros((capw,), jnp.float32).at[i].add(1.0).sum())
 print(f"w2v counts scatter (344K scalars)      : {timeit(cnt, gi):7.2f} ms", flush=True)
+# fused [grads|count] single scatter (the mean=True dense-push layout)
+g1 = jnp.concatenate([gw, jnp.ones((Nw, 1), jnp.float32)], axis=1)
+fscat = jax.jit(lambda i, g: jnp.zeros((capw, d + 1), jnp.float32)
+                .at[i].add(g).sum())
+print(f"w2v fused grads+count scatter (x101)   : {timeit(fscat, gi, g1):7.2f} ms", flush=True)
+# alias sampling cost at bench shape: 2 scalar gathers per draw from the
+# 30K-entry alias arrays — is the sampler a hidden transaction cost?
+import sys, os
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
+counts = rng.zipf(1.5, 30000).astype(np.int64)
+prob, alias = build_unigram_alias(counts)
+prob_d, alias_d = jnp.asarray(prob), jnp.asarray(alias)
+samp = jax.jit(lambda k: sample_alias(k, prob_d, alias_d, (16384, 20)).sum())
+print(f"alias sampling (16384 x 20 draws)      : {timeit(samp, jax.random.key(0)):7.2f} ms", flush=True)

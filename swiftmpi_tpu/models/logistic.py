@@ -140,9 +140,25 @@ class LogisticRegression:
         losses = []
         state = self.table.state
         # deferred per-batch loss scalars: fetched once per epoch (a
-        # float() per batch is a blocking device round trip)
+        # float() per batch is a blocking device round trip).  On the
+        # emulated multi-device CPU mesh the async pipeline must stay
+        # bounded — a rolling window blocking on the OLDEST in-flight
+        # dispatch, exactly word2vec._LossAccum's policy (unbounded
+        # pipelines starve XLA:CPU's thread pool at collective
+        # rendezvous and CHECK-abort the process).
+        from swiftmpi_tpu.models.word2vec import _LossAccum
+        window_bound = (_LossAccum._AUTO_BOUND
+                        if jax.default_backend() == "cpu" else None)
+        window = []
         pending = []
         group = []
+
+        def queue(loss, n):
+            pending.append((loss, n))
+            if window_bound is not None:
+                window.append(loss)
+                if len(window) > window_bound:
+                    jax.block_until_ready(window.pop(0))
 
         def flush_group():
             nonlocal state
@@ -152,7 +168,7 @@ class LogisticRegression:
                 stacked = tuple(
                     jnp.asarray(np.stack(col)) for col in zip(*group))
                 state, ls, ns = self._multi(state, *stacked)
-                pending.append((ls, ns))
+                queue(ls, ns)
             else:
                 # tail (or pre-grow flush) smaller than a full group:
                 # per-batch dispatch avoids a recompile per distinct size
@@ -160,7 +176,7 @@ class LogisticRegression:
                     state, loss, n = self._step(
                         state, jnp.asarray(slots), jnp.asarray(vals),
                         jnp.asarray(mask), jnp.asarray(targets))
-                    pending.append((loss, n))
+                    queue(loss, n)
             group.clear()
 
         for it in range(niters):
@@ -198,6 +214,7 @@ class LogisticRegression:
                 total += float((loss * n).sum())
                 count += int(n.sum())
             pending.clear()
+            window.clear()
             mean_err = total / max(count, 1)
             losses.append(mean_err)
             log.info("iter %d: %d records  error: %.6f", it, count, mean_err)

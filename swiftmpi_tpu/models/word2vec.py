@@ -74,21 +74,49 @@ class _LossAccum:
     dispatch, no host sync), so an epoch holds O(1) buffers and the
     epoch-end fetch is one round trip — not two per batch.  Folds in
     float32: exact up to 2^24 per fold, and beyond that the loss
-    denominator's relative error is <1e-7, immaterial."""
+    denominator's relative error is <1e-7, immaterial.
+
+    ``sync_every`` bounds the async dispatch pipeline as a ROLLING
+    window: once more than N scalars are in flight, each add blocks on
+    the OLDEST one (its completion implies every earlier step ran, and
+    ~N newer programs stay in flight — no pipeline bubble).  Why bound
+    at all: on the virtual multi-device CPU mesh an unbounded pipeline
+    of sharded step programs starves XLA:CPU's shared thread pool —
+    devices of one in-flight program occupy the threads another
+    program's collective rendezvous is waiting for, and past the
+    rendezvous timeout the whole process CHECK-aborts ("Fatal Python
+    error: Aborted" at a harmless-looking dispatch).  The default
+    ``"auto"`` applies the bound exactly there (cpu backend); a real
+    TPU chip runs one program at a time and gets no bound."""
 
     _FOLD = 256
+    _AUTO_BOUND = 16
 
-    def __init__(self):
+    def __init__(self, sync_every="auto"):
+        if sync_every == "auto":
+            sync_every = (self._AUTO_BOUND
+                          if jax.default_backend() == "cpu" else None)
         self._q = []
+        self._sync_every = sync_every
+        self._window = []
 
     def add(self, x) -> None:
-        self._q.append(jnp.asarray(x, jnp.float32))
+        x = jnp.asarray(x, jnp.float32)
+        self._q.append(x)
+        if self._sync_every is not None:
+            self._window.append(x)
+            if len(self._window) > self._sync_every:
+                jax.block_until_ready(self._window.pop(0))
         if len(self._q) >= self._FOLD:
             self._q = [jnp.stack(self._q).sum()]
 
     def total(self) -> float:
         if not self._q:
             return 0.0
+        # drain the dispatch pipeline before issuing the stack program:
+        # the newest scalar's completion implies every queued step ran
+        jax.block_until_ready(self._q[-1])
+        self._window.clear()
         return float(jnp.stack(self._q).sum())
 
 
@@ -653,7 +681,7 @@ class Word2Vec:
                 # an on-device int32 accumulator would wrap at ~2.1e9
                 # target pairs, i.e. exactly the corpus sizes this
                 # optimization targets.
-                es_q, ec_q = _LossAccum(), _LossAccum()
+                es_q, ec_q = _LossAccum(), _LossAccum(None)
                 group = []
 
                 def run_single(batch):
@@ -744,7 +772,7 @@ class Word2Vec:
         step, n_workers = self._step
         group = n_workers * max(self.local_steps, 1)
         state = self.table.state
-        es_q, ec_q = _LossAccum(), _LossAccum()
+        es_q, ec_q = _LossAccum(), _LossAccum(None)
         buf = []
         dropped = 0
         for batch in batcher.epoch(batch_size):

@@ -13,10 +13,10 @@ Pallas surface — with zero chip measurements nobody knows whether XLA
 falls short"): ``vmem_gather(table, idx)`` stages the whole table into
 VMEM via the BlockSpec pipeline and gathers index blocks with
 ``jnp.take`` inside the kernel (Mosaic's dynamic-gather path).  The
-A/B against XLA's native gather lives in ``scripts/gather_micro.py``
-(--pallas); wiring into ``XlaTransfer.pull`` is gated on that A/B
-showing a real win on hardware — on CPU the kernel runs in interpret
-mode and is for correctness only.
+A/B against XLA's native gather runs as the final cell of
+``scripts/gather_micro.py``; wiring into ``XlaTransfer.pull`` is gated
+on that A/B showing a real win on hardware — on CPU the kernel runs in
+interpret mode and is for correctness only.
 
 Reference context: the gather this replaces is the pull half of
 ``MiniBatch::pull`` (/root/reference/src/apps/word2vec/word2vec.h:303-311);

@@ -16,6 +16,13 @@ import os
 os.environ["XLA_FLAGS"] = (
     os.environ.get("XLA_FLAGS", "")
     + " --xla_force_host_platform_device_count=8"
+    # On an oversubscribed machine the 8 virtual devices' collective
+    # threads can miss XLA:CPU's in-process rendezvous window, and the
+    # default 40s terminate timeout CHECK-aborts the whole test process
+    # ("Fatal Python error: Aborted" mid-suite whenever anything else is
+    # hogging the cores).  Warn early, abort only after 10 minutes.
+    + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
+    + " --xla_cpu_collective_call_terminate_timeout_seconds=600"
 )
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable axon sitecustomize hook
