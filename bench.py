@@ -94,7 +94,7 @@ def _timed_steps(step, state, args, timed_calls, key):
     return state, time.perf_counter() - t0, float(es)
 
 
-def _build_w2v(device, w2v_overrides=None):
+def _build_w2v(device, w2v_overrides=None, inner_steps=None):
     import jax
     import jax.numpy as jnp
     from swiftmpi_tpu.data.text import CBOWBatcher, synthetic_corpus
@@ -112,35 +112,38 @@ def _build_w2v(device, w2v_overrides=None):
                    "dtype": os.environ.get("BENCH_DTYPE", "float32")},
         "worker": {"minibatch": 5000},
     })
+    n_inner = inner_steps or INNER_STEPS
     with jax.default_device(device):
         model = Word2Vec(
             config=cfg, cluster=Cluster(cfg, devices=[device]).initialize())
         corpus = synthetic_corpus(SENTENCES, VOCAB, SENT_LEN, seed=11)
         model.build(corpus)
-        step = model._build_multi_step(INNER_STEPS)
+        step = model._build_multi_step(n_inner)
         batcher = CBOWBatcher(corpus, model.vocab, model.window,
                               model.sample, seed=5)
         batches = []
         for b in batcher.epoch(BATCH):
             if b.n_words == BATCH:  # full batches only (static shapes)
                 batches.append(b)
-            if len(batches) >= INNER_STEPS:
+            if len(batches) >= n_inner:
                 break
         if not batches:
             raise RuntimeError(
                 f"corpus produced no full batch of {BATCH} centers; "
                 "lower BATCH or enlarge the synthetic corpus")
         n_distinct = len(batches)
-        while len(batches) < INNER_STEPS:  # small corpus: cycle
+        while len(batches) < n_inner:  # small corpus: cycle
             batches.append(batches[len(batches) % n_distinct])
         return model, step, batches
 
 
-def _bench_w2v(device, timed_calls, built=None):
+def _bench_w2v(device, timed_calls, built=None, inner_steps=None):
     import jax
     import jax.numpy as jnp
 
-    model, step, batches = built or _build_w2v(device)
+    n_inner = inner_steps or INNER_STEPS
+    model, step, batches = built or _build_w2v(device,
+                                               inner_steps=inner_steps)
     with jax.default_device(device):
         state = {f: jax.device_put(v, device)
                  for f, v in model.table.state.items()}
@@ -163,7 +166,7 @@ def _bench_w2v(device, timed_calls, built=None):
         # the model at the live final state so later benches can reuse it
         model.table.state = state
     return {"words_per_sec": words_per_call * timed_calls / dt,
-            "step_ms": dt / (timed_calls * INNER_STEPS) * 1e3,
+            "step_ms": dt / (timed_calls * n_inner) * 1e3,
             "loss": loss}
 
 
@@ -341,9 +344,18 @@ def child_main(which: str) -> None:
                                     "shared_pool": 4096})
         return _bench_w2v(device, timed, built)
 
+    def _sg():
+        # BASELINE.md config #2 (skip-gram+NS): per-PAIR negatives make
+        # the target gather B*2W*(K+1) rows — ~8x the CBOW step — so it
+        # runs at a shorter scan and fewer timed calls to bound wall time
+        built = _build_w2v(device, {"sg": 1}, inner_steps=2)
+        return _bench_w2v(device, max(timed // 4, 1), built,
+                          inner_steps=2)
+
     secondaries = [("lr", lambda: _bench_lr(device, max(timed // 4, 1))),
                    ("s2v", lambda: _bench_s2v(device, 1, model)),
-                   ("w2v_shared", _shared)]
+                   ("w2v_shared", _shared),
+                   ("w2v_sg", _sg)]
     if which == "cpu":
         secondaries.append(("oracle", _bench_oracle))
     if os.environ.get("BENCH_SCALE"):
@@ -530,9 +542,11 @@ def parent_main() -> None:
                               ("sent2vec", "sents_per_sec", "sents/s"),
                               ("w2v_shared_negatives", "words_per_sec",
                                "words/s"),
+                              ("w2v_skipgram", "words_per_sec", "words/s"),
                               ("w2v_1m_vocab", "words_per_sec", "words/s")):
         key = {"lr_a9a": "lr", "sent2vec": "s2v",
                "w2v_shared_negatives": "w2v_shared",
+               "w2v_skipgram": "w2v_sg",
                "w2v_1m_vocab": "w2v_1m"}[name]
         entry = {"unit": unit}
         if tpu_res and key in tpu_res:

@@ -35,6 +35,7 @@ from swiftmpi_tpu.parameter import lr_access
 from swiftmpi_tpu.parameter.key_index import CapacityError
 from swiftmpi_tpu.utils.config import ConfigParser, global_config
 from swiftmpi_tpu.utils.logger import get_logger
+from swiftmpi_tpu.utils.pipeline import DispatchWindow
 
 log = get_logger(__name__)
 
@@ -140,25 +141,17 @@ class LogisticRegression:
         losses = []
         state = self.table.state
         # deferred per-batch loss scalars: fetched once per epoch (a
-        # float() per batch is a blocking device round trip).  On the
-        # emulated multi-device CPU mesh the async pipeline must stay
-        # bounded — a rolling window blocking on the OLDEST in-flight
-        # dispatch, exactly word2vec._LossAccum's policy (unbounded
-        # pipelines starve XLA:CPU's thread pool at collective
-        # rendezvous and CHECK-abort the process).
-        from swiftmpi_tpu.models.word2vec import _LossAccum
-        window_bound = (_LossAccum._AUTO_BOUND
-                        if jax.default_backend() == "cpu" else None)
-        window = []
+        # float() per batch is a blocking device round trip); the
+        # DispatchWindow keeps the async pipeline bounded on the
+        # emulated multi-device CPU mesh (see utils/pipeline.py for the
+        # rendezvous-starvation failure mode it prevents)
+        window = DispatchWindow()
         pending = []
         group = []
 
         def queue(loss, n):
             pending.append((loss, n))
-            if window_bound is not None:
-                window.append(loss)
-                if len(window) > window_bound:
-                    jax.block_until_ready(window.pop(0))
+            window.push(loss)
 
         def flush_group():
             nonlocal state

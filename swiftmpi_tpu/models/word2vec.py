@@ -56,6 +56,7 @@ from swiftmpi_tpu.parameter import w2v_access
 from swiftmpi_tpu.transfer import PushSpec
 from swiftmpi_tpu.utils.config import ConfigParser, global_config
 from swiftmpi_tpu.utils.logger import get_logger
+from swiftmpi_tpu.utils.pipeline import DispatchWindow
 from swiftmpi_tpu.utils.timers import Throughput
 
 log = get_logger(__name__)
@@ -76,37 +77,21 @@ class _LossAccum:
     float32: exact up to 2^24 per fold, and beyond that the loss
     denominator's relative error is <1e-7, immaterial.
 
-    ``sync_every`` bounds the async dispatch pipeline as a ROLLING
-    window: once more than N scalars are in flight, each add blocks on
-    the OLDEST one (its completion implies every earlier step ran, and
-    ~N newer programs stay in flight — no pipeline bubble).  Why bound
-    at all: on the virtual multi-device CPU mesh an unbounded pipeline
-    of sharded step programs starves XLA:CPU's shared thread pool —
-    devices of one in-flight program occupy the threads another
-    program's collective rendezvous is waiting for, and past the
-    rendezvous timeout the whole process CHECK-aborts ("Fatal Python
-    error: Aborted" at a harmless-looking dispatch).  The default
-    ``"auto"`` applies the bound exactly there (cpu backend); a real
-    TPU chip runs one program at a time and gets no bound."""
+    ``bound`` feeds a utils.pipeline.DispatchWindow (default "auto":
+    bound the async pipeline only on the emulated cpu mesh, where
+    unbounded in-flight sharded programs CHECK-abort at collective
+    rendezvous — see that module's docstring for the failure mode)."""
 
     _FOLD = 256
-    _AUTO_BOUND = 16
 
-    def __init__(self, sync_every="auto"):
-        if sync_every == "auto":
-            sync_every = (self._AUTO_BOUND
-                          if jax.default_backend() == "cpu" else None)
+    def __init__(self, bound="auto"):
         self._q = []
-        self._sync_every = sync_every
-        self._window = []
+        self._window = DispatchWindow(bound)
 
     def add(self, x) -> None:
         x = jnp.asarray(x, jnp.float32)
         self._q.append(x)
-        if self._sync_every is not None:
-            self._window.append(x)
-            if len(self._window) > self._sync_every:
-                jax.block_until_ready(self._window.pop(0))
+        self._window.push(x)
         if len(self._q) >= self._FOLD:
             self._q = [jnp.stack(self._q).sum()]
 

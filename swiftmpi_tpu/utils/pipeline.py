@@ -1,0 +1,45 @@
+"""Async-dispatch pipeline bounding.
+
+On the virtual multi-device CPU mesh an unbounded pipeline of sharded
+programs starves XLA:CPU's shared thread pool: devices of one in-flight
+program occupy the threads another program's collective rendezvous is
+waiting for, and past the rendezvous timeout the whole process
+CHECK-aborts ("Fatal Python error: Aborted" at a harmless-looking
+dispatch).  ``DispatchWindow`` bounds the depth as a ROLLING window —
+past N tracked arrays, each push blocks on the OLDEST (its completion
+implies every earlier dependent dispatch ran, and ~N newer programs
+stay in flight, so there is no pipeline bubble).
+
+The ``"auto"`` policy applies the bound only on the cpu backend: a real
+TPU chip runs one program at a time and needs no bound.  Shared by
+``word2vec._LossAccum``, the LR train loop, and anything else that
+queues device results without fetching them.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Union
+
+import jax
+
+AUTO_BOUND = 16
+
+
+class DispatchWindow:
+    def __init__(self, bound: Union[str, int, None] = "auto"):
+        if bound == "auto":
+            bound = AUTO_BOUND if jax.default_backend() == "cpu" else None
+        self._bound: Optional[int] = bound
+        self._window: list = []
+
+    def push(self, x) -> None:
+        """Track one in-flight device value; block on the oldest tracked
+        value once more than ``bound`` are outstanding."""
+        if self._bound is None:
+            return
+        self._window.append(x)
+        if len(self._window) > self._bound:
+            jax.block_until_ready(self._window.pop(0))
+
+    def clear(self) -> None:
+        self._window.clear()

@@ -307,3 +307,14 @@ def test_tpu_backend_hybrid_data_shard_mesh(devices8):
     for f in want_new:
         np.testing.assert_allclose(np.asarray(new[f]), want_new[f],
                                    rtol=1e-5, atol=1e-6)
+
+    # mean=True across the hybrid mesh: counts accumulate at the owning
+    # shard AND psum across the data groups, exactly like the grads —
+    # global mean, not per-group mean
+    new_m = t.push(table.state, slots, grads, access, mean=True)
+    want_m = LocalTransfer().push(state_np, slots, grads, access,
+                                  mean=True)
+    for f in want_m:
+        np.testing.assert_allclose(np.asarray(new_m[f]), want_m[f],
+                                   rtol=1e-5, atol=1e-6,
+                                   err_msg=f"hybrid mean:{f}")
