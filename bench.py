@@ -105,7 +105,10 @@ def _build_w2v(device, w2v_overrides=None, inner_steps=None):
     cfg = ConfigParser().update({
         "cluster": {"transfer": "xla", "server_num": 1},
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
-                     "sample": 1e-4, "learning_rate": 0.05,
+                     # demo.conf sample: 0.00001 (subsampling gates only
+                     # which words become centers; n_words counts real
+                     # centers, so words/s stays honestly accounted)
+                     "sample": 1e-5, "learning_rate": 0.05,
                      **(w2v_overrides or {})},
         # BENCH_DTYPE=bfloat16 measures the half-width-storage mode
         "server": {"initial_learning_rate": 0.7, "frag_num": 1000,
@@ -141,9 +144,11 @@ def _bench_w2v(device, timed_calls, built=None, inner_steps=None):
     import jax
     import jax.numpy as jnp
 
-    n_inner = inner_steps or INNER_STEPS
     model, step, batches = built or _build_w2v(device,
                                                inner_steps=inner_steps)
+    # the batch stack IS the scan length — derived, so a prebuilt model
+    # and the inner_steps argument cannot desynchronize
+    n_inner = len(batches)
     with jax.default_device(device):
         state = {f: jax.device_put(v, device)
                  for f, v in model.table.state.items()}
