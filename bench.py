@@ -304,6 +304,37 @@ def _bench_w2v_1m(device, timed_calls):
             "vocab": V, "capacity": model.table.capacity}
 
 
+def _bench_tfm(device, timed_calls):
+    """Transformer-LM training tokens/s (beyond-reference model family;
+    opt-in via BENCH_TFM=1 so the default driver run's time budget is
+    untouched).  Small GPT-style config, bf16 activations, adamw."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from swiftmpi_tpu.models.trainer import Trainer
+    from swiftmpi_tpu.models.transformer import TransformerConfig
+
+    B, S = 16, 512
+    cfg = TransformerConfig(vocab_size=8192, d_model=512, n_heads=8,
+                            n_layers=4, d_ff=2048, max_seq=S,
+                            dtype=jnp.bfloat16)
+    with jax.default_device(device):
+        tr = Trainer(cfg, learning_rate=1e-3)
+        state = tr.init_state(jax.random.key(0))
+        rng = np.random.default_rng(0)
+        tokens = jnp.asarray(rng.integers(0, 8192, (B, S)), jnp.int32)
+        state, loss = tr.step(state, tokens)            # compile
+        jax.block_until_ready(loss)
+        float(loss)                                     # D2H fence
+        t0 = time.perf_counter()
+        for _ in range(timed_calls):
+            state, loss = tr.step(state, tokens)
+        last = float(loss)                              # fences the chain
+        dt = time.perf_counter() - t0
+    return {"tokens_per_sec": B * S * timed_calls / dt,
+            "step_ms": dt / timed_calls * 1e3, "loss": last}
+
+
 def _bench_oracle():
     """Sequential numpy oracle words/s — the reference-faithful
     single-threaded loop (testing/w2v_oracle.py), measured on a corpus
@@ -366,6 +397,9 @@ def child_main(which: str) -> None:
     if os.environ.get("BENCH_SCALE"):
         secondaries.append(
             ("w2v_1m", lambda: _bench_w2v_1m(device, max(timed // 2, 1))))
+    if os.environ.get("BENCH_TFM"):
+        secondaries.append(
+            ("tfm", lambda: _bench_tfm(device, max(timed // 2, 1))))
     for name, fn in secondaries:
         try:
             out[name] = fn()
@@ -548,11 +582,14 @@ def parent_main() -> None:
                               ("w2v_shared_negatives", "words_per_sec",
                                "words/s"),
                               ("w2v_skipgram", "words_per_sec", "words/s"),
-                              ("w2v_1m_vocab", "words_per_sec", "words/s")):
+                              ("w2v_1m_vocab", "words_per_sec", "words/s"),
+                              ("transformer_lm", "tokens_per_sec",
+                               "tokens/s")):
         key = {"lr_a9a": "lr", "sent2vec": "s2v",
                "w2v_shared_negatives": "w2v_shared",
                "w2v_skipgram": "w2v_sg",
-               "w2v_1m_vocab": "w2v_1m"}[name]
+               "w2v_1m_vocab": "w2v_1m",
+               "transformer_lm": "tfm"}[name]
         entry = {"unit": unit}
         if tpu_res and key in tpu_res:
             entry["tpu"] = round(tpu_res[key][field], 1)
