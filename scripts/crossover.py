@@ -25,15 +25,10 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
     __file__))))
 
-# must precede jax import: the 8-device cpu mesh's collective rendezvous
-# CHECK-aborts at 40s when compiles/other programs hold the thread pool
-# (see swiftmpi_tpu/utils/pipeline.py); guarded so a caller's XLA_FLAGS wins
-if "--xla_cpu_collective_call_terminate_timeout_seconds" not in \
-        os.environ.get("XLA_FLAGS", ""):
-    os.environ["XLA_FLAGS"] = (
-        os.environ.get("XLA_FLAGS", "")
-        + " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
-        + " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+# must precede jax import (see swiftmpi_tpu/utils/xla_env.py)
+from swiftmpi_tpu.utils.xla_env import ensure_cpu_mesh_flags  # noqa: E402
+
+ensure_cpu_mesh_flags()
 
 import numpy as np  # noqa: E402
 

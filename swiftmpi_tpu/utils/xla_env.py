@@ -1,0 +1,36 @@
+"""XLA environment setup for the emulated multi-device CPU platform.
+
+Must run BEFORE the first jax import in the process (env-var flags are
+read at backend init).  Importing this module is side-effect free and
+jax-free, so test conftests and entry scripts can call it first thing.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def ensure_cpu_mesh_flags(n_devices: int | None = None) -> None:
+    """Idempotently append the virtual-CPU-mesh XLA flags.
+
+    * ``--xla_force_host_platform_device_count=N`` (when ``n_devices``
+      is given) — the standard JAX fake-multi-device trick.
+    * Collective rendezvous timeouts: on an oversubscribed host the
+      virtual devices' collective threads can miss XLA:CPU's in-process
+      rendezvous window, and the default 40s terminate timeout
+      CHECK-aborts the whole process ("Fatal Python error: Aborted" at
+      a harmless-looking dispatch — see utils/pipeline.py for the
+      full failure mode).  Warn at 60s, abort only at 600s.
+
+    Every append is guarded by a substring check so a caller's own
+    XLA_FLAGS value wins (XLA parses flags last-occurrence-wins; an
+    unconditional append would silently override it).
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    if n_devices is not None and \
+            "--xla_force_host_platform_device_count" not in flags:
+        flags += f" --xla_force_host_platform_device_count={n_devices}"
+    if "--xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
+        flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
+                  " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+    os.environ["XLA_FLAGS"] = flags

@@ -13,22 +13,9 @@ is early enough.
 
 import os
 
-os.environ["XLA_FLAGS"] = (
-    os.environ.get("XLA_FLAGS", "")
-    + " --xla_force_host_platform_device_count=8"
-)
-if "--xla_cpu_collective_call_terminate_timeout_seconds" not in \
-        os.environ["XLA_FLAGS"]:
-    # On an oversubscribed machine the 8 virtual devices' collective
-    # threads can miss XLA:CPU's in-process rendezvous window, and the
-    # default 40s terminate timeout CHECK-aborts the whole test process
-    # ("Fatal Python error: Aborted" mid-suite whenever anything else is
-    # hogging the cores).  Warn early, abort only after 10 minutes.
-    # Guarded so a caller's own XLA_FLAGS setting wins (XLA parses
-    # last-occurrence-wins; an unconditional append would override it).
-    os.environ["XLA_FLAGS"] += (
-        " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
-        " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+from swiftmpi_tpu.utils.xla_env import ensure_cpu_mesh_flags
+
+ensure_cpu_mesh_flags(n_devices=8)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable axon sitecustomize hook
 
