@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""One-command TPU measurement session for a live tunnel window.
+
+The axon tunnel is up for unpredictable windows; this runs the full
+measurement agenda in priority order, each stage in its own subprocess
+with a timeout (a wedge costs one stage), appending every result to
+``chip_session.jsonl``:
+
+  1. full bench.py (headline + secondaries -> the driver-format line)
+  2. step_sweep.py (BATCH x SCAN tuning grid)
+  3. gather_micro.py (incl. the Pallas VMEM-gather A/B)
+  4. scatter_micro.py (scatter/sampling cells)
+  5. crossover.py --single-device (backend grid, chip cells)
+  6. bench.py TPU child with BENCH_SCALE=1 (1M-vocab pipeline)
+  7. bench.py TPU child with BENCH_TFM=1 (transformer tokens/s)
+
+Run: python scripts/chip_session.py            (probes first)
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+import bench  # noqa: E402
+
+OUT = os.path.join(REPO, "chip_session.jsonl")
+
+
+def log(rec):
+    rec["ts"] = time.time()
+    with open(OUT, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+    print(json.dumps(rec), flush=True)
+
+
+def run(name, cmd, timeout_s, env_extra=None, tpu_env=True):
+    env = bench._tpu_env() if tpu_env else dict(os.environ)
+    env.update(env_extra or {})
+    t0 = time.time()
+    try:
+        p = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout_s, env=env, cwd=REPO)
+        tail = "\n".join((p.stdout or "").strip().splitlines()[-25:])
+        log({"stage": name, "rc": p.returncode,
+             "wall_s": round(time.time() - t0, 1), "tail": tail,
+             "stderr_tail": "\n".join(
+                 (p.stderr or "").strip().splitlines()[-3:])})
+        return p.returncode == 0
+    except subprocess.TimeoutExpired:
+        log({"stage": name, "rc": "timeout",
+             "wall_s": round(time.time() - t0, 1)})
+        return False
+
+
+def main():
+    if not bench._tpu_alive():
+        print("tunnel down — aborting session", flush=True)
+        sys.exit(1)
+    log({"stage": "session_start", "note": "tunnel probe OK"})
+    py = sys.executable
+    agenda = [
+        ("bench_full", [py, "bench.py"], 1600, None),
+        ("step_sweep", [py, "scripts/step_sweep.py"], 2400, None),
+        ("gather_micro", [py, "scripts/gather_micro.py"], 600, None),
+        ("scatter_micro", [py, "scripts/scatter_micro.py"], 600, None),
+        ("crossover_chip", [py, "scripts/crossover.py",
+                            "--single-device", "--reps", "3"], 1800, None),
+        ("bench_scale", [py, "bench.py", "--child", "tpu"], 600,
+         {"BENCH_SCALE": "1"}),
+        ("bench_tfm", [py, "bench.py", "--child", "tpu"], 600,
+         {"BENCH_TFM": "1"}),
+    ]
+    for name, cmd, timeout_s, env_extra in agenda:
+        # bench.py parent manages its own children's envs; everything
+        # else pins to the chip
+        tpu_env = name not in ("bench_full",)
+        ok = run(name, cmd, timeout_s, env_extra, tpu_env=tpu_env)
+        if not ok and not bench._tpu_alive(timeout_s=60):
+            log({"stage": "session_end", "note": "tunnel lost"})
+            return
+    log({"stage": "session_end", "note": "agenda complete"})
+
+
+if __name__ == "__main__":
+    main()

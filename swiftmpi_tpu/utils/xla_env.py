@@ -10,11 +10,17 @@ from __future__ import annotations
 import os
 
 
-def ensure_cpu_mesh_flags(n_devices: int | None = None) -> None:
+def ensure_cpu_mesh_flags(n_devices: int | None = None,
+                          force_device_count: bool = False) -> None:
     """Idempotently append the virtual-CPU-mesh XLA flags.
 
     * ``--xla_force_host_platform_device_count=N`` (when ``n_devices``
       is given) — the standard JAX fake-multi-device trick.
+      ``force_device_count=True`` appends even when the flag is already
+      present (XLA parses last-occurrence-wins, so the append overrides
+      the earlier value) — the test suite uses this so a developer's
+      leftover device-count export can never silently shrink the mesh
+      and skip every ``devices8`` test.
     * Collective rendezvous timeouts: on an oversubscribed host the
       virtual devices' collective threads can miss XLA:CPU's in-process
       rendezvous window, and the default 40s terminate timeout
@@ -27,8 +33,9 @@ def ensure_cpu_mesh_flags(n_devices: int | None = None) -> None:
     unconditional append would silently override it).
     """
     flags = os.environ.get("XLA_FLAGS", "")
-    if n_devices is not None and \
-            "--xla_force_host_platform_device_count" not in flags:
+    if n_devices is not None and (
+            force_device_count
+            or "--xla_force_host_platform_device_count" not in flags):
         flags += f" --xla_force_host_platform_device_count={n_devices}"
     if "--xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
         flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"

@@ -15,7 +15,7 @@ import os
 
 from swiftmpi_tpu.utils.xla_env import ensure_cpu_mesh_flags
 
-ensure_cpu_mesh_flags(n_devices=8)
+ensure_cpu_mesh_flags(n_devices=8, force_device_count=True)
 os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ["PALLAS_AXON_POOL_IPS"] = ""  # disable axon sitecustomize hook
 

@@ -318,3 +318,30 @@ def test_tpu_backend_hybrid_data_shard_mesh(devices8):
         np.testing.assert_allclose(np.asarray(new_m[f]), want_m[f],
                                    rtol=1e-5, atol=1e-6,
                                    err_msg=f"hybrid mean:{f}")
+
+
+def test_pushspec_mean_flag_is_static_under_jit(devices8):
+    """PushSpec registers `mean` as pytree aux data: a jitted function
+    taking pushes as an ARGUMENT sees a concrete bool (the async
+    snapshot mode jits apply_fn this way), and different flags retrace
+    rather than alias."""
+    from swiftmpi_tpu.transfer import PushSpec
+
+    access = lr_access(learning_rate=1.0)
+    table, ki = make_table(access, num_shards=1, cap=8)
+    slot = int(ki.lookup(np.array([7], np.uint64))[0])
+    slots = jnp.asarray([slot, slot], jnp.int32)
+    grads = {"val": jnp.asarray([[1.0], [3.0]], jnp.float32)}
+    t = XlaTransfer()
+
+    @jax.jit
+    def apply(state, push):
+        s, g, mean = push
+        assert isinstance(mean, bool)      # concrete at trace time
+        return t.push(state, s, g, access, mean=mean)
+
+    out_sum = apply(table.state, PushSpec(slots, grads))
+    out_mean = apply(table.state, PushSpec(slots, grads, mean=True))
+    # sum: g=4 -> grad2sum=16; mean: g=2 -> grad2sum=4
+    assert np.asarray(out_sum["grad2sum"])[slot, 0] == pytest.approx(16.0)
+    assert np.asarray(out_mean["grad2sum"])[slot, 0] == pytest.approx(4.0)

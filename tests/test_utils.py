@@ -202,3 +202,24 @@ def test_timer_and_error():
     assert e.norm() == pytest.approx(3.0)
     e.reset()
     assert e.norm() == 0.0
+
+
+def test_xla_env_import_is_jax_free():
+    """utils/xla_env must be importable BEFORE jax initializes (its whole
+    purpose is setting XLA_FLAGS pre-init) — so the package __init__
+    chains it pulls in must never import jax at module level.  Pins the
+    contract tests/conftest.py, __graft_entry__.py, and
+    scripts/crossover.py rely on."""
+    import subprocess
+    import sys
+
+    p = subprocess.run(
+        [sys.executable, "-c",
+         "import sys; "
+         "from swiftmpi_tpu.utils.xla_env import ensure_cpu_mesh_flags; "
+         "import os; os.environ.pop('XLA_FLAGS', None); "
+         "ensure_cpu_mesh_flags(n_devices=3, force_device_count=True); "
+         "assert '=3' in os.environ['XLA_FLAGS']; "
+         "assert 'jax' not in sys.modules, 'xla_env import pulled in jax'"],
+        capture_output=True, text=True, timeout=120)
+    assert p.returncode == 0, p.stderr
