@@ -53,8 +53,11 @@ LR_BATCH = 8192
 S2V_SENTS = 256
 S2V_NITERS = 10
 
-TPU_TIMEOUT_S = 420
-TPU_RETRY_TIMEOUT_S = 240
+# budget: ~6 distinct programs compile through the remote-compile tunnel
+# at ~20-40s each (w2v multi-step, train()'s fused+single pair for the
+# epoch bench, lr scan, s2v, shared, sg) before the runs themselves
+TPU_TIMEOUT_S = 560
+TPU_RETRY_TIMEOUT_S = 300
 CPU_TIMEOUT_S = 900
 FAST_FAIL_S = 90       # a child dying this fast is worth one retry
 
