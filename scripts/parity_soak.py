@@ -1,0 +1,82 @@
+#!/usr/bin/env python
+"""Loss-parity soak: a larger-corpus version of
+tests/test_w2v_oracle.py::test_loss_parity_vs_reference_oracle.
+
+The unit test pins the trajectory on a 40-sentence corpus; this drives
+the same comparison at ~50K tokens x several epochs, where slow drift
+between the fused SPMD trainer and the reference-faithful sequential
+oracle would have time to show.  Prints per-epoch losses for both
+sides and the relative gap (north-star clause 2: matching final loss).
+
+Run: PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu \
+       XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+       python scripts/parity_soak.py
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+from swiftmpi_tpu.utils.xla_env import ensure_cpu_mesh_flags  # noqa: E402
+
+ensure_cpu_mesh_flags()
+
+import numpy as np  # noqa: E402
+
+N_SENT = int(os.environ.get("SOAK_SENTS", 250))
+SENT_LEN = int(os.environ.get("SOAK_LEN", 200))
+VOCAB = int(os.environ.get("SOAK_VOCAB", 2000))
+NITERS = int(os.environ.get("SOAK_ITERS", 4))
+
+
+def main():
+    from swiftmpi_tpu.data.text import synthetic_corpus
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.testing import W2VOracle
+    from swiftmpi_tpu.utils import ConfigParser
+
+    sents = [list(map(int, np.asarray(s)))
+             for s in synthetic_corpus(N_SENT, VOCAB, SENT_LEN, seed=17)]
+    n_tokens = sum(len(s) for s in sents)
+    print(f"corpus: {N_SENT} sentences, {n_tokens} tokens, "
+          f"vocab<={VOCAB}, {NITERS} epochs", flush=True)
+
+    oracle = W2VOracle(len_vec=32, window=3, negative=5, alpha=0.05,
+                       server_lr=0.3, sample=-1.0, minibatch_lines=25,
+                       table_size=1_000_000, seed=2008, init_seed=0)
+    t0 = time.perf_counter()
+    ref_losses = oracle.train(sents, niters=NITERS)
+    t_oracle = time.perf_counter() - t0
+
+    cfg = ConfigParser().update({
+        "cluster": {"server_num": 2, "transfer": "xla"},
+        "word2vec": {"len_vec": 32, "window": 3, "negative": 5,
+                     "sample": -1, "learning_rate": 0.05},
+        "server": {"initial_learning_rate": 0.3, "frag_num": 200},
+        "worker": {"minibatch": 5000},
+    })
+    model = Word2Vec(config=cfg)
+    model.build(sents)
+    t0 = time.perf_counter()
+    # 25 lines x ~SENT_LEN tokens per oracle batch: match granularity
+    losses = model.train(sents, niters=NITERS,
+                         batch_size=25 * SENT_LEN)
+    t_model = time.perf_counter() - t0
+
+    print(f"oracle losses ({t_oracle:.1f}s): "
+          + " ".join(f"{x:.4f}" for x in ref_losses), flush=True)
+    print(f"model  losses ({t_model:.1f}s): "
+          + " ".join(f"{x:.4f}" for x in losses), flush=True)
+    for i, (a, b) in enumerate(zip(losses, ref_losses)):
+        print(f"epoch {i}: rel gap {(a - b) / b:+.2%}", flush=True)
+    final_rel = abs(losses[-1] - ref_losses[-1]) / ref_losses[-1]
+    print(f"FINAL rel gap: {final_rel:.2%} "
+          f"({'PASS' if final_rel < 0.125 else 'FAIL'} @ 12.5%)",
+          flush=True)
+
+
+if __name__ == "__main__":
+    main()
