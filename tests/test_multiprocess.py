@@ -22,13 +22,18 @@ def run_launch(*args, timeout=300):
         env={**os.environ, "PYTHONPATH": REPO})
 
 
-def test_two_process_cluster_and_collective():
-    res = run_launch("-np", "2", "-cpu", "2", "--",
+@pytest.mark.parametrize("nprocs", [2, 4])
+def test_multi_process_cluster_and_collective(nprocs):
+    """N-way rendering of the reference's mpirun -np N: N jax.distributed
+    processes x 2 virtual devices; at N=4 the hybrid transfer=tpu mesh
+    gets 4 data groups (the _mp_child assertions scale with N)."""
+    res = run_launch("-np", str(nprocs), "-cpu", "2", "--",
                      sys.executable, os.path.join(REPO, "tests",
                                                   "_mp_child.py"))
     assert res.returncode == 0, res.stdout + res.stderr
-    for rank in (0, 1):
-        assert f"MP_OK proc={rank}/2 devices=4" in res.stdout, res.stdout
+    for rank in range(nprocs):
+        assert (f"MP_OK proc={rank}/{nprocs} devices={2 * nprocs}"
+                in res.stdout), res.stdout
 
 
 def test_launcher_propagates_child_failure():
