@@ -1,0 +1,14 @@
+#!/bin/bash
+# Poll the axon tunnel; on the first successful probe, run the full
+# chip_session agenda (results land in chip_session.jsonl). One shot.
+cd /root/repo
+for i in $(seq 1 200); do
+  if JAX_PLATFORMS=axon timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+    echo "$(date -u +%H:%M) tunnel UP - starting chip_session" >> tunnel_watch.log
+    python scripts/chip_session.py >> tunnel_watch.log 2>&1
+    echo "$(date -u +%H:%M) chip_session done" >> tunnel_watch.log
+    exit 0
+  fi
+  echo "$(date -u +%H:%M) probe $i: down" >> tunnel_watch.log
+  sleep 240
+done
