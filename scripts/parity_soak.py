@@ -77,6 +77,29 @@ def main():
           f"({'PASS' if final_rel < 0.125 else 'FAIL'} @ 12.5%)",
           flush=True)
 
+    if os.environ.get("SOAK_ASYNC"):
+        # hogwild (genuinely unsynchronized per-device replicas) vs the
+        # sync run above: the reference's async variant trades staleness
+        # for throughput and is expected to land near the same loss
+        hw = Word2Vec(config=ConfigParser().update({
+            "cluster": {"server_num": 1, "transfer": "xla"},
+            "word2vec": {"len_vec": 32, "window": 3, "negative": 5,
+                         "sample": -1, "learning_rate": 0.05,
+                         "async_mode": "hogwild", "local_steps": 2},
+            "server": {"initial_learning_rate": 0.3, "frag_num": 200},
+            "worker": {"minibatch": 5000},
+        }))
+        hw.build(sents)
+        t0 = time.perf_counter()
+        # group = 8 workers x local_steps full batches: a smaller batch
+        # keeps >= several groups per epoch at this corpus size
+        hw_losses = hw.train(sents, niters=NITERS, batch_size=1024)
+        t_hw = time.perf_counter() - t0
+        print(f"hogwild losses ({t_hw:.1f}s): "
+              + " ".join(f"{x:.4f}" for x in hw_losses), flush=True)
+        hw_rel = abs(hw_losses[-1] - losses[-1]) / losses[-1]
+        print(f"hogwild vs sync final gap: {hw_rel:+.2%}", flush=True)
+
 
 if __name__ == "__main__":
     main()

@@ -278,9 +278,21 @@ class Word2Vec:
         replica of the table (the reference's LocalParamCache, taken to
         its limit), trains ``n_inner`` batches on its own stream — own
         negatives, own AdaGrad accumulation, zero cross-device traffic —
-        then all replicas' deltas are psum-reconciled into the base, so
-        every worker's pushes land exactly once, none serialized against
-        another's (the server summing pushes as they arrive).  Staleness
+        then every worker's RAW GRADIENT pushes are all_gathered and
+        applied to the shared base SEQUENTIALLY through the access
+        method, exactly as the reference server applies each thread's
+        push in arrival order against the live accumulators
+        (server.h:159-176; worker-major order here is one valid
+        linearization of the nondeterministic arrival order).
+
+        Why not psum the replicas' deltas (this mode's first rendering):
+        each delta composes that worker's AdaGrad trajectory from the
+        SAME base accumulator, so summing them applies every worker's
+        full-size early steps to shared hot rows — an effective
+        n_workers-times overstep on frequent words that measurably
+        diverges (parity soak: hogwild loss rising by epoch 3, +72% vs
+        sync).  Sequential re-application lets each push see the accum
+        state the previous pushes grew, like the reference.  Staleness
         bound = ``n_inner`` batches x ``n_devices`` workers (the
         reference's is unbounded only by thread scheduling).
 
@@ -325,17 +337,27 @@ class Word2Vec:
                 c, x, m, k = xs
                 pushes, es, ec = grads_fn(
                     local, slot_of_vocab, alias_prob, alias_idx, c, x, m, k)
-                return apply_fn(local, pushes), (es, ec)
+                # the local replica evolves with this worker's own pushes
+                # (its stale view); the same pushes are also carried out
+                # for the shared sequential apply
+                return apply_fn(local, pushes), (pushes, es, ec)
 
-            local, (es, ec) = jax.lax.scan(
+            _, (pushes_l, es, ec) = jax.lax.scan(
                 body, state, (centers_l, contexts_l, masks_l, keys))
-            # reconcile: sum every worker's deltas into the shared base —
-            # params AND optimizer accumulators (the server saw all
-            # pushes); psum over the replicated base is divided back out.
-            new_state = {
-                f: state[f] + (jax.lax.psum(local[f], "worker")
-                               - n_workers * state[f])
-                for f in state}
+            # reconcile: every worker's push sequence, applied to the
+            # shared base one push at a time (worker-major) so each
+            # AdaGrad application sees the accumulators the previous
+            # pushes grew — the reference server's arrival-order apply.
+            gathered = jax.tree_util.tree_map(
+                lambda x: jax.lax.all_gather(x, "worker"), pushes_l)
+
+            def apply_worker(st, w_pushes):
+                def apply_step(st, s_pushes):
+                    return apply_fn(st, s_pushes), None
+                st, _ = jax.lax.scan(apply_step, st, w_pushes)
+                return st, None
+
+            new_state, _ = jax.lax.scan(apply_worker, state, gathered)
             return new_state, jax.lax.psum(es.sum(), "worker"), \
                 jax.lax.psum(ec.sum(), "worker")
 

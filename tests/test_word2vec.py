@@ -364,7 +364,8 @@ def test_w2v_resume_after_grow_invalidates_step(tmp_path, devices8):
 
 def test_w2v_hogwild_trains_and_matches_sync_loss(devices8):
     """Genuinely unsynchronized mode: 8 independent worker replicas,
-    delta-sum reconciliation.  Must converge, and land near the sync
+    sequential arrival-order reconciliation.  Must converge, and land
+    near the sync
     mode's final loss on the same corpus."""
     corpus = synthetic_corpus(150, vocab_size=50, length=12, seed=4)
 
@@ -399,10 +400,26 @@ def test_w2v_staleness_sweep(devices8):
         m = make_model(word2vec=overrides)
         losses = m.train(corpus, niters=3, batch_size=16)
         assert losses[-1] < losses[0], (name, losses)
+        # the final loss must BE the trajectory minimum: the fixed
+        # delta-psum overstep bug's signature was late divergence
+        # (4.41 -> 4.59 -> 6.05 — final 37% above the minimum), which a
+        # final-vs-initial check alone cannot catch
+        assert losses[-1] <= min(losses) + 1e-9, (name, losses)
         finals[name] = losses[-1]
     base = finals["sync"]
     for name, f in finals.items():
-        assert abs(f - base) / base < 0.35, finals
+        if name == "hogwild4":
+            # hogwild's staleness here is extreme for the corpus: a
+            # reconciliation round = 8 workers x 4 batches = 32 stale
+            # batches, ~1/3 of the whole epoch — correct sequential-
+            # apply semantics converge strictly but measurably slower
+            # at 3 epochs (the parity soak shows the trajectory closing
+            # epoch over epoch; the old delta-sum reconciliation looked
+            # "closer" at tiny scale only because its n_workers-fold
+            # overstep accelerated early descent before diverging).
+            assert abs(f - base) / base < 0.75, finals
+        else:
+            assert abs(f - base) / base < 0.35, finals
 
 
 def test_w2v_hogwild_guards(devices8):
