@@ -299,7 +299,12 @@ class Word2Vec:
         Trades the row-sharded layout for replication during the async
         phase (a vocab-scale table fits one device by orders of
         magnitude); the ``data``/``model`` sharded layout is the sync
-        path's concern."""
+        path's concern.  Memory note: the reconciliation materializes
+        every worker's push sequence on every device —
+        ``n_workers x local_steps x push_rows x d`` floats (e.g. 2.2GB
+        at a 16K-batch, 8-worker, 2-step configuration) — so very large
+        batch x local_steps combinations should prefer the snapshot
+        (``local_steps``-only) async mode."""
         if getattr(self.transfer, "name", "") == "tpu":
             raise ValueError(
                 "async_mode=hogwild requires the gather/scatter 'xla' "
