@@ -422,6 +422,11 @@ def child_main(which: str) -> None:
     model, step, batches = _build_w2v(device)
     out["w2v"] = _bench_w2v(device, timed, (model, step, batches))
     print("BENCH_CHILD " + json.dumps(out), flush=True)
+    if os.environ.get("BENCH_ONLY") == "w2v":
+        # tuning sweeps re-run the child across a shape grid; compiling
+        # the five secondary programs per cell (~minutes of scarce
+        # tunnel time each) would dwarf the one measurement they want
+        return
     def _shared():
         # TPU-first shared-negative-pool mode (docs/ARCHITECTURE.md):
         # same shapes, different NS sampling — labeled separately, never

@@ -31,7 +31,8 @@ def run_cell(batch, scan, timeout_s=360):
     number)."""
     res, err, _dt = bench._run_child(
         "tpu", timeout_s,
-        extra_env={"BENCH_BATCH": str(batch), "BENCH_SCAN": str(scan)})
+        extra_env={"BENCH_BATCH": str(batch), "BENCH_SCAN": str(scan),
+                   "BENCH_ONLY": "w2v"})
     return res, err
 
 
@@ -44,8 +45,8 @@ def main():
         cells = [tuple(int(x) for x in c.split(":"))
                  for c in os.environ["SWEEP_CELLS"].split(",")]
     best = None
-    print(f"{'batch':>7} {'scan':>5} {'words/s':>12} {'step_ms':>9} "
-          f"{'shared w/s':>12}", flush=True)
+    print(f"{'batch':>7} {'scan':>5} {'words/s':>12} {'step_ms':>9}",
+          flush=True)
     for batch, scan in cells:
         res, err = run_cell(batch, scan)
         w2v = (res or {}).get("w2v")
@@ -56,9 +57,7 @@ def main():
             continue
         w = w2v["words_per_sec"]
         s = w2v["step_ms"]
-        sh = res.get("w2v_shared", {}).get("words_per_sec", float("nan"))
-        print(f"{batch:7d} {scan:5d} {w:12.0f} {s:9.2f} {sh:12.0f}",
-              flush=True)
+        print(f"{batch:7d} {scan:5d} {w:12.0f} {s:9.2f}", flush=True)
         if best is None or w > best[2]:
             best = (batch, scan, w)
     if best:
