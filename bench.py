@@ -27,6 +27,7 @@ Prints ONE json line:
 """
 
 import argparse
+import glob
 import json
 import os
 import subprocess
@@ -405,6 +406,49 @@ def _bench_oracle():
     return {"words_per_sec": 12 * 200 / dt}
 
 
+def _bench_cpp_oracle():
+    """Compiled (-O3 C++) sequential reference-math rate — the honest
+    single-core stand-in for the reference's per-thread loop
+    (native/w2v_oracle.cpp; loss-parity-checked against the numpy oracle
+    in tests/test_cpp_oracle.py).  The modeled 8-rank figure divides by
+    8x THIS rate, not the numpy one (round-2 verdict: numpy flatters the
+    TPU by 10-30x)."""
+    import tempfile
+
+    import numpy as np
+    from swiftmpi_tpu.data.text import synthetic_corpus
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    binary = os.path.join(here, "native", "w2v_oracle")
+    if not os.path.exists(binary):
+        mk = subprocess.run(["make", "-C", os.path.join(here, "native"),
+                             "w2v_oracle"], capture_output=True,
+                            text=True, timeout=120)
+        if not os.path.exists(binary):
+            raise RuntimeError(
+                f"native/w2v_oracle failed to build (rc={mk.returncode}): "
+                f"{(mk.stderr or '').strip()[-300:]}")
+    sents = synthetic_corpus(12, VOCAB, 200, seed=11)
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        for s in sents:
+            f.write(" ".join(str(int(x)) for x in np.asarray(s)) + "\n")
+        path = f.name
+    try:
+        p = subprocess.run(
+            [binary, "-data", path, "-min_time", "2.0"],
+            capture_output=True, text=True, timeout=120)
+        if p.returncode != 0:
+            raise RuntimeError(f"w2v_oracle rc={p.returncode}: "
+                               f"{(p.stderr or '').strip()[-200:]}")
+        rec = json.loads(p.stdout.strip().splitlines()[-1])
+    finally:
+        os.unlink(path)
+    return {"words_per_sec": rec["words_per_sec"],
+            "loss_first_epoch": rec["loss_first_epoch"],
+            "epochs_timed": rec["epochs"]}
+
+
 def child_main(which: str) -> None:
     import jax
 
@@ -450,6 +494,7 @@ def child_main(which: str) -> None:
                    ("w2v_sg", _sg)]
     if which == "cpu":
         secondaries.append(("oracle", _bench_oracle))
+        secondaries.append(("cpp_oracle", _bench_cpp_oracle))
     if os.environ.get("BENCH_SCALE"):
         secondaries.append(
             ("w2v_1m", lambda: _bench_w2v_1m(device, max(timed // 2, 1))))
@@ -507,6 +552,56 @@ def _tpu_alive(timeout_s: float = 75) -> bool:
         return p.returncode == 0 and "AXON_OK" in (p.stdout or "")
     except subprocess.TimeoutExpired:
         return False
+
+
+CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                         ".bench_cache")
+_SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
+              "BENCH_SCALE", "BENCH_TFM")
+
+
+def _cache_tpu_result(tpu_res) -> None:
+    """Persist every successful TPU child result to disk (round-2
+    postmortem: 794K words/s was measured 12h before round end and then
+    LOST from the driver artifact because the tunnel was down at round
+    end and the degraded JSON carried no history).  Canonical-shape runs
+    (no BENCH_* overrides) additionally refresh ``tpu_latest.json``,
+    which degraded output embeds as ``last_known_tpu``."""
+    try:
+        os.makedirs(CACHE_DIR, exist_ok=True)
+        rec = {"ts": time.time(),
+               "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime()),
+               "overrides": {k: os.environ[k] for k in _SHAPE_ENV
+                             if os.environ.get(k)},
+               "result": tpu_res}
+        with open(os.path.join(CACHE_DIR,
+                               f"tpu_{int(rec['ts'])}.json"), "w") as f:
+            json.dump(rec, f)
+        if not rec["overrides"]:
+            with open(os.path.join(CACHE_DIR, "tpu_latest.json"),
+                      "w") as f:
+                json.dump(rec, f)
+    except OSError:
+        pass      # caching must never break the bench
+
+
+def _last_known_tpu():
+    """Newest cached TPU child result — canonical shape preferred, any
+    shape otherwise — with its age, for embedding in degraded output."""
+    try:
+        path = os.path.join(CACHE_DIR, "tpu_latest.json")
+        if not os.path.exists(path):
+            cands = sorted(glob.glob(os.path.join(CACHE_DIR,
+                                                  "tpu_*.json")))
+            if not cands:
+                return None
+            path = cands[-1]
+        with open(path) as f:
+            rec = json.load(f)
+        rec["age_hours"] = round((time.time() - rec["ts"]) / 3600, 1)
+        return rec
+    except (OSError, ValueError, KeyError):
+        return None
 
 
 def _run_child(which: str, timeout_s: float, extra_env=None):
@@ -577,6 +672,8 @@ def parent_main() -> None:
         tpu_err = ("liveness probe: axon backend init hung/failed within "
                    "75s — tunnel down; skipped the TPU child to protect "
                    "the overall bench budget")
+    if tpu_res is not None and "w2v" in tpu_res:
+        _cache_tpu_result(tpu_res)
     if tpu_res is None:
         degraded.append(f"tpu_unavailable: {tpu_err}")
 
@@ -619,17 +716,28 @@ def parent_main() -> None:
                 if cpu_res and "oracle" in cpu_res else None),
             "oracle_note": (
                 "sequential numpy port of the reference per-thread loop "
-                "(testing/w2v_oracle.py) at bench hyperparameters — the "
-                "single-thread reference-math rate"),
+                "(testing/w2v_oracle.py) — kept as the loss-parity "
+                "anchor only; throughput comparisons use the compiled "
+                "rate below"),
+            "cpp_oracle_words_per_sec": (
+                round(cpu_res["cpp_oracle"]["words_per_sec"], 1)
+                if cpu_res and "cpp_oracle" in cpu_res else None),
+            "cpp_oracle_note": (
+                "compiled -O3 C++ port of the same sequential loop "
+                "(native/w2v_oracle.cpp, loss-parity-checked vs the "
+                "numpy oracle) — the honest single-core reference-math "
+                "rate"),
             "vs_8rank_reference_estimate": (
                 round(tpu_w2v["words_per_sec"]
-                      / (8 * cpu_res["oracle"]["words_per_sec"]), 2)
-                if tpu_w2v and cpu_res and "oracle" in cpu_res else None),
+                      / (8 * cpu_res["cpp_oracle"]["words_per_sec"]), 2)
+                if tpu_w2v and cpu_res and "cpp_oracle" in cpu_res
+                else None),
             "vs_8rank_note": (
-                "TPU rate over 8x the sequential oracle — a MODELED "
-                "stand-in for the north star's 8-rank OpenMPI deployment "
-                "(assumes perfect 8-way scaling of the reference math, "
-                "i.e. an upper bound on the reference side)"),
+                "TPU rate over 8x the COMPILED sequential oracle — a "
+                "MODELED stand-in for the north star's 8-rank OpenMPI "
+                "deployment (assumes perfect 8-way scaling of the "
+                "reference math and zero RPC cost, i.e. an upper bound "
+                "on the reference side)"),
         },
         "secondary": {},
     }
@@ -673,6 +781,22 @@ def parent_main() -> None:
         out["detail"]["step_ms"] = round(tpu_w2v["step_ms"], 3)
     if degraded:
         out["degraded"] = degraded
+    if tpu_res is None:
+        lk = _last_known_tpu()
+        if lk is not None:
+            lk_w2v = (lk.get("result") or {}).get("w2v") or {}
+            out["last_known_tpu"] = {
+                "note": ("most recent successful on-chip measurement, "
+                         "cached by this bench — the tunnel was down "
+                         "for THIS run, so vs_baseline above is null; "
+                         "this block is the round's chip evidence"),
+                "measured_at": lk.get("iso"),
+                "age_hours": lk.get("age_hours"),
+                "words_per_sec": (round(lk_w2v["words_per_sec"], 1)
+                                  if "words_per_sec" in lk_w2v else None),
+                "overrides": lk.get("overrides") or {},
+                "result": lk.get("result"),
+            }
     print(json.dumps(out), flush=True)
 
 

@@ -1,0 +1,372 @@
+// Compiled sequential oracle of the reference word2vec CBOW+NS training
+// loop — the honest single-core stand-in for the reference's per-thread
+// rate (round-2 verdict Missing #3: a numpy oracle flatters the TPU; the
+// reference is -O3 C++, so the modeled 8-rank comparison must divide by
+// a compiled rate).
+//
+// Spec (behavior, not source): /root/reference/src/apps/word2vec/
+// word2vec.h:550-615 (hot loop), 177-185 (server AdaGrad, fudge 1e-6),
+// 398-425 (per-batch unigram^0.75 table), 120-132 (push-time gradient
+// mean-normalization), 621-630 (subsampling); LCG constants
+// /root/reference/src/utils/random.h:25-42.  Written from the same
+// behavioral spec as swiftmpi_tpu/testing/w2v_oracle.py so the two can
+// be cross-checked for loss parity (tests/test_cpp_oracle.py); this file
+// is an independent implementation, not a translation of the reference.
+//
+// Deliberate float discipline mirrors the numpy oracle exactly: float32
+// row storage, float64 hot-loop accumulation, float32 AdaGrad — so loss
+// curves agree to float tolerance.  Row init replicates
+// numpy.random.RandomState(seed).rand() (std::mt19937 shares MT19937's
+// init_genrand seeding; random_sample is the standard 53-bit recipe).
+//
+// Build: make -C native w2v_oracle
+// Run:   ./w2v_oracle -data corpus.txt [-len_vec 100 -window 4
+//        -negative 20 -alpha 0.05 -server_lr 0.7 -sample -1
+//        -minibatch 5000 -table_size 1000000 -min_time 1.0]
+// Output: one JSON line {"tokens":N,"epochs":E,"elapsed_s":S,
+//        "words_per_sec":R,"loss_first_epoch":L}
+
+#include <algorithm>
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <chrono>
+#include <fstream>
+#include <random>
+#include <sstream>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+constexpr int kExpTableSize = 1000;
+constexpr double kMaxExp = 6.0;
+
+// ---- reference LCGs (random.h:25-42) ----------------------------------
+struct Lcg {
+  uint64_t next_random;
+  uint64_t next_float_random;
+  explicit Lcg(uint64_t seed)
+      : next_random(seed), next_float_random(UINT64_MAX / 2) {}
+  uint64_t operator()() {
+    next_random = next_random * 25214903917ULL + 11ULL;
+    return next_random;
+  }
+  double gen_float() {
+    next_float_random = next_float_random * 4903917ULL + 11ULL;
+    return static_cast<double>(next_float_random) /
+           static_cast<double>(UINT64_MAX);
+  }
+};
+
+// ---- numpy RandomState(seed).rand() replica ---------------------------
+struct NumpyRand {
+  std::mt19937 mt;
+  explicit NumpyRand(uint32_t seed) : mt(seed) {}
+  double rand() {
+    uint32_t a = mt() >> 5, b = mt() >> 6;
+    return (a * 67108864.0 + b) / 9007199254740992.0;
+  }
+};
+
+// ---- bucketed sigmoid (word2vec.h:237-267) ----------------------------
+float g_exp_table[kExpTableSize];
+
+void init_exp_table() {
+  for (int i = 0; i < kExpTableSize; ++i) {
+    double t = std::exp((static_cast<double>(i) / kExpTableSize * 2.0 - 1.0)
+                        * kMaxExp);
+    g_exp_table[i] = static_cast<float>(t / (t + 1.0));
+  }
+}
+
+// (label - sigmoid_clipped(f)) * alpha with the reference branch
+// structure (word2vec.h:591-598)
+inline double grad_coef(double f, int label, double alpha) {
+  if (f > kMaxExp) return (label - 1.0) * alpha;
+  if (f < -kMaxExp) return static_cast<double>(label) * alpha;
+  int idx = static_cast<int>((f + kMaxExp) * (kExpTableSize / kMaxExp / 2.0));
+  if (idx >= kExpTableSize) idx = kExpTableSize - 1;
+  if (idx < 0) idx = 0;
+  return (label - static_cast<double>(g_exp_table[idx])) * alpha;
+}
+
+struct Args {
+  std::string data;
+  int len_vec = 100, window = 4, negative = 20, minibatch = 5000;
+  double alpha = 0.05, server_lr = 0.7, sample = -1.0, min_time = 1.0;
+  long table_size = 1000000;
+  uint64_t seed = 2008;
+  uint32_t init_seed = 0;
+  int max_epochs = 1000000;
+};
+
+struct Corpus {
+  std::vector<std::vector<int>> sentences;
+  long tokens = 0;
+  int max_word = 0;
+};
+
+Corpus load_corpus(const std::string& path) {
+  Corpus c;
+  std::ifstream in(path);
+  if (!in) { std::fprintf(stderr, "cannot open %s\n", path.c_str()); std::exit(2); }
+  std::string line;
+  while (std::getline(in, line)) {
+    std::vector<int> sent;
+    std::istringstream ss(line);
+    int w;
+    while (ss >> w) {
+      sent.push_back(w);
+      if (w > c.max_word) c.max_word = w;
+    }
+    if (!sent.empty()) {
+      c.tokens += static_cast<long>(sent.size());
+      c.sentences.push_back(std::move(sent));
+    }
+  }
+  return c;
+}
+
+class Oracle {
+ public:
+  Oracle(const Args& a, int vocab_cap)
+      : a_(a), d_(a.len_vec), lcg_(a.seed), init_rng_(a.init_seed),
+        V_(vocab_cap),
+        h_(static_cast<size_t>(V_) * d_), v_(static_cast<size_t>(V_) * d_),
+        h2_(static_cast<size_t>(V_) * d_, 0.f),
+        v2_(static_cast<size_t>(V_) * d_, 0.f),
+        initialized_(V_, false),
+        gh_(static_cast<size_t>(V_) * d_, 0.0),
+        gv_(static_cast<size_t>(V_) * d_, 0.0),
+        ch_(V_, 0), cv_(V_, 0),
+        hs_(static_cast<size_t>(V_) * d_), vs_(static_cast<size_t>(V_) * d_),
+        batch_freq_(V_, 0) {}
+
+  // one epoch; returns mean error (Error::norm, word2vec.h:491)
+  double train_epoch(const Corpus& c) {
+    double err_sum = 0.0;
+    long err_cnt = 0;
+    // batches of minibatch+1 lines (the post-increment break quirk)
+    size_t step = static_cast<size_t>(a_.minibatch) + 1;
+    for (size_t start = 0; start < c.sentences.size(); start += step) {
+      size_t end = std::min(start + step, c.sentences.size());
+      train_batch(c, start, end, &err_sum, &err_cnt);
+    }
+    return err_sum / static_cast<double>(std::max(err_cnt, 1L));
+  }
+
+ private:
+  void ensure_row(int w) {
+    if (initialized_[w]) return;
+    initialized_[w] = true;
+    float* h = &h_[static_cast<size_t>(w) * d_];
+    float* v = &v_[static_cast<size_t>(w) * d_];
+    for (int k = 0; k < d_; ++k)
+      h[k] = static_cast<float>((init_rng_.rand() - 0.5) / d_);
+    for (int k = 0; k < d_; ++k)
+      v[k] = static_cast<float>((init_rng_.rand() - 0.5) / d_);
+  }
+
+  // per-batch unigram^0.75 table, words in ascending key order,
+  // searchsorted-left advance (word2vec.h:398-425)
+  void gen_unigram_table(const std::vector<int>& keys_sorted) {
+    size_t n = keys_sorted.size();
+    std::vector<double> cum(n);
+    double total = 0.0;
+    for (size_t i = 0; i < n; ++i)
+      total += std::pow(static_cast<double>(batch_freq_[keys_sorted[i]]),
+                        0.75);
+    double run = 0.0;
+    for (size_t i = 0; i < n; ++i) {
+      run += std::pow(static_cast<double>(batch_freq_[keys_sorted[i]]),
+                      0.75);
+      cum[i] = run / total;
+    }
+    table_.resize(a_.table_size);
+    size_t i = 0;
+    for (long aidx = 0; aidx < a_.table_size; ++aidx) {
+      double frac = static_cast<double>(aidx) / a_.table_size;
+      while (i < n && cum[i] < frac) ++i;  // lower_bound advance
+      table_[aidx] = keys_sorted[std::min(i, n - 1)];
+    }
+  }
+
+  void train_batch(const Corpus& c, size_t s0, size_t s1,
+                   double* err_sum, long* err_cnt) {
+    // gather: batch frequencies in first-seen order; cumulative
+    // num_words (never reset — reference quirk)
+    touched_.clear();
+    for (size_t s = s0; s < s1; ++s)
+      for (int w : c.sentences[s]) {
+        if (batch_freq_[w] == 0) touched_.push_back(w);
+        ++batch_freq_[w];
+        ++num_words_;
+      }
+    if (touched_.size() < 5) {              // word2vec.h:528 guard
+      for (int w : touched_) batch_freq_[w] = 0;
+      return;
+    }
+    for (int w : touched_) ensure_row(w);   // lazy init at pull
+    std::vector<int> keys_sorted(touched_);
+    std::sort(keys_sorted.begin(), keys_sorted.end());
+    gen_unigram_table(keys_sorted);
+    // pulled snapshot: grads against pull-time values
+    for (int w : touched_) {
+      std::memcpy(&hs_[static_cast<size_t>(w) * d_],
+                  &h_[static_cast<size_t>(w) * d_], sizeof(float) * d_);
+      std::memcpy(&vs_[static_cast<size_t>(w) * d_],
+                  &v_[static_cast<size_t>(w) * d_], sizeof(float) * d_);
+    }
+
+    std::vector<double> neu1(d_), neu1e(d_);
+    std::vector<int> ctx;
+    for (size_t s = s0; s < s1; ++s) {
+      const std::vector<int>& sent = c.sentences[s];
+      int L = static_cast<int>(sent.size());
+      for (int pos = 0; pos < L; ++pos) {
+        int word = sent[pos];
+        if (a_.sample >= 0.0) {             // subsampling coin
+          double freq = static_cast<double>(batch_freq_[word]) /
+                        static_cast<double>(num_words_);
+          double ran = 1.0 - std::sqrt(a_.sample / freq);
+          if (!(lcg_.gen_float() > ran)) continue;
+        }
+        int b = static_cast<int>(lcg_() % a_.window);   // word2vec.h:566
+        std::fill(neu1.begin(), neu1.end(), 0.0);
+        ctx.clear();
+        for (int aa = b; aa < a_.window * 2 + 1 - b; ++aa) {
+          if (aa == a_.window) continue;
+          int cpos = pos - a_.window + aa;
+          if (cpos < 0 || cpos >= L) continue;
+          int cw = sent[cpos];
+          ctx.push_back(cw);
+          const float* row = &vs_[static_cast<size_t>(cw) * d_];
+          for (int k = 0; k < d_; ++k) neu1[k] += row[k];
+        }
+        std::fill(neu1e.begin(), neu1e.end(), 0.0);
+        for (int dd = 0; dd <= a_.negative; ++dd) {
+          int target, label;
+          if (dd == 0) {
+            target = word; label = 1;
+          } else {
+            target = table_[(lcg_() >> 16) % a_.table_size];
+            if (target == 0)                 // single redraw quirk
+              target = table_[(lcg_() >> 16) % a_.table_size];
+            if (target == word) continue;
+            label = 0;
+          }
+          const float* hrow = &hs_[static_cast<size_t>(target) * d_];
+          double f = 0.0;
+          for (int k = 0; k < d_; ++k) f += neu1[k] * hrow[k];
+          double g = grad_coef(f, label, a_.alpha);
+          *err_sum += 1e4 * g * g;           // word2vec.h:593
+          ++*err_cnt;
+          double* ghrow = &gh_[static_cast<size_t>(target) * d_];
+          for (int k = 0; k < d_; ++k) {
+            neu1e[k] += g * hrow[k];
+            ghrow[k] += g * neu1[k];
+          }
+          ++ch_[target];
+        }
+        for (int cw : ctx) {
+          double* gvrow = &gv_[static_cast<size_t>(cw) * d_];
+          for (int k = 0; k < d_; ++k) gvrow[k] += neu1e[k];
+          ++cv_[cw];
+        }
+      }
+    }
+
+    // push: mean-normalize then server AdaGrad (float32 discipline)
+    for (int w : touched_) {
+      if (ch_[w] > 0)
+        adagrad(&h_[static_cast<size_t>(w) * d_],
+                &h2_[static_cast<size_t>(w) * d_],
+                &gh_[static_cast<size_t>(w) * d_], ch_[w]);
+      if (cv_[w] > 0)
+        adagrad(&v_[static_cast<size_t>(w) * d_],
+                &v2_[static_cast<size_t>(w) * d_],
+                &gv_[static_cast<size_t>(w) * d_], cv_[w]);
+      // reset batch accumulators for the touched rows only
+      std::memset(&gh_[static_cast<size_t>(w) * d_], 0, sizeof(double) * d_);
+      std::memset(&gv_[static_cast<size_t>(w) * d_], 0, sizeof(double) * d_);
+      ch_[w] = 0; cv_[w] = 0;
+      batch_freq_[w] = 0;
+    }
+  }
+
+  // word2vec.h:177-185: accum += g²; p += lr·g/sqrt(accum + 1e-6)
+  void adagrad(float* p, float* sq, const double* grad_sum, long count) {
+    float lr = static_cast<float>(a_.server_lr);
+    for (int k = 0; k < d_; ++k) {
+      float g = static_cast<float>(grad_sum[k] / count);
+      sq[k] = sq[k] + g * g;
+      p[k] = p[k] + lr * g / std::sqrt(sq[k] + 1e-6f);
+    }
+  }
+
+  const Args& a_;
+  int d_;
+  Lcg lcg_;
+  NumpyRand init_rng_;
+  int V_;
+  std::vector<float> h_, v_, h2_, v2_;
+  std::vector<char> initialized_;
+  std::vector<double> gh_, gv_;
+  std::vector<long> ch_, cv_;
+  std::vector<float> hs_, vs_;          // pull-time snapshots
+  std::vector<long> batch_freq_;
+  std::vector<int> touched_;
+  std::vector<int> table_;
+  long num_words_ = 0;
+};
+
+}  // namespace
+
+int main(int argc, char** argv) {
+  Args a;
+  for (int i = 1; i < argc - 1; ++i) {
+    std::string k = argv[i];
+    const char* val = argv[i + 1];
+    if (k == "-data") a.data = val;
+    else if (k == "-len_vec") a.len_vec = std::atoi(val);
+    else if (k == "-window") a.window = std::atoi(val);
+    else if (k == "-negative") a.negative = std::atoi(val);
+    else if (k == "-minibatch") a.minibatch = std::atoi(val);
+    else if (k == "-alpha") a.alpha = std::atof(val);
+    else if (k == "-server_lr") a.server_lr = std::atof(val);
+    else if (k == "-sample") a.sample = std::atof(val);
+    else if (k == "-table_size") a.table_size = std::atol(val);
+    else if (k == "-min_time") a.min_time = std::atof(val);
+    else if (k == "-seed") a.seed = std::strtoull(val, nullptr, 10);
+    else if (k == "-init_seed") a.init_seed = std::atoi(val);
+    else if (k == "-max_epochs") a.max_epochs = std::atoi(val);
+  }
+  if (a.data.empty()) {
+    std::fprintf(stderr, "usage: w2v_oracle -data corpus.txt [flags]\n");
+    return 2;
+  }
+  init_exp_table();
+  Corpus c = load_corpus(a.data);
+  Oracle oracle(a, c.max_word + 1);
+
+  double loss_first = 0.0;
+  int epochs = 0;
+  auto t0 = std::chrono::steady_clock::now();
+  double elapsed = 0.0;
+  while (epochs < a.max_epochs) {
+    double loss = oracle.train_epoch(c);
+    if (epochs == 0) loss_first = loss;
+    ++epochs;
+    elapsed = std::chrono::duration<double>(
+        std::chrono::steady_clock::now() - t0).count();
+    if (elapsed >= a.min_time) break;
+  }
+  double wps = static_cast<double>(c.tokens) * epochs / elapsed;
+  std::printf("{\"tokens\": %ld, \"epochs\": %d, \"elapsed_s\": %.6f, "
+              "\"words_per_sec\": %.1f, \"loss_first_epoch\": %.6f}\n",
+              c.tokens, epochs, elapsed, wps, loss_first);
+  return 0;
+}

@@ -1,14 +1,33 @@
 #!/bin/bash
-# Poll the axon tunnel; on the first successful probe, run the full
-# chip_session agenda (results land in chip_session.jsonl). One shot.
+# Poll the axon tunnel; whenever a probe succeeds, run the full
+# chip_session agenda (results land in chip_session.jsonl), then KEEP
+# watching — later windows re-run the agenda so newly-landed code gets
+# measured too.
 cd /root/repo
-for i in $(seq 1 200); do
-  if JAX_PLATFORMS=axon timeout 75 python -c "import jax; jax.devices()" >/dev/null 2>&1; then
+# The sitecustomize hook only registers the axon PJRT plugin when this
+# var is set; without it every probe fails even with the tunnel live
+# (round-2 advisor finding).  Same default as bench._tpu_env().
+export PALLAS_AXON_POOL_IPS="${PALLAS_AXON_POOL_IPS:-127.0.0.1}"
+
+probe() {
+  # bench._tpu_alive() is THE shared probe (same env construction as the
+  # TPU child) — probing any other way re-opens the probe/child
+  # divergence this script exists to avoid
+  timeout 120 python -c \
+    "import bench, sys; sys.exit(0 if bench._tpu_alive() else 1)" \
+    >/dev/null 2>&1
+}
+
+i=0
+while :; do
+  i=$((i+1))
+  if probe; then
     echo "$(date -u +%H:%M) tunnel UP - starting chip_session" >> tunnel_watch.log
     python scripts/chip_session.py >> tunnel_watch.log 2>&1
-    echo "$(date -u +%H:%M) chip_session done" >> tunnel_watch.log
-    exit 0
+    echo "$(date -u +%H:%M) chip_session done - resuming watch" >> tunnel_watch.log
+    sleep 600   # cooldown: don't re-burn the same window back-to-back
+  else
+    echo "$(date -u +%H:%M) probe $i: down" >> tunnel_watch.log
   fi
-  echo "$(date -u +%H:%M) probe $i: down" >> tunnel_watch.log
-  sleep 240
+  sleep 120
 done

@@ -187,7 +187,7 @@ class Sent2Vec:
                 wm._slot_of_vocab, jnp.asarray(vocab_pos),
                 niters, sub)
             queued.append((chunk, vecs, err))
-            if len(queued) > MAX_IN_FLIGHT:
+            while len(queued) >= MAX_IN_FLIGHT:
                 drain_one()
         while queued:
             drain_one()

@@ -37,7 +37,11 @@ def ensure_cpu_mesh_flags(n_devices: int | None = None,
             force_device_count
             or "--xla_force_host_platform_device_count" not in flags):
         flags += f" --xla_force_host_platform_device_count={n_devices}"
+    # each timeout flag guarded on ITS OWN substring: a caller who set
+    # only one of the pair keeps their value (last-occurrence-wins would
+    # otherwise silently override it — round-2 advisor finding)
+    if "--xla_cpu_collective_call_warn_stuck_timeout_seconds" not in flags:
+        flags += " --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
     if "--xla_cpu_collective_call_terminate_timeout_seconds" not in flags:
-        flags += (" --xla_cpu_collective_call_warn_stuck_timeout_seconds=60"
-                  " --xla_cpu_collective_call_terminate_timeout_seconds=600")
+        flags += " --xla_cpu_collective_call_terminate_timeout_seconds=600"
     os.environ["XLA_FLAGS"] = flags

@@ -1,0 +1,44 @@
+"""bench.py chip-evidence cache: a successful TPU child result must
+survive to later (possibly tunnel-down) runs as ``last_known_tpu``
+(round-2 verdict Weak #1: 794K words/s was measured 12h before round end
+and lost from the driver artifact because the degraded JSON carried no
+history)."""
+
+import sys
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+import bench  # noqa: E402
+
+
+def test_cache_roundtrip(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    res = {"w2v": {"words_per_sec": 123456.0, "step_ms": 20.0},
+           "platform": "axon"}
+    bench._cache_tpu_result(res)
+    lk = bench._last_known_tpu()
+    assert lk["result"]["w2v"]["words_per_sec"] == 123456.0
+    assert lk["age_hours"] < 1.0
+    assert lk["overrides"] == {}
+
+
+def test_override_runs_do_not_clobber_canonical_latest(tmp_path,
+                                                       monkeypatch):
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result({"w2v": {"words_per_sec": 100.0}})
+    # a sweep cell (non-canonical shape) is archived but must not become
+    # the headline last-known number
+    monkeypatch.setenv("BENCH_BATCH", "999")
+    monkeypatch.setenv("BENCH_ONLY", "w2v")
+    bench._cache_tpu_result({"w2v": {"words_per_sec": 999.0}})
+    lk = bench._last_known_tpu()
+    assert lk["result"]["w2v"]["words_per_sec"] == 100.0
+
+
+def test_no_cache_returns_none(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path / "empty"))
+    assert bench._last_known_tpu() is None
