@@ -6,13 +6,17 @@ measurement agenda in priority order, each stage in its own subprocess
 with a timeout (a wedge costs one stage), appending every result to
 ``chip_session.jsonl``:
 
-  1. full bench.py (headline + secondaries -> the driver-format line)
-  2. step_sweep.py (BATCH x SCAN tuning grid)
-  3. gather_micro.py (incl. the Pallas VMEM-gather A/B)
-  4. scatter_micro.py (scatter/sampling cells)
-  5. crossover.py --single-device (backend grid, chip cells)
-  6. bench.py TPU child with BENCH_SCALE=1 (1M-vocab pipeline)
-  7. bench.py TPU child with BENCH_TFM=1 (transformer tokens/s)
+  1. gather_micro.py --ab-only (records the vmem-gather calibration
+     verdict so everything after runs with the measured-best path)
+  2. full bench.py (headline + secondaries -> the driver-format line)
+  3. bench.py TPU child, BENCH_ONLY=w2v, Pallas gates forced OFF (the
+     step-level on/off delta for the record)
+  4. gather_micro.py --no-ab (full grid)
+  5. scatter_micro.py (scatter/sampling cells + Pallas scatter A/B)
+  6. step_sweep.py (BATCH x SCAN tuning grid)
+  7. crossover.py --single-device (backend grid, chip cells)
+  8. bench.py TPU child with BENCH_SCALE=1 (1M-vocab pipeline)
+  9. bench.py TPU child with BENCH_TFM=1 (transformer tokens/s)
 
 Run: python scripts/chip_session.py            (probes first)
 """
@@ -64,10 +68,21 @@ def main():
     log({"stage": "session_start", "note": "tunnel probe OK"})
     py = sys.executable
     agenda = [
+        # A/B first: records the vmem-gather calibration verdict so the
+        # bench_full that follows (and the driver's round-end bench) run
+        # with the measured-best gather path
+        ("gather_ab", [py, "scripts/gather_micro.py", "--ab-only"],
+         360, None),
         ("bench_full", [py, "bench.py"], 1600, None),
-        ("step_sweep", [py, "scripts/step_sweep.py"], 2400, None),
-        ("gather_micro", [py, "scripts/gather_micro.py"], 600, None),
+        # step-level on/off delta for the record (gate forced off)
+        ("bench_w2v_nopallas", [py, "bench.py", "--child", "tpu"], 600,
+         {"BENCH_ONLY": "w2v", "SMTPU_PALLAS_GATHER": "0",
+          "SMTPU_PALLAS_SCATTER": "0"}),
+        # --no-ab: the A/B already ran as stage 1; don't re-burn window
+        ("gather_micro", [py, "scripts/gather_micro.py", "--no-ab"],
+         600, None),
         ("scatter_micro", [py, "scripts/scatter_micro.py"], 600, None),
+        ("step_sweep", [py, "scripts/step_sweep.py"], 2400, None),
         ("crossover_chip", [py, "scripts/crossover.py",
                             "--single-device", "--reps", "3"], 1800, None),
         ("bench_scale", [py, "bench.py", "--child", "tpu"], 600,

@@ -35,7 +35,7 @@ def timeit(fn, *args, reps=16):
     return (time.perf_counter() - t0) / reps
 
 
-def main():
+def main(ab=True):
     import jax
     import jax.numpy as jnp
 
@@ -90,23 +90,68 @@ def main():
     ms = timeit(jax.jit(onehot_mm), table, idx2) * 1e3
     print(f"onehot-matmul gather (bf16, cap=17314): {ms:7.2f} ms", flush=True)
 
-    # Pallas VMEM-resident gather (ops/pallas_gather.py) vs XLA's HBM
-    # gather at the bench shape — the "does XLA fall short?" experiment
+    if ab:
+        pallas_ab()
+
+
+def pallas_ab():
+    """Pallas VMEM-resident gather (ops/pallas_gather.py) vs XLA's HBM
+    gather at the bench shape — the "does XLA fall short?" experiment.
+    Records the verdict via ops/calibration so the pull path's
+    measurement-driven gate (transfer/xla.py) flips on a real win."""
+    import jax
+    import jax.numpy as jnp
+
+    from swiftmpi_tpu.ops import calibration
     from swiftmpi_tpu.ops.pallas_gather import fits_vmem, vmem_gather
+
+    rng = np.random.default_rng(0)
+    cap = 17_314
     tf32 = jnp.asarray(rng.standard_normal((cap, 100)), jnp.float32)
     N = 344_064
     idx3 = jnp.asarray(rng.integers(0, cap, N), jnp.int32)
-    if fits_vmem(tf32):
-        try:
-            pg = jax.jit(lambda t, i: vmem_gather(t, i).sum())
-            ms = timeit(pg, tf32, idx3) * 1e3
-            gb = N * 100 * 4 / 1e9
-            print(f"pallas vmem gather (fp32, cap=17314): {ms:7.2f} ms  "
-                  f"{gb / ms * 1e3:6.1f} GB/s", flush=True)
-        except Exception as e:       # Mosaic may reject dynamic gather
-            print(f"pallas vmem gather: UNSUPPORTED ({type(e).__name__}: "
-                  f"{str(e)[:200]})", flush=True)
+    platform = jax.devices()[0].platform
+    print(f"A/B device: {jax.devices()[0]}", flush=True)
+
+    xla_take = jax.jit(lambda t, i: jnp.take(t, i, axis=0).sum())
+    xla_ms = timeit(xla_take, tf32, idx3) * 1e3
+    gb = N * 100 * 4 / 1e9
+    print(f"xla gather    (fp32, cap={cap}): {xla_ms:7.2f} ms  "
+          f"{gb / xla_ms * 1e3:6.1f} GB/s", flush=True)
+    if not fits_vmem(tf32):
+        return
+    try:
+        # correctness first: a Mosaic-lowering divergence must never
+        # flip the gate onto wrong numerics
+        small_idx = idx3[:8192]
+        got = np.asarray(vmem_gather(tf32, small_idx))
+        want = np.asarray(jnp.take(tf32, small_idx, axis=0))
+        correct = bool(np.allclose(got, want))
+        pg = jax.jit(lambda t, i: vmem_gather(t, i).sum())
+        pallas_ms = timeit(pg, tf32, idx3) * 1e3
+        print(f"pallas vmem gather (fp32, cap={cap}): {pallas_ms:7.2f} ms"
+              f"  {gb / pallas_ms * 1e3:6.1f} GB/s  correct={correct}",
+              flush=True)
+        verdict = {"win": bool(correct and pallas_ms < 0.9 * xla_ms),
+                   "correct": correct,
+                   "pallas_ms": round(pallas_ms, 3),
+                   "xla_ms": round(xla_ms, 3),
+                   "shape": f"cap={cap} d=100 fp32 N={N}"}
+    except Exception as e:       # Mosaic may reject dynamic gather
+        print(f"pallas vmem gather: UNSUPPORTED ({type(e).__name__}: "
+              f"{str(e)[:200]})", flush=True)
+        verdict = {"win": False,
+                   "error": f"{type(e).__name__}: {str(e)[:200]}",
+                   "xla_ms": round(xla_ms, 3)}
+    if platform == "tpu":        # only chip verdicts gate the chip path
+        key = calibration.device_key()
+        calibration.record("vmem_gather", key, verdict)
+        print(f"calibration recorded: vmem_gather:{key} -> {verdict}",
+              flush=True)
 
 
 if __name__ == "__main__":
-    main()
+    if "--ab-only" in sys.argv:
+        pallas_ab()
+    else:
+        main(ab="--no-ab" not in sys.argv)

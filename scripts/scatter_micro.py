@@ -41,3 +41,37 @@ prob, alias = build_unigram_alias(counts)
 prob_d, alias_d = jnp.asarray(prob), jnp.asarray(alias)
 samp = jax.jit(lambda k: sample_alias(k, prob_d, alias_d, (16384, 20)).sum())
 print(f"alias sampling (16384 x 20 draws)      : {timeit(samp, jax.random.key(0)):7.2f} ms", flush=True)
+# Pallas VMEM-resident scatter A/B (ops/pallas_scatter.py) at the w2v
+# fused grads+count shape — records the calibration verdict that gates
+# the push path (transfer/xla.py)
+from swiftmpi_tpu.ops import calibration
+from swiftmpi_tpu.ops.pallas_scatter import fits_vmem, vmem_scatter_add
+xla_ms = timeit(fscat, gi, g1)
+if fits_vmem(capw, d + 1):
+    try:
+        # correctness first (duplicate-heavy small case), then timing
+        si, sg = gi[:8192], g1[:8192]
+        got = np.asarray(vmem_scatter_add(si, sg, capw))
+        want = np.asarray(jnp.zeros((capw + 1, d + 1), jnp.float32)
+                          .at[si].add(sg))
+        correct = bool(np.allclose(got, want, rtol=1e-5, atol=1e-5))
+        pscat = jax.jit(lambda i, g: vmem_scatter_add(i, g, capw).sum())
+        p_ms = timeit(pscat, gi, g1)
+        print(f"pallas vmem scatter (x101 -> 17314+1)  : {p_ms:7.2f} ms"
+              f"  correct={correct}", flush=True)
+        verdict = {"win": bool(correct and p_ms < 0.9 * xla_ms),
+                   "correct": correct,
+                   "pallas_ms": round(p_ms, 3),
+                   "xla_ms": round(xla_ms, 3),
+                   "shape": f"cap={capw} w={d+1} fp32 N={Nw}"}
+    except Exception as e:
+        print(f"pallas vmem scatter: UNSUPPORTED ({type(e).__name__}: "
+              f"{str(e)[:200]})", flush=True)
+        verdict = {"win": False,
+                   "error": f"{type(e).__name__}: {str(e)[:200]}",
+                   "xla_ms": round(xla_ms, 3)}
+    if jax.devices()[0].platform == "tpu":
+        key = calibration.device_key()
+        calibration.record("vmem_scatter", key, verdict)
+        print(f"calibration recorded: vmem_scatter:{key} -> {verdict}",
+              flush=True)

@@ -1,0 +1,118 @@
+"""Measurement-driven kernel selection (autotuning verdicts).
+
+The round-2 on-chip profile showed XLA's HBM row gather is
+transaction-bound at ~3.5% of HBM peak — but whether the Pallas
+VMEM-resident alternative actually beats it is a *measurement*, not a
+judgment call, and the answer may differ per platform/generation.  This
+module is the tiny persistence layer that lets microbenchmarks
+(scripts/gather_micro.py, scripts/scatter_micro.py) record their A/B
+verdicts and lets hot paths (transfer/xla.py) consult them at trace
+time:
+
+    record("vmem_gather", "tpu", {"win": True, "pallas_ms": ..,
+                                  "xla_ms": ..})
+    lookup("vmem_gather", "tpu")  -> dict | None
+
+Verdicts live in ``.bench_cache/calibration.json`` at the repo root
+(committed, so a fresh checkout on the same hardware class inherits
+them) — the same evidence directory bench.py uses for chip results.
+Absent verdict = conservative default (XLA path), so nothing here can
+make a cold environment slower.
+
+The reference has no analogue (its hot loop is fixed C++); this is the
+TPU-first replacement for hand-tuning.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from typing import Optional
+
+_LOCK = threading.Lock()
+_CACHE: Optional[dict] = None
+
+
+def _path() -> str:
+    env = os.environ.get("SMTPU_CALIBRATION")
+    if env:
+        return env
+    repo = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(repo, ".bench_cache", "calibration.json")
+
+
+def _load() -> dict:
+    global _CACHE
+    if _CACHE is None:
+        try:
+            with open(_path()) as f:
+                _CACHE = json.load(f)
+        except (OSError, ValueError):
+            _CACHE = {}
+    return _CACHE
+
+
+def lookup(name: str, platform: str) -> Optional[dict]:
+    """Most recent verdict for (kernel, platform), or None."""
+    return _load().get(f"{name}:{platform}")
+
+
+def record(name: str, platform: str, verdict: dict) -> None:
+    """Persist a verdict; merges with existing file under a lock."""
+    global _CACHE
+    with _LOCK:
+        path = _path()
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            data = {}
+        data[f"{name}:{platform}"] = verdict
+        os.makedirs(os.path.dirname(path), exist_ok=True)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(data, f, indent=1, sort_keys=True)
+        os.replace(tmp, path)
+        _CACHE = data
+
+
+def reset_cache() -> None:
+    """Drop the in-process memo (tests; or after an external write)."""
+    global _CACHE
+    _CACHE = None
+
+
+def device_key() -> str:
+    """Calibration key for the current accelerator: the device *kind*
+    (e.g. ``TPU v5 lite``), not the bare platform — a win measured on
+    one TPU generation must not gate the kernel on another."""
+    import jax
+
+    return jax.devices()[0].device_kind
+
+
+def gated(name: str, env_var: str, fits: bool) -> bool:
+    """The shared measurement-driven gate policy (one copy for all
+    Pallas kernels): env force-off beats everything; a kernel that
+    doesn't fit never routes; env force-on is the caller's explicit
+    override (tests/experiments); auto requires TPU backend, a single
+    device (the kernels are single-core VMEM programs — sharded
+    operands would be re-laid-out or rejected by the partitioner), and
+    a recorded on-chip win for this device kind."""
+    import jax
+
+    mode = os.environ.get(env_var, "auto").lower()
+    if mode in ("0", "off", "false"):
+        return False
+    if not fits:
+        return False
+    if mode in ("1", "on", "true"):
+        return True
+    if jax.default_backend() != "tpu":
+        return False
+    if jax.device_count() != 1:
+        return False
+    verdict = lookup(name, device_key())
+    return bool(verdict and verdict.get("win"))

@@ -27,11 +27,14 @@ LocalParamCache in L2.
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
+
+from swiftmpi_tpu.ops import calibration
 
 _DEF_IDX_BLOCK = 4096
 
@@ -84,3 +87,36 @@ def fits_vmem(table: jax.Array, idx_block: int = _DEF_IDX_BLOCK,
     t = table.shape[0] * table.shape[1] * table.dtype.itemsize
     blk = idx_block * (4 + table.shape[1] * table.dtype.itemsize)
     return t + blk <= budget_bytes
+
+
+# --------------------------------------------------------------------------
+# the wired-in path: masked gather + measurement-driven gate
+# --------------------------------------------------------------------------
+
+def use_vmem_gather(table: jax.Array) -> bool:
+    """Should the pull path route this gather through the VMEM kernel?
+
+    Env override ``SMTPU_PALLAS_GATHER``: ``1/on`` forces it whenever the
+    table fits, ``0/off`` disables.  Default (``auto``): single TPU
+    device only, and only when a recorded on-chip A/B verdict
+    (scripts/gather_micro.py -> ops/calibration.py) for this device kind
+    says the kernel actually wins — absent evidence, XLA's gather stays
+    (a cold environment can never get slower)."""
+    return calibration.gated("vmem_gather", "SMTPU_PALLAS_GATHER",
+                             fits_vmem(table))
+
+
+def masked_vmem_gather(table: jax.Array, slots: jax.Array,
+                       valid: jax.Array) -> jax.Array:
+    """Drop-in for the pull path's masked ``jnp.take``: pads ``slots`` to
+    an index-block multiple, gathers from the VMEM-resident table, and
+    zeroes invalid rows — identical semantics to
+    ``transfer.xla._masked_gather`` (clip keeps padding defined)."""
+    n = slots.shape[0]
+    safe = jnp.where(valid, slots, 0)
+    pad = (-n) % _DEF_IDX_BLOCK
+    if pad:
+        safe = jnp.concatenate(
+            [safe, jnp.zeros((pad,), slots.dtype)])
+    rows = vmem_gather(table, safe)[:n]
+    return jnp.where(valid[:, None], rows, 0)

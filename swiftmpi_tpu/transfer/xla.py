@@ -24,11 +24,17 @@ from __future__ import annotations
 import jax
 import jax.numpy as jnp
 
+from swiftmpi_tpu.ops import pallas_gather, pallas_scatter
 from swiftmpi_tpu.transfer.api import Transfer
 
 
 def _masked_gather(arr: jax.Array, slots: jax.Array,
                    valid: jax.Array) -> jax.Array:
+    # VMEM-resident Pallas gather when the on-chip A/B verdict says it
+    # beats XLA's transaction-bound HBM gather (ops/pallas_gather.py;
+    # absent a recorded win this branch never taken)
+    if pallas_gather.use_vmem_gather(arr):
+        return pallas_gather.masked_vmem_gather(arr, slots, valid)
     # clip: an out-of-range slot is a caller bug, but TPU OOB gather yields
     # garbage/NaN rather than trapping — clamp so it stays observable as a
     # wrong row, not as NaN contamination.
@@ -87,6 +93,16 @@ class XlaTransfer(Transfer):
                 counts = jnp.zeros((capacity,), jnp.float32).at[safe].add(
                     1.0, mode="drop")
                 inv = (1.0 / jnp.maximum(counts, 1.0))[:, None]
+        def _scatter(g, width):
+            # VMEM-resident Pallas scatter when the on-chip A/B verdict
+            # says it beats XLA's (ops/pallas_scatter.py; never taken
+            # without a recorded win)
+            if pallas_scatter.use_vmem_scatter(capacity, width):
+                return pallas_scatter.masked_vmem_scatter_add(
+                    slots, valid, g, capacity)
+            acc = jnp.zeros((capacity, width), g.dtype)
+            return acc.at[safe].add(g, mode="drop")
+
         dense_grads = {}
         for f in grads:
             g = jnp.asarray(grads[f])
@@ -94,13 +110,11 @@ class XlaTransfer(Transfer):
             if fuse_count:
                 g1 = jnp.concatenate(
                     [g, jnp.ones((g.shape[0], 1), g.dtype)], axis=1)
-                acc = jnp.zeros((capacity, width + 1), g.dtype)
-                acc = acc.at[safe].add(g1, mode="drop")
+                acc = _scatter(g1, width + 1)
                 dense_grads[f] = acc[:, :width] / jnp.maximum(
                     acc[:, width:], 1.0)
             else:
-                acc = jnp.zeros((capacity, width), g.dtype)
-                acc = acc.at[safe].add(g, mode="drop")
+                acc = _scatter(g, width)
                 dense_grads[f] = acc * inv if mean else acc
         new_fields = access.apply_push(state, dense_grads)
         out = dict(state)

@@ -105,3 +105,177 @@ def test_vmem_gather_matches_take(devices8):
     assert not fits_vmem(jnp.zeros((1 << 20, 100), jnp.float32))
     with pytest.raises(ValueError):
         vmem_gather(table, idx[:1000], idx_block=1024)
+
+
+def test_masked_vmem_gather_matches_masked_take(devices8):
+    """masked_vmem_gather == the xla backend's masked gather semantics,
+    including non-block-multiple lengths (padding) and invalid slots."""
+    from swiftmpi_tpu.ops.pallas_gather import masked_vmem_gather
+    from swiftmpi_tpu.transfer.xla import _masked_gather
+
+    rng = np.random.default_rng(9)
+    table = jnp.asarray(rng.standard_normal((513, 20)), jnp.float32)
+    slots = jnp.asarray(rng.integers(-1, 513, 1000), jnp.int32)
+    valid = slots >= 0
+    got = masked_vmem_gather(table, slots, valid)
+    want = _masked_gather(table, slots, valid)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want))
+
+
+def test_use_vmem_gather_gate(monkeypatch, tmp_path):
+    """The measurement-driven gate: off by default without a recorded
+    chip win; env force-on/off overrides; oversized tables never route."""
+    from swiftmpi_tpu.ops import calibration
+    from swiftmpi_tpu.ops.pallas_gather import use_vmem_gather
+
+    monkeypatch.setenv("SMTPU_CALIBRATION",
+                       str(tmp_path / "calib.json"))
+    calibration.reset_cache()
+    small = jnp.zeros((1000, 50), jnp.float32)
+    huge = jnp.zeros((1 << 20, 100), jnp.float32)
+
+    monkeypatch.delenv("SMTPU_PALLAS_GATHER", raising=False)
+    assert not use_vmem_gather(small)      # cpu backend, no verdict
+    monkeypatch.setenv("SMTPU_PALLAS_GATHER", "1")
+    assert use_vmem_gather(small)          # forced on (fits)
+    assert not use_vmem_gather(huge)       # forced on but doesn't fit
+    monkeypatch.setenv("SMTPU_PALLAS_GATHER", "0")
+    assert not use_vmem_gather(small)      # forced off
+
+    # recorded win flips auto mode on a single tpu device (simulated):
+    # verdicts are keyed by device KIND so one generation's win never
+    # gates another's kernel
+    monkeypatch.delenv("SMTPU_PALLAS_GATHER", raising=False)
+    import jax as _jax
+    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(_jax, "device_count", lambda: 1)
+    monkeypatch.setattr(calibration, "device_key", lambda: "TPU v5 lite")
+    calibration.record("vmem_gather", "TPU v5 lite",
+                       {"win": True, "pallas_ms": 1.0, "xla_ms": 5.0})
+    assert use_vmem_gather(small)
+    # a different device kind has no verdict -> stays off
+    monkeypatch.setattr(calibration, "device_key", lambda: "TPU v4")
+    assert not use_vmem_gather(small)
+    # multi-device (sharded-operand hazard) -> auto mode stays off
+    monkeypatch.setattr(calibration, "device_key", lambda: "TPU v5 lite")
+    monkeypatch.setattr(_jax, "device_count", lambda: 8)
+    assert not use_vmem_gather(small)
+    monkeypatch.setattr(_jax, "device_count", lambda: 1)
+    calibration.record("vmem_gather", "TPU v5 lite", {"win": False})
+    assert not use_vmem_gather(small)
+    calibration.reset_cache()
+
+
+def test_w2v_step_with_pallas_pull_matches_xla(monkeypatch, devices8):
+    """End-to-end: the parity-mode w2v step with the VMEM gather forced
+    on (interpret mode on CPU) produces the same loss as the XLA gather
+    path — the wiring in transfer/xla.py preserves semantics exactly."""
+    import jax
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.data.text import CBOWBatcher, synthetic_corpus
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+
+    def run(force):
+        if force:
+            monkeypatch.setenv("SMTPU_PALLAS_GATHER", "1")
+        else:
+            monkeypatch.setenv("SMTPU_PALLAS_GATHER", "0")
+        cfg = ConfigParser().update({
+            "cluster": {"transfer": "xla", "server_num": 1},
+            "word2vec": {"len_vec": 16, "window": 3, "negative": 4,
+                         "sample": -1, "learning_rate": 0.05},
+            "server": {"initial_learning_rate": 0.7, "frag_num": 100},
+            "worker": {"minibatch": 50},
+        })
+        m = Word2Vec(config=cfg, cluster=Cluster(cfg).initialize())
+        corpus = synthetic_corpus(20, 200, 40, seed=13)
+        m.build(corpus)
+        step = jax.jit(m._build_step())
+        batcher = CBOWBatcher(corpus, m.vocab, m.window, m.sample, seed=5)
+        b = next(iter(batcher.epoch(128)))
+        state = dict(m.table.state)
+        state, es, ec = step(
+            state, m._slot_of_vocab, m._alias_prob, m._alias_idx,
+            jnp.asarray(b.centers), jnp.asarray(b.contexts),
+            jnp.asarray(b.ctx_mask), jax.random.key(0))
+        return float(es), {f: np.asarray(v) for f, v in state.items()}
+
+    es0, st0 = run(False)
+    es1, st1 = run(True)
+    assert es0 == pytest.approx(es1, rel=1e-6)
+    for f in st0:
+        np.testing.assert_allclose(st1[f], st0[f], rtol=1e-6)
+
+
+def test_vmem_scatter_add_matches_xla(devices8):
+    """ops/pallas_scatter.py: VMEM-resident scatter-add == .at[].add
+    with drop semantics (interpret mode; chip A/B in scatter_micro)."""
+    from swiftmpi_tpu.ops.pallas_scatter import (fits_vmem,
+                                                 vmem_scatter_add)
+
+    rng = np.random.default_rng(5)
+    cap, W, n = 97, 8, 512
+    idx = jnp.asarray(rng.integers(0, cap + 1, n), jnp.int32)  # incl dump
+    g = jnp.asarray(rng.standard_normal((n, W)), jnp.float32)
+    got = vmem_scatter_add(idx, g, cap, idx_block=128)
+    want = jnp.zeros((cap + 1, W), jnp.float32).at[idx].add(g)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+    assert fits_vmem(17_314, 101)
+    assert not fits_vmem(1 << 20, 101)
+
+
+def test_masked_vmem_scatter_matches_push_semantics(devices8):
+    """masked wrapper: invalid slots dropped, non-block-multiple length
+    padded, result shape (capacity, W)."""
+    from swiftmpi_tpu.ops.pallas_scatter import masked_vmem_scatter_add
+
+    rng = np.random.default_rng(6)
+    cap, W, n = 61, 4, 300        # 300 pads up to 4096
+    slots = jnp.asarray(rng.integers(-1, cap, n), jnp.int32)
+    valid = slots >= 0
+    g = jnp.asarray(rng.standard_normal((n, W)), jnp.float32)
+    got = masked_vmem_scatter_add(slots, valid, g, cap)
+    safe = jnp.where(valid, slots, cap)
+    want = jnp.zeros((cap, W), jnp.float32).at[safe].add(g, mode="drop")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_w2v_step_with_pallas_scatter_matches_xla(monkeypatch, devices8):
+    """End-to-end: parity-mode step with the VMEM scatter forced on
+    (interpret) == the XLA scatter path."""
+    import jax
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.data.text import CBOWBatcher, synthetic_corpus
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+
+    def run(force):
+        monkeypatch.setenv("SMTPU_PALLAS_SCATTER", "1" if force else "0")
+        cfg = ConfigParser().update({
+            "cluster": {"transfer": "xla", "server_num": 1},
+            "word2vec": {"len_vec": 16, "window": 3, "negative": 4,
+                         "sample": -1, "learning_rate": 0.05},
+            "server": {"initial_learning_rate": 0.7, "frag_num": 100},
+            "worker": {"minibatch": 50},
+        })
+        m = Word2Vec(config=cfg, cluster=Cluster(cfg).initialize())
+        corpus = synthetic_corpus(10, 100, 30, seed=17)
+        m.build(corpus)
+        step = jax.jit(m._build_step())
+        batcher = CBOWBatcher(corpus, m.vocab, m.window, m.sample, seed=5)
+        b = next(iter(batcher.epoch(64)))
+        state = dict(m.table.state)
+        state, es, ec = step(
+            state, m._slot_of_vocab, m._alias_prob, m._alias_idx,
+            jnp.asarray(b.centers), jnp.asarray(b.contexts),
+            jnp.asarray(b.ctx_mask), jax.random.key(0))
+        return float(es), {f: np.asarray(v) for f, v in state.items()}
+
+    es0, st0 = run(False)
+    es1, st1 = run(True)
+    assert es0 == pytest.approx(es1, rel=1e-5)
+    for f in st0:
+        np.testing.assert_allclose(st1[f], st0[f], rtol=1e-5, atol=1e-6)
