@@ -221,19 +221,31 @@ def _bench_lr(device, timed_calls):
         state = {f: jax.device_put(v, device)
                  for f, v in model.table.state.items()}
 
-        def epoch(state):
-            state, losses, ns = multi(state, *stacked)
-            return state, losses[-1]
+        E = int(os.environ.get("BENCH_LR_EPOCHS", "8"))
 
-        state, loss = epoch(state)                    # warmup/compile
+        @jax.jit
+        def epochs_fn(state):
+            # E epochs in ONE dispatch: through the tunnel a dispatch
+            # costs ~5ms, which at a9a scale caps rows/s below the CPU
+            # baseline no matter how fast the chip step is (round-2
+            # live-window: 0.06x with per-batch dispatches); scanning
+            # epochs inside the program amortizes it over E*32K rows
+            def ebody(st, _):
+                st, losses, ns = multi(st, *stacked)
+                return st, losses[-1]
+            st, lasts = jax.lax.scan(ebody, state, None, length=E)
+            return st, lasts[-1]
+
+        state, loss = epochs_fn(state)                # warmup/compile
         _fence(state, loss)
         t0 = time.perf_counter()
         for _ in range(timed_calls):
-            state, loss = epoch(state)
+            state, loss = epochs_fn(state)
         _fence(state, loss)
         dt = time.perf_counter() - t0
-    rows = len(prepared) * LR_BATCH * timed_calls
-    return {"rows_per_sec": rows / dt, "loss": float(loss)}
+    rows = len(prepared) * LR_BATCH * E * timed_calls
+    return {"rows_per_sec": rows / dt, "loss": float(loss),
+            "epochs_per_dispatch": E}
 
 
 def _bench_s2v(device, timed_calls, model):

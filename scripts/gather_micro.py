@@ -110,7 +110,6 @@ def pallas_ab():
     tf32 = jnp.asarray(rng.standard_normal((cap, 100)), jnp.float32)
     N = 344_064
     idx3 = jnp.asarray(rng.integers(0, cap, N), jnp.int32)
-    platform = jax.devices()[0].platform
     print(f"A/B device: {jax.devices()[0]}", flush=True)
 
     xla_take = jax.jit(lambda t, i: jnp.take(t, i, axis=0).sum())
@@ -132,22 +131,13 @@ def pallas_ab():
         print(f"pallas vmem gather (fp32, cap={cap}): {pallas_ms:7.2f} ms"
               f"  {gb / pallas_ms * 1e3:6.1f} GB/s  correct={correct}",
               flush=True)
-        verdict = {"win": bool(correct and pallas_ms < 0.9 * xla_ms),
-                   "correct": correct,
-                   "pallas_ms": round(pallas_ms, 3),
-                   "xla_ms": round(xla_ms, 3),
-                   "shape": f"cap={cap} d=100 fp32 N={N}"}
+        calibration.ab_verdict("vmem_gather", xla_ms, pallas_ms, correct,
+                               shape=f"cap={cap} d=100 fp32 N={N}")
     except Exception as e:       # Mosaic may reject dynamic gather
         print(f"pallas vmem gather: UNSUPPORTED ({type(e).__name__}: "
               f"{str(e)[:200]})", flush=True)
-        verdict = {"win": False,
-                   "error": f"{type(e).__name__}: {str(e)[:200]}",
-                   "xla_ms": round(xla_ms, 3)}
-    if platform == "tpu":        # only chip verdicts gate the chip path
-        key = calibration.device_key()
-        calibration.record("vmem_gather", key, verdict)
-        print(f"calibration recorded: vmem_gather:{key} -> {verdict}",
-              flush=True)
+        calibration.ab_verdict("vmem_gather", xla_ms,
+                               error=f"{type(e).__name__}: {str(e)[:200]}")
 
 
 if __name__ == "__main__":

@@ -101,5 +101,61 @@ def main():
         print(f"hogwild vs sync final gap: {hw_rel:+.2%}", flush=True)
 
 
+def staleness_curve():
+    """Loss-vs-staleness curve to convergence (round-2 verdict Next #6):
+    {sync, stale4, stale16, hogwild} on the same corpus and batch
+    granularity, enough epochs for the async arms to close.  Writes
+    ``.bench_cache/staleness_curve.json`` and prints the table."""
+    import json
+
+    from swiftmpi_tpu.data.text import synthetic_corpus
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+
+    sents = [list(map(int, np.asarray(s)))
+             for s in synthetic_corpus(N_SENT, VOCAB, SENT_LEN, seed=17)]
+    n_tokens = sum(len(s) for s in sents)
+    print(f"curve corpus: {n_tokens} tokens, vocab<={VOCAB}, "
+          f"{NITERS} epochs", flush=True)
+    variants = [("sync", {}),
+                ("stale4", {"local_steps": 4}),
+                ("stale16", {"local_steps": 16}),
+                ("hogwild", {"async_mode": "hogwild", "local_steps": 2})]
+    results = {}
+    for name, ov in variants:
+        m = Word2Vec(config=ConfigParser().update({
+            "cluster": {"server_num": 1, "transfer": "xla"},
+            "word2vec": {"len_vec": 32, "window": 3, "negative": 5,
+                         "sample": -1, "learning_rate": 0.05, **ov},
+            "server": {"initial_learning_rate": 0.3, "frag_num": 200},
+            "worker": {"minibatch": 5000},
+        }))
+        m.build(sents)
+        t0 = time.perf_counter()
+        losses = m.train(sents, niters=NITERS, batch_size=1024)
+        dt = time.perf_counter() - t0
+        results[name] = [round(float(x), 4) for x in losses]
+        print(f"{name:8s} ({dt:6.1f}s): "
+              + " ".join(f"{x:.4f}" for x in losses), flush=True)
+    sync_final = results["sync"][-1]
+    summary = {name: {"losses": ls, "final": ls[-1],
+                      "vs_sync_final": round(
+                          (ls[-1] - sync_final) / sync_final, 4)}
+               for name, ls in results.items()}
+    out = {"tokens": n_tokens, "epochs": NITERS, "curve": summary}
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    path = os.path.join(repo, ".bench_cache", "staleness_curve.json")
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with open(path, "w") as f:
+        json.dump(out, f, indent=1)
+    print(f"wrote {path}", flush=True)
+    for name, rec in summary.items():
+        print(f"{name:8s} final {rec['final']:.4f} "
+              f"({rec['vs_sync_final']:+.2%} vs sync)", flush=True)
+
+
 if __name__ == "__main__":
-    main()
+    if os.environ.get("SOAK_CURVE"):
+        staleness_curve()
+    else:
+        main()

@@ -59,19 +59,10 @@ if fits_vmem(capw, d + 1):
         p_ms = timeit(pscat, gi, g1)
         print(f"pallas vmem scatter (x101 -> 17314+1)  : {p_ms:7.2f} ms"
               f"  correct={correct}", flush=True)
-        verdict = {"win": bool(correct and p_ms < 0.9 * xla_ms),
-                   "correct": correct,
-                   "pallas_ms": round(p_ms, 3),
-                   "xla_ms": round(xla_ms, 3),
-                   "shape": f"cap={capw} w={d+1} fp32 N={Nw}"}
+        calibration.ab_verdict("vmem_scatter", xla_ms, p_ms, correct,
+                               shape=f"cap={capw} w={d+1} fp32 N={Nw}")
     except Exception as e:
         print(f"pallas vmem scatter: UNSUPPORTED ({type(e).__name__}: "
               f"{str(e)[:200]})", flush=True)
-        verdict = {"win": False,
-                   "error": f"{type(e).__name__}: {str(e)[:200]}",
-                   "xla_ms": round(xla_ms, 3)}
-    if jax.devices()[0].platform == "tpu":
-        key = calibration.device_key()
-        calibration.record("vmem_scatter", key, verdict)
-        print(f"calibration recorded: vmem_scatter:{key} -> {verdict}",
-              flush=True)
+        calibration.ab_verdict("vmem_scatter", xla_ms,
+                               error=f"{type(e).__name__}: {str(e)[:200]}")

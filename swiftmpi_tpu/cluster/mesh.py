@@ -126,9 +126,11 @@ def ps_mesh(n: Optional[int] = None,
     multiple processes: 2-D ``(data, shard)`` — the shard axis (which
     carries the all_to_all request/response routing every step) stays
     WITHIN each process so it rides ICI; each process group holds a full
-    table replica and only the push's dense gradient psum crosses DCN
-    (the reference's multi-node deployment instead sent every pull/push
-    over TCP, cluster.h:63-110)."""
+    table replica and only the push's reconciliation crosses DCN —
+    batch-proportional (slot, grad) pair gathers in the sparse regime,
+    one dense grad psum when the batch approaches table scale (see
+    transfer/tpu.py) — where the reference's multi-node deployment sent
+    every pull/push over TCP (cluster.h:63-110)."""
     devices = list(jax.devices() if devices is None else devices)
     if n is not None:
         devices = devices[:n]

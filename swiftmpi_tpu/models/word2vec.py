@@ -299,11 +299,20 @@ class Word2Vec:
         Trades the row-sharded layout for replication during the async
         phase (a vocab-scale table fits one device by orders of
         magnitude); the ``data``/``model`` sharded layout is the sync
-        path's concern.  Memory note: the reconciliation materializes
-        every worker's push sequence on every device —
-        ``n_workers x local_steps x push_rows x d`` floats (e.g. 2.2GB
-        at a 16K-batch, 8-worker, 2-step configuration) — so very large
-        batch x local_steps combinations should prefer the snapshot
+        path's concern.  Memory note: reconciliation rings the STATE
+        through the workers (each applies its own, locally-held pushes
+        to the passing chain), so peak extra memory is one table-state
+        copy (O(capacity x d), ~27MB at demo.conf scale) on top of the
+        worker's own push sequence (O(local_steps x push_rows x d),
+        which the gradient scan holds anyway) — no n_workers-scaled
+        materialization.  Time note: the apply is inherently SEQUENTIAL
+        over all ``n_workers x local_steps`` pushes (that is its
+        semantics — each AdaGrad apply must see the accumulators the
+        previous pushes grew), and every device runs the full chain
+        redundantly (each computing a different rotation, only the
+        worker-major one kept); reconciliation wall-time therefore grows
+        linearly with worker count, so large fleets amortize it with
+        bigger ``local_steps`` or prefer the snapshot
         (``local_steps``-only) async mode."""
         if getattr(self.transfer, "name", "") == "tpu":
             raise ValueError(
@@ -353,16 +362,35 @@ class Word2Vec:
             # shared base one push at a time (worker-major) so each
             # AdaGrad application sees the accumulators the previous
             # pushes grew — the reference server's arrival-order apply.
-            gathered = jax.tree_util.tree_map(
-                lambda x: jax.lax.all_gather(x, "worker"), pushes_l)
+            # RING THE STATE, NOT THE PUSHES (round-2 all_gathered every
+            # sequence to every device: 2.2GB at 16K-batch/8-worker/
+            # 2-step): each device applies its OWN pushes to the chain
+            # state passing through, so push data never crosses the
+            # ring and per-round traffic is one table state (~27MB at
+            # demo.conf scale).  After round 0 (own apply) + n-1
+            # shift+apply rounds, the device with the highest id holds
+            # exactly A_{n-1}(...A_1(A_0(base))) — the worker-major
+            # linearization — and one masked psum broadcasts it.
+            shift = [(i, (i + 1) % n_workers)
+                     for i in range(n_workers)]
 
-            def apply_worker(st, w_pushes):
+            def apply_own(st):
                 def apply_step(st, s_pushes):
                     return apply_fn(st, s_pushes), None
-                st, _ = jax.lax.scan(apply_step, st, w_pushes)
-                return st, None
+                st, _ = jax.lax.scan(apply_step, st, pushes_l)
+                return st
 
-            new_state, _ = jax.lax.scan(apply_worker, state, gathered)
+            chain = apply_own(state)
+            for _ in range(n_workers - 1):
+                chain = jax.tree_util.tree_map(
+                    lambda x: jax.lax.ppermute(x, "worker", shift),
+                    chain)
+                chain = apply_own(chain)
+            is_last = wid == n_workers - 1
+            new_state = jax.tree_util.tree_map(
+                lambda x: jax.lax.psum(
+                    jnp.where(is_last, x, jnp.zeros_like(x)), "worker"),
+                chain)
             return new_state, jax.lax.psum(es.sum(), "worker"), \
                 jax.lax.psum(ec.sum(), "worker")
 

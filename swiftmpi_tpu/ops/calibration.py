@@ -13,11 +13,12 @@ time:
                                   "xla_ms": ..})
     lookup("vmem_gather", "tpu")  -> dict | None
 
-Verdicts live in ``.bench_cache/calibration.json`` at the repo root
-(committed, so a fresh checkout on the same hardware class inherits
-them) — the same evidence directory bench.py uses for chip results.
-Absent verdict = conservative default (XLA path), so nothing here can
-make a cold environment slower.
+Verdicts live in ``.bench_cache/calibration.json`` at the repo root —
+the same evidence directory bench.py uses for chip results; the session
+workflow commits it with the round's measurement evidence so a checkout
+on the same hardware class inherits the verdicts.  Absent the file,
+every gate defaults to the XLA path, so a cold environment can never
+get slower.
 
 The reference has no analogue (its hot loop is fixed C++); this is the
 TPU-first replacement for hand-tuning.
@@ -91,6 +92,33 @@ def device_key() -> str:
     import jax
 
     return jax.devices()[0].device_kind
+
+
+def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
+               correct: bool = None, shape: str = None,
+               error: str = None) -> dict:
+    """Build the standard A/B verdict (shared by the gather and scatter
+    microbench harnesses) and record it when running on a real chip:
+    a win requires the kernel to be CORRECT on-device and >=10% faster
+    than the XLA path; any lowering failure is a loud non-win."""
+    if error is not None:
+        verdict = {"win": False, "error": error,
+                   "xla_ms": round(xla_ms, 3)}
+    else:
+        verdict = {"win": bool(correct and pallas_ms < 0.9 * xla_ms),
+                   "correct": bool(correct),
+                   "pallas_ms": round(pallas_ms, 3),
+                   "xla_ms": round(xla_ms, 3)}
+        if shape:
+            verdict["shape"] = shape
+    import jax
+
+    if jax.devices()[0].platform == "tpu":
+        key = device_key()
+        record(name, key, verdict)
+        print(f"calibration recorded: {name}:{key} -> {verdict}",
+              flush=True)
+    return verdict
 
 
 def gated(name: str, env_var: str, fits: bool) -> bool:

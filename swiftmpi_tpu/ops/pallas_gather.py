@@ -27,7 +27,6 @@ LocalParamCache in L2.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp

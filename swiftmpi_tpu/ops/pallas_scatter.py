@@ -24,7 +24,6 @@ mutates in place.
 from __future__ import annotations
 
 import functools
-import os
 
 import jax
 import jax.numpy as jnp
