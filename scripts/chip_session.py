@@ -87,6 +87,9 @@ def main():
                             "--single-device", "--reps", "3"], 1800, None),
         ("bench_scale", [py, "bench.py", "--child", "tpu"], 600,
          {"BENCH_SCALE": "1"}),
+        # text8-scale end-to-end epoch (BASELINE config #2 corpus shape)
+        ("bench_text8", [py, "bench.py", "--child", "tpu"], 900,
+         {"BENCH_TEXT8": "1"}),
         ("bench_tfm", [py, "bench.py", "--child", "tpu"], 600,
          {"BENCH_TFM": "1"}),
     ]

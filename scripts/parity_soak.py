@@ -32,14 +32,34 @@ VOCAB = int(os.environ.get("SOAK_VOCAB", 2000))
 NITERS = int(os.environ.get("SOAK_ITERS", 4))
 
 
-def main():
+def _corpus():
+    """The soak corpus — shared by the parity run and the staleness
+    curve so 'same corpus' stays true by construction."""
     from swiftmpi_tpu.data.text import synthetic_corpus
-    from swiftmpi_tpu.models.word2vec import Word2Vec
-    from swiftmpi_tpu.testing import W2VOracle
+
+    return [list(map(int, np.asarray(s)))
+            for s in synthetic_corpus(N_SENT, VOCAB, SENT_LEN, seed=17)]
+
+
+def _w2v_config(**overrides):
+    """The soak model hyperparameters (one source of truth)."""
     from swiftmpi_tpu.utils import ConfigParser
 
-    sents = [list(map(int, np.asarray(s)))
-             for s in synthetic_corpus(N_SENT, VOCAB, SENT_LEN, seed=17)]
+    return ConfigParser().update({
+        "cluster": {"server_num": overrides.pop("server_num", 1),
+                    "transfer": "xla"},
+        "word2vec": {"len_vec": 32, "window": 3, "negative": 5,
+                     "sample": -1, "learning_rate": 0.05, **overrides},
+        "server": {"initial_learning_rate": 0.3, "frag_num": 200},
+        "worker": {"minibatch": 5000},
+    })
+
+
+def main():
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.testing import W2VOracle
+
+    sents = _corpus()
     n_tokens = sum(len(s) for s in sents)
     print(f"corpus: {N_SENT} sentences, {n_tokens} tokens, "
           f"vocab<={VOCAB}, {NITERS} epochs", flush=True)
@@ -51,14 +71,7 @@ def main():
     ref_losses = oracle.train(sents, niters=NITERS)
     t_oracle = time.perf_counter() - t0
 
-    cfg = ConfigParser().update({
-        "cluster": {"server_num": 2, "transfer": "xla"},
-        "word2vec": {"len_vec": 32, "window": 3, "negative": 5,
-                     "sample": -1, "learning_rate": 0.05},
-        "server": {"initial_learning_rate": 0.3, "frag_num": 200},
-        "worker": {"minibatch": 5000},
-    })
-    model = Word2Vec(config=cfg)
+    model = Word2Vec(config=_w2v_config(server_num=2))
     model.build(sents)
     t0 = time.perf_counter()
     # 25 lines x ~SENT_LEN tokens per oracle batch: match granularity
@@ -81,14 +94,8 @@ def main():
         # hogwild (genuinely unsynchronized per-device replicas) vs the
         # sync run above: the reference's async variant trades staleness
         # for throughput and is expected to land near the same loss
-        hw = Word2Vec(config=ConfigParser().update({
-            "cluster": {"server_num": 1, "transfer": "xla"},
-            "word2vec": {"len_vec": 32, "window": 3, "negative": 5,
-                         "sample": -1, "learning_rate": 0.05,
-                         "async_mode": "hogwild", "local_steps": 2},
-            "server": {"initial_learning_rate": 0.3, "frag_num": 200},
-            "worker": {"minibatch": 5000},
-        }))
+        hw = Word2Vec(config=_w2v_config(async_mode="hogwild",
+                                         local_steps=2))
         hw.build(sents)
         t0 = time.perf_counter()
         # group = 8 workers x local_steps full batches: a smaller batch
@@ -108,12 +115,9 @@ def staleness_curve():
     ``.bench_cache/staleness_curve.json`` and prints the table."""
     import json
 
-    from swiftmpi_tpu.data.text import synthetic_corpus
     from swiftmpi_tpu.models.word2vec import Word2Vec
-    from swiftmpi_tpu.utils import ConfigParser
 
-    sents = [list(map(int, np.asarray(s)))
-             for s in synthetic_corpus(N_SENT, VOCAB, SENT_LEN, seed=17)]
+    sents = _corpus()
     n_tokens = sum(len(s) for s in sents)
     print(f"curve corpus: {n_tokens} tokens, vocab<={VOCAB}, "
           f"{NITERS} epochs", flush=True)
@@ -123,13 +127,7 @@ def staleness_curve():
                 ("hogwild", {"async_mode": "hogwild", "local_steps": 2})]
     results = {}
     for name, ov in variants:
-        m = Word2Vec(config=ConfigParser().update({
-            "cluster": {"server_num": 1, "transfer": "xla"},
-            "word2vec": {"len_vec": 32, "window": 3, "negative": 5,
-                         "sample": -1, "learning_rate": 0.05, **ov},
-            "server": {"initial_learning_rate": 0.3, "frag_num": 200},
-            "worker": {"minibatch": 5000},
-        }))
+        m = Word2Vec(config=_w2v_config(**ov))
         m.build(sents)
         t0 = time.perf_counter()
         losses = m.train(sents, niters=NITERS, batch_size=1024)
