@@ -322,36 +322,55 @@ def _bench_w2v_1m(device, timed_calls):
             "vocab": V, "capacity": model.table.capacity}
 
 
+def _native_corpus(corpus, max_sentence_length):
+    """Write a token corpus to a temp file and load it back through the
+    native C++ loader (shared by the epoch-wall benches).  Returns
+    (vocab, tokens, offsets); the temp file is already unlinked."""
+    import tempfile
+
+    import numpy as np
+    from swiftmpi_tpu.data import native
+
+    if not native.available():
+        raise RuntimeError("native loader unavailable")
+    with tempfile.NamedTemporaryFile("w", suffix=".txt",
+                                     delete=False) as f:
+        for s in corpus:
+            # tolist + map(str): several-fold cheaper than per-token
+            # str(int(x)) at text8 scale
+            f.write(" ".join(map(str, np.asarray(s).tolist())) + "\n")
+        path = f.name
+    try:
+        return native.load_corpus_native(
+            path, max_sentence_length=max_sentence_length)
+    finally:
+        os.unlink(path)
+
+
+def _timed_epoch(model, vocab, tokens, offsets):
+    """Warm + timed epoch through the PUBLIC train() path with the
+    native prefetching batcher.  Returns (wall_s, losses)."""
+    from swiftmpi_tpu.data import native
+
+    batcher = native.PrefetchingCBOWBatcher(
+        tokens, offsets, vocab, model.window, model.sample, seed=7)
+    model.train(batcher=batcher, niters=1, batch_size=BATCH)   # warm
+    t0 = time.perf_counter()
+    losses = model.train(batcher=batcher, niters=1, batch_size=BATCH)
+    return time.perf_counter() - t0, losses
+
+
 def _bench_w2v_epoch(device, model):
     """END-TO-END epoch wall-clock through the PUBLIC train() path —
     the north star's literal metric (BASELINE.json: epoch wall-clock,
     not steady-state step rate).  Includes vocab-indexed batching via
     the native C++ prefetching batcher, H2D transfer, dispatch, and the
     epoch-end loss fetch.  Reuses the already-built model/table."""
-    import tempfile
-
-    import numpy as np
-    from swiftmpi_tpu.data import native
     from swiftmpi_tpu.data.text import synthetic_corpus
 
-    if not native.available():
-        raise RuntimeError("native loader unavailable")
     corpus = synthetic_corpus(SENTENCES, VOCAB, SENT_LEN, seed=11)
-    with tempfile.NamedTemporaryFile("w", suffix=".txt",
-                                     delete=False) as f:
-        for s in corpus:
-            f.write(" ".join(str(int(x)) for x in np.asarray(s)) + "\n")
-        path = f.name
-    try:
-        vocab, tokens, offsets = native.load_corpus_native(path)
-        batcher = native.PrefetchingCBOWBatcher(
-            tokens, offsets, vocab, model.window, model.sample, seed=7)
-        model.train(batcher=batcher, niters=1, batch_size=BATCH)  # warm
-        t0 = time.perf_counter()
-        model.train(batcher=batcher, niters=1, batch_size=BATCH)
-        dt = time.perf_counter() - t0
-    finally:
-        os.unlink(path)
+    vocab, tokens, offsets = _native_corpus(corpus, SENT_LEN)
+    dt, _ = _timed_epoch(model, vocab, tokens, offsets)
     n_tokens = int(len(tokens))
     # corpus tokens != the primary metric's post-subsampling center
     # count — named distinctly so the two rates are never conflated
@@ -369,54 +388,30 @@ def _bench_w2v_text8(device):
     steady-state corpus: host batching, subsampling, H2D, and dispatch
     all at full corpus size.  Opt-in (BENCH_TEXT8=1): a CPU epoch at
     this scale would blow the default bench budget."""
-    import tempfile
-
-    import numpy as np
-    from swiftmpi_tpu.data import native
+    import jax
+    from swiftmpi_tpu.cluster.cluster import Cluster
     from swiftmpi_tpu.data.text import synthetic_corpus
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
 
-    if not native.available():
-        raise RuntimeError("native loader unavailable")
     # text8 shape by default; env overrides keep smoke tests cheap
     V8 = int(os.environ.get("BENCH_TEXT8_VOCAB", 70_000))
     S8 = int(os.environ.get("BENCH_TEXT8_SENTS", 17_000))
     L8 = int(os.environ.get("BENCH_TEXT8_LEN", 1_000))   # ~17M tokens
     corpus = synthetic_corpus(S8, V8, L8, seed=42)
-    with tempfile.NamedTemporaryFile("w", suffix=".txt",
-                                     delete=False) as f:
-        for s in corpus:
-            # tolist + map(str): several-fold cheaper than per-token
-            # str(int(x)) at 17M tokens — this is setup, not bench time,
-            # but it shares the stage's wall-clock budget
-            f.write(" ".join(map(str, np.asarray(s).tolist())) + "\n")
-        path = f.name
-    try:
-        import jax
-        from swiftmpi_tpu.models.word2vec import Word2Vec
-        from swiftmpi_tpu.cluster.cluster import Cluster
-        from swiftmpi_tpu.utils import ConfigParser
-
-        cfg = ConfigParser().update({
-            "cluster": {"transfer": "xla", "server_num": 1},
-            "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
-                         "sample": 1e-5, "learning_rate": 0.05},
-            "server": {"initial_learning_rate": 0.7, "frag_num": 1000},
-            "worker": {"minibatch": 5000, "inner_steps": INNER_STEPS},
-        })
-        with jax.default_device(device):
-            m = Word2Vec(config=cfg,
-                         cluster=Cluster(cfg, devices=[device])
-                         .initialize())
-            vocab, tokens, offsets = native.load_corpus_native(path)
-            m.build_from_vocab(vocab)
-            batcher = native.PrefetchingCBOWBatcher(
-                tokens, offsets, vocab, m.window, m.sample, seed=7)
-            m.train(batcher=batcher, niters=1, batch_size=BATCH)  # warm
-            t0 = time.perf_counter()
-            losses = m.train(batcher=batcher, niters=1, batch_size=BATCH)
-            dt = time.perf_counter() - t0
-    finally:
-        os.unlink(path)
+    vocab, tokens, offsets = _native_corpus(corpus, L8)
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
+                     "sample": 1e-5, "learning_rate": 0.05},
+        "server": {"initial_learning_rate": 0.7, "frag_num": 1000},
+        "worker": {"minibatch": 5000, "inner_steps": INNER_STEPS},
+    })
+    with jax.default_device(device):
+        m = Word2Vec(config=cfg,
+                     cluster=Cluster(cfg, devices=[device]).initialize())
+        m.build_from_vocab(vocab)
+        dt, losses = _timed_epoch(m, vocab, tokens, offsets)
     n_tokens = int(len(tokens))
     return {"epoch_wall_s": dt,
             "corpus_tokens_per_sec": n_tokens / dt,

@@ -96,11 +96,13 @@ def device_key() -> str:
 
 def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
                correct: bool = None, shape: str = None,
-               error: str = None) -> dict:
+               error: str = None, extra: dict = None) -> dict:
     """Build the standard A/B verdict (shared by the gather and scatter
     microbench harnesses) and record it when running on a real chip:
     a win requires the kernel to be CORRECT on-device and >=10% faster
-    than the XLA path; any lowering failure is a loud non-win."""
+    than the XLA path; any lowering failure is a loud non-win.
+    ``extra`` merges additional keys (e.g. the winning kernel variant)
+    into the recorded verdict."""
     if error is not None:
         verdict = {"win": False, "error": error,
                    "xla_ms": round(xla_ms, 3)}
@@ -111,6 +113,7 @@ def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
                    "xla_ms": round(xla_ms, 3)}
         if shape:
             verdict["shape"] = shape
+    verdict.update(extra or {})
     import jax
 
     if jax.devices()[0].platform == "tpu":

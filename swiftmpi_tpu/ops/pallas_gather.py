@@ -46,24 +46,47 @@ def _gather_kernel(table_ref, idx_ref, out_ref):
     out_ref[...] = jnp.take(table_ref[...], idx, axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("idx_block", "interpret"))
+def _gather_loop_kernel(table_ref, idx_ref, out_ref):
+    """Fallback form: sequential per-row dynamic-slice copies.  Exists
+    because Mosaic's vectorized dynamic-gather path (``jnp.take`` above)
+    may be rejected for some shapes/generations — the A/B harness tries
+    ``take`` first and records whichever lowers and wins (same pattern
+    as ops/pallas_scatter's RMW loop, which is inherently per-row)."""
+    idx = jnp.clip(idx_ref[...], 0, table_ref.shape[0] - 1)
+
+    def body(j, _):
+        out_ref[pl.ds(j, 1), :] = table_ref[pl.ds(idx[j], 1), :]
+        return 0
+
+    jax.lax.fori_loop(0, idx.shape[0], body, 0)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("idx_block", "interpret", "method"))
 def vmem_gather(table: jax.Array, idx: jax.Array,
                 idx_block: int = _DEF_IDX_BLOCK,
-                interpret: bool | None = None) -> jax.Array:
+                interpret: bool | None = None,
+                method: str = "take") -> jax.Array:
     """``table[idx]`` with the table staged in VMEM.
 
     ``idx`` length must be a multiple of ``idx_block`` (pad with any
     in-range value and discard).  Requires the table (plus one index and
     one output block) to fit the ~16MB VMEM budget — callers check
-    ``fits_vmem(table)`` first."""
+    ``fits_vmem(table)`` first.  ``method``: ``take`` (vectorized
+    dynamic gather) or ``loop`` (per-row dynamic slices; the lowering
+    fallback)."""
     n = idx.shape[0]
     if n % idx_block:
         raise ValueError(f"idx length {n} not a multiple of {idx_block}")
+    if method not in ("take", "loop"):
+        # a stale/hand-edited calibration file must fail loudly, not
+        # silently select the slow loop kernel on the production path
+        raise ValueError(f"unknown vmem_gather method {method!r}")
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
     grid = (n // idx_block,)
     return pl.pallas_call(
-        _gather_kernel,
+        _gather_kernel if method == "take" else _gather_loop_kernel,
         grid=grid,
         in_specs=[
             # whole table every step: the pipeline loads it once and the
@@ -105,6 +128,14 @@ def use_vmem_gather(table: jax.Array) -> bool:
                              fits_vmem(table))
 
 
+def gather_method() -> str:
+    """The kernel variant the recorded verdict crowned for this device
+    kind (``take`` when no verdict names one or names an unknown)."""
+    v = calibration.lookup("vmem_gather", calibration.device_key())
+    m = (v or {}).get("method", "take")
+    return m if m in ("take", "loop") else "take"
+
+
 def masked_vmem_gather(table: jax.Array, slots: jax.Array,
                        valid: jax.Array) -> jax.Array:
     """Drop-in for the pull path's masked ``jnp.take``: pads ``slots`` to
@@ -117,5 +148,5 @@ def masked_vmem_gather(table: jax.Array, slots: jax.Array,
     if pad:
         safe = jnp.concatenate(
             [safe, jnp.zeros((pad,), slots.dtype)])
-    rows = vmem_gather(table, safe)[:n]
+    rows = vmem_gather(table, safe, method=gather_method())[:n]
     return jnp.where(valid[:, None], rows, 0)

@@ -279,3 +279,16 @@ def test_w2v_step_with_pallas_scatter_matches_xla(monkeypatch, devices8):
     assert es0 == pytest.approx(es1, rel=1e-5)
     for f in st0:
         np.testing.assert_allclose(st1[f], st0[f], rtol=1e-5, atol=1e-6)
+
+
+def test_vmem_gather_loop_variant_matches_take(devices8):
+    """The per-row loop fallback kernel must produce exactly what the
+    vectorized take kernel does (interpret mode)."""
+    from swiftmpi_tpu.ops.pallas_gather import vmem_gather
+
+    rng = np.random.default_rng(11)
+    table = jnp.asarray(rng.standard_normal((301, 24)), jnp.float32)
+    idx = jnp.asarray(rng.integers(-1, 301, 512), jnp.int32)
+    a = vmem_gather(table, idx, idx_block=128, method="take")
+    b = vmem_gather(table, idx, idx_block=128, method="loop")
+    np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
