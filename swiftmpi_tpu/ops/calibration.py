@@ -94,6 +94,20 @@ def device_key() -> str:
     return jax.devices()[0].device_kind
 
 
+def on_tpu() -> bool:
+    """Is the default device a TPU?  Checked via the DEVICE platform,
+    not ``jax.default_backend()`` — a PJRT plugin (e.g. the axon
+    tunnel) may register under its own backend name while its devices
+    still report platform ``tpu``; trusting the backend name would
+    silently leave every kernel in interpret mode on the real chip."""
+    import jax
+
+    try:
+        return jax.devices()[0].platform == "tpu"
+    except Exception:
+        return False
+
+
 def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
                correct: bool = None, shape: str = None,
                error: str = None, extra: dict = None) -> dict:
@@ -141,7 +155,7 @@ def gated(name: str, env_var: str, fits: bool) -> bool:
         return False
     if mode in ("1", "on", "true"):
         return True
-    if jax.default_backend() != "tpu":
+    if not on_tpu():
         return False
     if jax.device_count() != 1:
         return False

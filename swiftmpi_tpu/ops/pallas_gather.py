@@ -83,7 +83,7 @@ def vmem_gather(table: jax.Array, idx: jax.Array,
         # silently select the slow loop kernel on the production path
         raise ValueError(f"unknown vmem_gather method {method!r}")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not calibration.on_tpu()
     grid = (n // idx_block,)
     return pl.pallas_call(
         _gather_kernel if method == "take" else _gather_loop_kernel,

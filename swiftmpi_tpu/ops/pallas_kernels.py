@@ -85,5 +85,8 @@ def adagrad_update(param: jax.Array, accum: jax.Array, grad: jax.Array,
 
 
 def default_interpret() -> bool:
-    """Interpret mode off only on real TPU backends."""
-    return jax.default_backend() != "tpu"
+    """Interpret mode off only on real TPU devices (checked via the
+    device platform, not the backend name — see calibration.on_tpu)."""
+    from swiftmpi_tpu.ops.calibration import on_tpu
+
+    return not on_tpu()

@@ -71,7 +71,7 @@ def vmem_scatter_add(idx: jax.Array, grads: jax.Array, capacity: int,
     if n % idx_block:
         raise ValueError(f"idx length {n} not a multiple of {idx_block}")
     if interpret is None:
-        interpret = jax.default_backend() != "tpu"
+        interpret = not calibration.on_tpu()
     W = grads.shape[1]
     grid = (n // idx_block,)
     return pl.pallas_call(

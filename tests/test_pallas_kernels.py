@@ -147,7 +147,7 @@ def test_use_vmem_gather_gate(monkeypatch, tmp_path):
     # gates another's kernel
     monkeypatch.delenv("SMTPU_PALLAS_GATHER", raising=False)
     import jax as _jax
-    monkeypatch.setattr(_jax, "default_backend", lambda: "tpu")
+    monkeypatch.setattr(calibration, "on_tpu", lambda: True)
     monkeypatch.setattr(_jax, "device_count", lambda: 1)
     monkeypatch.setattr(calibration, "device_key", lambda: "TPU v5 lite")
     calibration.record("vmem_gather", "TPU v5 lite",
