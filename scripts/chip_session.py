@@ -11,12 +11,14 @@ with a timeout (a wedge costs one stage), appending every result to
   2. full bench.py (headline + secondaries -> the driver-format line)
   3. bench.py TPU child, BENCH_ONLY=w2v, Pallas gates forced OFF (the
      step-level on/off delta for the record)
-  4. gather_micro.py --no-ab (full grid)
-  5. scatter_micro.py (scatter/sampling cells + Pallas scatter A/B)
-  6. step_sweep.py (BATCH x SCAN tuning grid)
-  7. crossover.py --single-device (backend grid, chip cells)
-  8. bench.py TPU child with BENCH_SCALE=1 (1M-vocab pipeline)
-  9. bench.py TPU child with BENCH_TFM=1 (transformer tokens/s)
+  4. gather_micro.py --dense-only (dense vocab-matmul rendering cells)
+  5. gather_micro.py --no-ab (full grid)
+  6. scatter_micro.py (scatter/sampling cells + Pallas scatter A/B)
+  7. step_sweep.py (BATCH x SCAN tuning grid)
+  8. crossover.py --single-device (backend grid, chip cells)
+  9. bench.py TPU child with BENCH_SCALE=1 (1M-vocab pipeline)
+ 10. bench.py TPU child with BENCH_TEXT8=1 (17M-token epoch wall)
+ 11. bench.py TPU child with BENCH_TFM=1 (transformer tokens/s)
 
 Run: python scripts/chip_session.py            (probes first)
 """
@@ -78,6 +80,10 @@ def main():
         ("bench_w2v_nopallas", [py, "bench.py", "--child", "tpu"], 600,
          {"BENCH_ONLY": "w2v", "SMTPU_PALLAS_GATHER": "0",
           "SMTPU_PALLAS_SCATTER": "0"}),
+        # dense vocab-matmul rendering cells: the MXU-shaped candidate
+        # replacement for the random row gather/scatter (decision data)
+        ("dense_micro", [py, "scripts/gather_micro.py", "--dense-only"],
+         420, None),
         # --no-ab: the A/B already ran as stage 1; don't re-burn window
         ("gather_micro", [py, "scripts/gather_micro.py", "--no-ab"],
          600, None),

@@ -94,6 +94,61 @@ def main(ab=True):
         pallas_ab()
 
 
+def dense_cells():
+    """Dense vocab-matmul rendering of the parity step — measured piece
+    by piece.  Idea: with capacity ~17K, compute FULL logits
+    F = neu1 @ h.T on the MXU, then f[b,k] = F[b, t[b,k]] is a
+    ROW-LOCAL scalar gather (21 elements within one contiguous 69KB
+    row) instead of 344K random 400B row fetches; likewise the h-grad
+    becomes G.T @ neu1 (MXU) after a row-local scalar scatter.  Same
+    math, same sampling stream, different memory shape.  If these cells
+    beat gather+scatter (~7ms at bench shape), a `dense_logits` parity
+    mode is worth wiring."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(0)
+    cap, B, K1, d = 17_314, 16_384, 21, 100
+    h = jnp.asarray(rng.standard_normal((cap, d)), jnp.float32)
+    neu1 = jnp.asarray(rng.standard_normal((B, d)), jnp.float32)
+    tidx = jnp.asarray(rng.integers(0, cap, (B, K1)), jnp.int32)
+    gvals = jnp.asarray(rng.standard_normal((B, K1)), jnp.float32)
+    print(f"dense cells device: {jax.devices()[0]}", flush=True)
+    for dt in (jnp.float32, jnp.bfloat16):
+        hh, nn = h.astype(dt), neu1.astype(dt)
+        ms = timeit(jax.jit(lambda a, b: (a @ b.T).sum()), nn, hh) * 1e3
+        print(f"F = neu1 @ h.T   ({jnp.dtype(dt).name:8s}): {ms:7.2f} ms",
+              flush=True)
+    fpair = jax.jit(lambda a, b, i:
+                    jnp.take_along_axis(a @ b.T, i, axis=1).sum())
+    ms = timeit(fpair, neu1, h, tidx) * 1e3
+    print(f"F + row-local pair gather (fp32):  {ms:7.2f} ms", flush=True)
+    rows = jnp.arange(B)[:, None]
+    gscat = jax.jit(lambda g, i: jnp.zeros((B, cap), jnp.float32)
+                    .at[rows, i].add(g).sum())
+    ms = timeit(gscat, gvals, tidx) * 1e3
+    print(f"row-local scalar scatter (B,cap):  {ms:7.2f} ms", flush=True)
+    G = jnp.asarray(rng.standard_normal((B, cap)), jnp.bfloat16)
+    nb = neu1.astype(jnp.bfloat16)
+    ms = timeit(jax.jit(lambda G, n: (G.T @ n).sum()), G, nb) * 1e3
+    print(f"G.T @ neu1 grad matmul (bf16):     {ms:7.2f} ms", flush=True)
+    # end-to-end fused candidate: logits -> pair gather -> scalar
+    # scatter -> grad matmul, one jit (lets XLA fuse what it can)
+    alpha = 0.05
+
+    def fused(nn, hh, i):
+        F = nn @ hh.T                                    # (B, cap)
+        f = jnp.take_along_axis(F, i, axis=1)            # (B, K1)
+        g = (1.0 - jax.nn.sigmoid(f)) * alpha
+        G = jnp.zeros((B, cap), jnp.float32).at[rows, i].add(g)
+        hgrad = G.T @ nn                                 # (cap, d) MXU
+        neu1e = G @ hh                                   # (B, d)  MXU
+        return hgrad.sum() + neu1e.sum()
+
+    ms = timeit(jax.jit(fused), neu1, h, tidx) * 1e3
+    print(f"fused dense-logits NS phase (fp32):{ms:7.2f} ms", flush=True)
+
+
 def pallas_ab():
     """Pallas VMEM-resident gather (ops/pallas_gather.py) vs XLA's HBM
     gather at the bench shape — the "does XLA fall short?" experiment.
@@ -163,5 +218,7 @@ def pallas_ab():
 if __name__ == "__main__":
     if "--ab-only" in sys.argv:
         pallas_ab()
+    elif "--dense-only" in sys.argv:
+        dense_cells()
     else:
         main(ab="--no-ab" not in sys.argv)
