@@ -51,7 +51,8 @@ LR_ROWS = 32561        # a9a shape
 LR_DIM = 123
 LR_NNZ = 14
 LR_BATCH = 8192
-S2V_SENTS = 256
+S2V_SENTS = 1024     # one dispatch per 1024 sentences: at 256 the
+                     # ~5ms tunnel dispatch was ~20% of the batch wall
 S2V_NITERS = 10
 
 # budget: ~6 distinct programs compile through the remote-compile tunnel
@@ -113,6 +114,11 @@ def _build_w2v(device, w2v_overrides=None, inner_steps=None):
                      # which words become centers; n_words counts real
                      # centers, so words/s stays honestly accounted)
                      "sample": 1e-5, "learning_rate": 0.05,
+                     # BENCH_DENSE=1: the MXU dense-logits parity
+                     # rendering (same math/stream, no random row
+                     # gathers — word2vec._build_grads_dense)
+                     **({"dense_logits": 1}
+                        if os.environ.get("BENCH_DENSE") else {}),
                      **(w2v_overrides or {})},
         # BENCH_DTYPE=bfloat16 measures the half-width-storage mode
         "server": {"initial_learning_rate": 0.7, "frag_num": 1000,
@@ -634,7 +640,7 @@ def _tpu_alive(timeout_s: float = 75) -> bool:
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".bench_cache")
 _SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
-              "BENCH_SCALE", "BENCH_TFM")
+              "BENCH_SCALE", "BENCH_TFM", "BENCH_TEXT8", "BENCH_DENSE")
 
 
 def _cache_tpu_result(tpu_res) -> None:

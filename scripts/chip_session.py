@@ -11,6 +11,8 @@ with a timeout (a wedge costs one stage), appending every result to
   2. full bench.py (headline + secondaries -> the driver-format line)
   3. bench.py TPU child, BENCH_ONLY=w2v, Pallas gates forced OFF (the
      step-level on/off delta for the record)
+  3b. bench.py TPU child, BENCH_ONLY=w2v, BENCH_DENSE=1 (dense-logits
+     parity rendering A/B at the step level)
   4. gather_micro.py --dense-only (dense vocab-matmul rendering cells)
   5. gather_micro.py --no-ab (full grid)
   6. scatter_micro.py (scatter/sampling cells + Pallas scatter A/B)
@@ -80,6 +82,9 @@ def main():
         ("bench_w2v_nopallas", [py, "bench.py", "--child", "tpu"], 600,
          {"BENCH_ONLY": "w2v", "SMTPU_PALLAS_GATHER": "0",
           "SMTPU_PALLAS_SCATTER": "0"}),
+        # dense-logits parity rendering (MXU full-logits; same math)
+        ("bench_w2v_dense", [py, "bench.py", "--child", "tpu"], 600,
+         {"BENCH_ONLY": "w2v", "BENCH_DENSE": "1"}),
         # dense vocab-matmul rendering cells: the MXU-shaped candidate
         # replacement for the random row gather/scatter (decision data)
         ("dense_micro", [py, "scripts/gather_micro.py", "--dense-only"],

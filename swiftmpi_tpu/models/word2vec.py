@@ -163,6 +163,11 @@ class Word2Vec:
         self.shared_negatives = g(
             "word2vec", "shared_negatives", 0).to_int32()
         self.shared_pool = g("word2vec", "shared_pool", 1024).to_int32()
+        # TPU-first opt-in with PARITY semantics: compute the NS phase
+        # through full (B, capacity) logits on the MXU instead of
+        # random row gathers (see _build_grads_dense) — same sampling
+        # stream, same math, different memory shape
+        self.dense_logits = g("word2vec", "dense_logits", 0).to_int32()
         self.alpha = g("word2vec", "learning_rate", 0.05).to_float()
         self.min_sentence_length = g(
             "word2vec", "min_sentence_length", 1).to_int32()
@@ -413,9 +418,20 @@ class Word2Vec:
                 "per-pair skip-gram sampler would silently ignore it — "
                 "drop one of the two flags")
         if self.sg:
+            if self.dense_logits:
+                raise ValueError(
+                    "dense_logits is a CBOW-only rendering; with sg: 1 "
+                    "the per-pair skip-gram phase would ignore it — "
+                    "drop one of the two flags")
             return self._build_grads_sg()
+        if self.dense_logits and self.shared_negatives:
+            raise ValueError(
+                "dense_logits and shared_negatives are two different "
+                "renderings of the negative-sampling phase — pick one")
         if self.shared_negatives:
             return self._build_grads_shared()
+        if self.dense_logits:
+            return self._build_grads_dense()
         access = self.access
         transfer = self.transfer
         K = self.negative
@@ -464,6 +480,98 @@ class Word2Vec:
                 h_contrib.reshape(-1, d), v_contrib.reshape(-1, d))
 
             err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
+            err_cnt = t_valid.sum()
+            return pushes, err_sum, err_cnt
+
+        return grads_fn
+
+    def _build_grads_dense(self):
+        """Dense-logits rendering of the parity CBOW-NS gradient phase.
+
+        SAME sampling stream, same clipped sigmoid, same mean-normalized
+        update semantics as ``_build_grads`` — only the memory shape of
+        the h (target) side changes.  The parity step is transaction-
+        bound on its B*(K+1) random row gather + scatter (measured
+        ~14ns/row, docs/ARCHITECTURE.md); with a small table
+        (demo.conf: 17K rows) the same math fits the MXU instead:
+
+            F      = neu1 @ h.T                  (B, cap) logits
+            f[b,k] = F[b, t[b,k]]                row-LOCAL pair gather
+            G      = scatter g into (B, cap)     row-local scalar scatter
+            h_grad = G.T @ neu1                  (cap, d) — ARRIVES DENSE
+            neu1e  = G @ h                       (B, d)
+
+        so the random-row traffic disappears entirely: the h push skips
+        the transfer scatter (PushSpec(dense=True)) and normalization
+        uses the scattered count plane.  Cost moves to O(B*cap) MXU
+        FLOPs + (B, cap) buffers, profitable exactly when cap is small
+        — the regime the reference's demo targets.  Decision data:
+        ``scripts/gather_micro.py --dense-only`` on chip.  Context
+        (v) side is unchanged — its B*2W gather is ~10x smaller.
+
+        Reference math being reproduced: word2vec.h:550-615 (the same
+        f/g/neu1e quantities, batched).
+        """
+        if getattr(self.transfer, "name", "") == "tpu":
+            raise ValueError(
+                "dense_logits computes the h-grad as a full-capacity "
+                "matmul and applies it directly — the 'tpu' backend's "
+                "row-sharded routing doesn't apply (set [cluster] "
+                "transfer: xla)")
+        access = self.access
+        transfer = self.transfer
+        K = self.negative
+        alpha = self.alpha
+        d = self.len_vec
+
+        def grads_fn(state, slot_of_vocab, alias_prob, alias_idx,
+                     centers, contexts, ctx_mask, key):
+            B, W2 = contexts.shape
+            cap = state["h"].shape[0]
+            negs = sample_alias(key, alias_prob, alias_idx, (B, K))
+            targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
+            t_slots = slot_of_vocab[targets_v]            # (B, K+1)
+            ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
+            row_valid = ctx_mask.any(axis=1)
+            t_valid = jnp.concatenate(
+                [jnp.ones((B, 1), bool), negs != centers[:, None]],
+                axis=1)
+            t_valid = t_valid & row_valid[:, None]
+            safe_t = jnp.clip(jnp.where(t_valid, t_slots, 0), 0, cap - 1)
+
+            v_ctx = transfer.pull(
+                state, ctx_slots.reshape(-1), access, fields=("v",)
+            )["v"].reshape(B, W2, d).astype(jnp.float32)
+            neu1 = jnp.sum(v_ctx * ctx_mask[..., None], axis=1)  # (B, d)
+
+            h_all = state["h"].astype(jnp.float32)        # (cap, d)
+            F = neu1 @ h_all.T                            # (B, cap) MXU
+            f = jnp.take_along_axis(F, safe_t, axis=1)    # (B, K+1)
+            labels = jnp.concatenate(
+                [jnp.ones((B, 1)), jnp.zeros((B, K))], axis=1)
+            g = (labels - sigmoid_clipped(f)) * alpha
+            g = jnp.where(t_valid, g, 0.0)
+
+            rows = jnp.arange(B)[:, None]
+            G = jnp.zeros((B, cap), jnp.float32).at[rows, safe_t].add(g)
+            # counts scatter straight to (cap,): 344K scalar adds are
+            # noise next to the three O(B*cap) matmuls, and a (B, cap)
+            # count plane would cost another ~1.1GB buffer at bench
+            # shape just to be row-summed away
+            counts = jnp.zeros((cap,), jnp.float32).at[
+                safe_t.reshape(-1)].add(
+                t_valid.reshape(-1).astype(jnp.float32), mode="drop")
+            h_grad = (G.T @ neu1) / jnp.maximum(counts, 1.0)[:, None]
+            neu1e = G @ h_all                             # (B, d)
+            v_contrib = jnp.where(ctx_mask[..., None],
+                                  neu1e[:, None, :], 0.0)
+
+            pushes = (PushSpec(None, {"h": h_grad}, dense=True),
+                      PushSpec(ctx_slots.reshape(-1),
+                               {"v": v_contrib.reshape(-1, d)},
+                               mean=True))
+
+            err_sum = jnp.sum(1e4 * g * g)
             err_cnt = t_valid.sum()
             return pushes, err_sum, err_cnt
 
@@ -625,9 +733,17 @@ class Word2Vec:
         transfer = self.transfer
 
         def apply_fn(state, pushes):
-            for slots, grads, mean in pushes:
-                state = transfer.push(state, slots, grads, access,
-                                      mean=mean)
+            for spec in pushes:
+                if getattr(spec, "dense", False):
+                    # capacity-shaped, pre-normalized grads (dense-logits
+                    # mode): apply the access rule directly — untouched
+                    # rows carry exact zero and are no-ops
+                    new_fields = access.apply_push(state, spec.grads)
+                    state = dict(state)
+                    state.update(new_fields)
+                else:
+                    state = transfer.push(state, spec.slots, spec.grads,
+                                          access, mean=spec.mean)
             return state
 
         return apply_fn

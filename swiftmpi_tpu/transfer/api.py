@@ -54,25 +54,35 @@ from swiftmpi_tpu.utils.config import ConfigParser
 class PushSpec:
     """One gradient-family push: ``(slots, grads, mean)``.
 
-    A pytree whose ``mean`` flag is static aux data, so a jitted function
-    taking pushes as an argument (e.g. the async snapshot mode's
-    ``jit(apply_fn)(state, pushes)``) sees a concrete Python bool, not a
-    traced scalar.  Iterates like the plain 3-tuple it replaces."""
+    A pytree whose ``mean``/``dense`` flags are static aux data, so a
+    jitted function taking pushes as an argument (e.g. the async
+    snapshot mode's ``jit(apply_fn)(state, pushes)``) sees concrete
+    Python bools, not traced scalars.  Iterates like the plain 3-tuple
+    it replaces.
 
-    def __init__(self, slots, grads, mean: bool = False):
+    ``dense=True`` marks grads that are ALREADY capacity-shaped and
+    normalized (e.g. the dense-logits w2v mode computes the h-grad as
+    a (capacity, d) matmul output): the apply step feeds them straight
+    to the access method, skipping the transfer's scatter/dedup —
+    ``slots`` is unused and should be None."""
+
+    def __init__(self, slots, grads, mean: bool = False,
+                 dense: bool = False):
         self.slots = slots
         self.grads = grads
         self.mean = bool(mean)
+        self.dense = bool(dense)
 
     def __iter__(self):
         return iter((self.slots, self.grads, self.mean))
 
     def tree_flatten(self):
-        return (self.slots, self.grads), self.mean
+        return (self.slots, self.grads), (self.mean, self.dense)
 
     @classmethod
-    def tree_unflatten(cls, mean, children):
-        return cls(children[0], children[1], mean)
+    def tree_unflatten(cls, aux, children):
+        mean, dense = aux
+        return cls(children[0], children[1], mean, dense)
 
 
 class Transfer:

@@ -716,3 +716,52 @@ def test_w2v_hogwild_reconciliation_is_exact_worker_major_apply(devices8):
         # accumulator ordering), far outside this tolerance
         np.testing.assert_allclose(np.asarray(got[f]), np.asarray(ref[f]),
                                    rtol=1e-4, atol=1e-6, err_msg=f)
+
+
+def test_w2v_dense_logits_matches_parity_step(devices8):
+    """dense_logits: 1 — full-logits MXU rendering — must produce the
+    same loss and state as the gather-based parity step (same sampling
+    stream; differences bounded by matmul reassociation)."""
+    corpus = synthetic_corpus(40, vocab_size=120, length=20, seed=31)
+
+    def run(dense):
+        m = make_model(word2vec={"dense_logits": int(dense)})
+        m.build(corpus)
+        step = jax.jit(m._build_step())
+        batcher = CBOWBatcher(corpus, m.vocab, m.window, m.sample,
+                              seed=5)
+        b = next(iter(batcher.epoch(128)))
+        state = dict(m.table.state)
+        state, es, ec = step(
+            state, m._slot_of_vocab, m._alias_prob, m._alias_idx,
+            jnp.asarray(b.centers), jnp.asarray(b.contexts),
+            jnp.asarray(b.ctx_mask), jax.random.key(3))
+        return float(es), int(ec), \
+            {f: np.asarray(v) for f, v in state.items()}
+
+    es0, ec0, st0 = run(False)
+    es1, ec1, st1 = run(True)
+    assert ec0 == ec1
+    assert es0 == pytest.approx(es1, rel=1e-4)
+    for f in st0:
+        np.testing.assert_allclose(st1[f], st0[f], rtol=1e-3, atol=1e-5,
+                                   err_msg=f)
+
+
+def test_w2v_dense_logits_trains_and_guards(devices8):
+    """train() end-to-end in dense mode; invalid flag combinations and
+    the tpu-backend guard raise."""
+    corpus = synthetic_corpus(50, vocab_size=80, length=15, seed=33)
+    m = make_model(word2vec={"dense_logits": 1})
+    losses = m.train(corpus, niters=3, batch_size=64)
+    assert losses[-1] < losses[0], losses
+
+    with pytest.raises(ValueError, match="CBOW-only"):
+        make_model(word2vec={"dense_logits": 1, "sg": 1})._build_grads()
+    with pytest.raises(ValueError, match="pick one"):
+        make_model(word2vec={"dense_logits": 1,
+                             "shared_negatives": 1})._build_grads()
+    m3 = make_model(word2vec={"dense_logits": 1})
+    m3.transfer = type("FakeTpuTransfer", (), {"name": "tpu"})()
+    with pytest.raises(ValueError, match="transfer: xla"):
+        m3._build_grads()
