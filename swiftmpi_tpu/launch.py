@@ -34,7 +34,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 from swiftmpi_tpu.cluster.bootstrap import (ENV_COORDINATOR,
                                             ENV_NUM_PROCESSES,
@@ -128,9 +128,11 @@ def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
     return rc
 
 
-def main(args: List[str]) -> int:
+def main(args: Optional[List[str]] = None) -> int:
     from swiftmpi_tpu.utils.cmdline import CMDLine
 
+    if args is None:
+        args = sys.argv[1:]
     if "--" not in args:
         print("usage: python -m swiftmpi_tpu.launch -np N [-cpu D] "
               "[-port P] -- prog args...", file=sys.stderr)
