@@ -184,7 +184,11 @@ def _bench_w2v(device, timed_calls, built=None, inner_steps=None):
         model.table.state = state
     return {"words_per_sec": words_per_call * timed_calls / dt,
             "step_ms": dt / (timed_calls * n_inner) * 1e3,
-            "loss": loss}
+            "loss": loss,
+            # which NS rendering the model resolved ("gather"/"dense"/
+            # "shared"/"sg") — A/B verdicts must never compare numbers
+            # from mismatched renderings
+            "rendering": getattr(model, "resolved_rendering", None)}
 
 
 def _bench_lr(device, timed_calls):
@@ -535,7 +539,8 @@ def child_main(which: str) -> None:
         raise RuntimeError(
             "tpu child landed on the cpu backend; refusing to report a "
             "cpu number as the accelerator result")
-    out = {"platform": device.platform, "device": str(device)}
+    out = {"platform": device.platform, "device": str(device),
+           "device_kind": device.device_kind}
     timed = TIMED_CALLS[which]
     # emit after EVERY bench so a timeout/crash in a later (secondary)
     # bench never discards an already-measured number — the parent takes

@@ -58,11 +58,61 @@ def run(name, cmd, timeout_s, env_extra=None, tpu_env=True):
              "wall_s": round(time.time() - t0, 1), "tail": tail,
              "stderr_tail": "\n".join(
                  (p.stderr or "").strip().splitlines()[-3:])})
-        return p.returncode == 0
+        return p.returncode == 0, tail
     except subprocess.TimeoutExpired:
         log({"stage": name, "rc": "timeout",
              "wall_s": round(time.time() - t0, 1)})
-        return False
+        return False, ""
+
+
+def record_dense_verdict(tail):
+    """Compare the dense-logits cell against THIS session's cached
+    baseline chip number and record the calibration verdict that
+    ``dense_logits: auto`` (the default) consults — a measured win in
+    this window promotes the rendering into the driver's round-end
+    headline bench automatically.  Guards against the promotion
+    feedback loop: the comparison only happens when the baseline ran
+    the GATHER rendering (once promoted, the verdict freezes instead
+    of oscillating dense-vs-dense), when the baseline is fresh (this
+    window, not a days-old cache), and when the losses agree (same
+    sampling stream — >5% divergence means wrong, not fast)."""
+    from swiftmpi_tpu.ops import calibration
+
+    rec = bench._parse_child_stdout(tail)
+    if not rec or "w2v" not in rec or not rec.get("device_kind"):
+        return
+    dense = rec["w2v"]
+    if dense.get("rendering") != "dense":
+        log({"stage": "dense_verdict",
+             "rc": f"skip: cell rendering={dense.get('rendering')}"})
+        return
+    lk = bench._last_known_tpu()
+    base = (((lk or {}).get("result") or {}).get("w2v") or {})
+    if not base.get("words_per_sec"):
+        return
+    if base.get("rendering") not in ("gather", None):
+        # None = pre-labeling cache; anything else means the baseline
+        # itself already ran a promoted rendering — don't re-record
+        log({"stage": "dense_verdict",
+             "rc": f"skip: baseline rendering={base.get('rendering')}"})
+        return
+    if (lk or {}).get("age_hours", 1e9) > 0.5:
+        log({"stage": "dense_verdict",
+             "rc": f"skip: baseline {lk.get('age_hours')}h old — not "
+                   "this window's bench_full"})
+        return
+    loss_ok = (base.get("loss") and dense.get("loss")
+               and abs(dense["loss"] / base["loss"] - 1.0) < 0.05)
+    verdict = {
+        "win": bool(loss_ok and dense["words_per_sec"]
+                    > 1.1 * base["words_per_sec"]),
+        "loss_ok": bool(loss_ok),
+        "dense_words_per_sec": round(dense["words_per_sec"], 1),
+        "baseline_words_per_sec": round(base["words_per_sec"], 1),
+        "baseline_rendering": base.get("rendering"),
+    }
+    calibration.record("dense_logits", rec["device_kind"], verdict)
+    log({"stage": "dense_verdict", "rc": 0, "verdict": verdict})
 
 
 def main():
@@ -81,7 +131,7 @@ def main():
         # step-level on/off delta for the record (gate forced off)
         ("bench_w2v_nopallas", [py, "bench.py", "--child", "tpu"], 600,
          {"BENCH_ONLY": "w2v", "SMTPU_PALLAS_GATHER": "0",
-          "SMTPU_PALLAS_SCATTER": "0"}),
+          "SMTPU_PALLAS_SCATTER": "0", "SMTPU_DENSE_LOGITS": "0"}),
         # dense-logits parity rendering (MXU full-logits; same math)
         ("bench_w2v_dense", [py, "bench.py", "--child", "tpu"], 600,
          {"BENCH_ONLY": "w2v", "BENCH_DENSE": "1"}),
@@ -108,7 +158,13 @@ def main():
         # bench.py parent manages its own children's envs; everything
         # else pins to the chip
         tpu_env = name not in ("bench_full",)
-        ok = run(name, cmd, timeout_s, env_extra, tpu_env=tpu_env)
+        ok, tail = run(name, cmd, timeout_s, env_extra, tpu_env=tpu_env)
+        if ok and name == "bench_w2v_dense":
+            try:
+                record_dense_verdict(tail)
+            except Exception as e:      # a verdict bug must not end
+                log({"stage": "dense_verdict",     # the session
+                     "rc": f"error: {type(e).__name__}: {e}"})
         if not ok and not bench._tpu_alive(timeout_s=60):
             log({"stage": "session_end", "note": "tunnel lost"})
             return

@@ -166,8 +166,13 @@ class Word2Vec:
         # TPU-first opt-in with PARITY semantics: compute the NS phase
         # through full (B, capacity) logits on the MXU instead of
         # random row gathers (see _build_grads_dense) — same sampling
-        # stream, same math, different memory shape
-        self.dense_logits = g("word2vec", "dense_logits", 0).to_int32()
+        # stream, same math, different memory shape.  Default "auto":
+        # on a single TPU device with a recorded on-chip win for this
+        # rendering (ops/calibration, written by the chip session's
+        # step-level A/B) and a small table, use it; 0/1 force.
+        _dense_raw = g("word2vec", "dense_logits", "auto").to_string()
+        self.dense_logits = None if _dense_raw == "auto" \
+            else int(_dense_raw)
         self.alpha = g("word2vec", "learning_rate", 0.05).to_float()
         self.min_sentence_length = g(
             "word2vec", "min_sentence_length", 1).to_int32()
@@ -423,14 +428,31 @@ class Word2Vec:
                     "dense_logits is a CBOW-only rendering; with sg: 1 "
                     "the per-pair skip-gram phase would ignore it — "
                     "drop one of the two flags")
+            self.resolved_rendering = "sg"
             return self._build_grads_sg()
         if self.dense_logits and self.shared_negatives:
             raise ValueError(
                 "dense_logits and shared_negatives are two different "
                 "renderings of the negative-sampling phase — pick one")
         if self.shared_negatives:
+            self.resolved_rendering = "shared"
             return self._build_grads_shared()
-        if self.dense_logits:
+        dense = self.dense_logits
+        if dense is None:             # "auto": measurement-driven
+            from swiftmpi_tpu.ops import calibration
+
+            dense = (getattr(self.transfer, "name", "") != "tpu"
+                     and self.table is not None
+                     # the (B, capacity) buffers bound the regime: the
+                     # recorded verdict's shape is the ~17K demo table
+                     and self.table.capacity <= 20_000
+                     and calibration.gated("dense_logits",
+                                           "SMTPU_DENSE_LOGITS", True))
+        # which rendering actually resolved — benches label their
+        # numbers with this so A/B verdicts can't compare mismatched
+        # baselines (the dense-promotion feedback-loop hazard)
+        self.resolved_rendering = "dense" if dense else "gather"
+        if dense:
             return self._build_grads_dense()
         access = self.access
         transfer = self.transfer

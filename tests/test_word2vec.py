@@ -765,3 +765,41 @@ def test_w2v_dense_logits_trains_and_guards(devices8):
     m3.transfer = type("FakeTpuTransfer", (), {"name": "tpu"})()
     with pytest.raises(ValueError, match="transfer: xla"):
         m3._build_grads()
+
+
+def test_w2v_hogwild_with_dense_logits(devices8):
+    """The two opt-ins compose: hogwild workers each compute dense-mode
+    grads (capacity-shaped h push) and the ring reconciliation applies
+    them; loss must decrease."""
+    corpus = synthetic_corpus(150, vocab_size=50, length=12, seed=8)
+    m = make_model(word2vec={"async_mode": "hogwild",
+                             "dense_logits": 1, "local_steps": 2})
+    losses = m.train(corpus, niters=3, batch_size=16)
+    assert losses[-1] < losses[0], losses
+
+
+def test_w2v_dense_logits_auto_gate(monkeypatch, tmp_path, devices8):
+    """dense_logits defaults to 'auto': gather on CPU / without a
+    verdict; promoted to dense on a single TPU device with a recorded
+    chip win (same calibration policy as the Pallas kernels)."""
+    from swiftmpi_tpu.ops import calibration
+
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(tmp_path / "c.json"))
+    monkeypatch.delenv("SMTPU_DENSE_LOGITS", raising=False)
+    calibration.reset_cache()
+    corpus = synthetic_corpus(20, vocab_size=50, length=10, seed=2)
+    m = make_model()
+    assert m.dense_logits is None          # the auto default
+    m.build(corpus)
+    m._build_grads()
+    assert m.resolved_rendering == "gather"
+
+    monkeypatch.setattr(calibration, "on_tpu", lambda: True)
+    import jax as _jax
+    monkeypatch.setattr(_jax, "device_count", lambda: 1)
+    monkeypatch.setattr(calibration, "device_key",
+                        lambda: "TPU v5 lite")
+    calibration.record("dense_logits", "TPU v5 lite", {"win": True})
+    m._build_grads()
+    assert m.resolved_rendering == "dense"
+    calibration.reset_cache()
