@@ -96,7 +96,10 @@ def record_dense_verdict(tail):
         log({"stage": "dense_verdict",
              "rc": f"skip: baseline rendering={base.get('rendering')}"})
         return
-    if (lk or {}).get("age_hours", 1e9) > 0.5:
+    # freshness: must be THIS window's bench_full.  Headroom covers the
+    # intervening same-session stages (bench_full's CPU child ~900s +
+    # nopallas 600s + the dense cell 600s ≈ 0.6h) with margin.
+    if (lk or {}).get("age_hours", 1e9) > 1.0:
         log({"stage": "dense_verdict",
              "rc": f"skip: baseline {lk.get('age_hours')}h old — not "
                    "this window's bench_full"})

@@ -441,13 +441,15 @@ class Word2Vec:
         if dense is None:             # "auto": measurement-driven
             from swiftmpi_tpu.ops import calibration
 
+            # the (B, capacity) buffers bound the regime; passed as the
+            # gate's `fits` so SMTPU_DENSE_LOGITS=1 force-on keeps the
+            # same semantics as the Pallas kernel gates (force beats
+            # every auto condition except fit)
+            fits = (self.table is not None
+                    and self.table.capacity <= 20_000)
             dense = (getattr(self.transfer, "name", "") != "tpu"
-                     and self.table is not None
-                     # the (B, capacity) buffers bound the regime: the
-                     # recorded verdict's shape is the ~17K demo table
-                     and self.table.capacity <= 20_000
                      and calibration.gated("dense_logits",
-                                           "SMTPU_DENSE_LOGITS", True))
+                                           "SMTPU_DENSE_LOGITS", fits))
         # which rendering actually resolved — benches label their
         # numbers with this so A/B verdicts can't compare mismatched
         # baselines (the dense-promotion feedback-loop hazard)

@@ -138,14 +138,20 @@ def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
     return verdict
 
 
-def gated(name: str, env_var: str, fits: bool) -> bool:
+def gated(name: str, env_var: str, fits: bool,
+          manual: bool = False) -> bool:
     """The shared measurement-driven gate policy (one copy for all
     Pallas kernels): env force-off beats everything; a kernel that
     doesn't fit never routes; env force-on is the caller's explicit
     override (tests/experiments); auto requires TPU backend, a single
     device (the kernels are single-core VMEM programs — sharded
     operands would be re-laid-out or rejected by the partitioner), and
-    a recorded on-chip win for this device kind."""
+    a recorded on-chip win for this device kind.
+
+    ``manual=True`` relaxes the single-device requirement: the caller
+    is inside ``shard_map`` where operands are already per-device local
+    arrays, so the partitioner hazard doesn't exist and the single-chip
+    verdict is the right proxy for each core's kernel."""
     import jax
 
     mode = os.environ.get(env_var, "auto").lower()
@@ -157,7 +163,7 @@ def gated(name: str, env_var: str, fits: bool) -> bool:
         return True
     if not on_tpu():
         return False
-    if jax.device_count() != 1:
+    if not manual and jax.device_count() != 1:
         return False
     verdict = lookup(name, device_key())
     return bool(verdict and verdict.get("win"))

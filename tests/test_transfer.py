@@ -407,3 +407,30 @@ def test_tpu_backend_hybrid_sparse_dcn_push(devices8):
     assert re.search(r"all_gather[^\n]*tensor<2x32x", txt)
     n_ar_dense, _, _ = collectives(64)        # dense regime
     assert n_ar_dense > 0, "dense regime should still psum"
+
+
+def test_tpu_backend_pull_with_pallas_shard_gather(monkeypatch,
+                                                   devices8):
+    """The shard-local VMEM gather (forced on; interpret mode inside
+    shard_map) must reproduce the plain take-based pull exactly."""
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.asarray(jax.devices()[:4]), (SHARD_AXIS,))
+    access = w2v_access(learning_rate=0.3, len_vec=8)
+    ki = KeyIndex(num_shards=4, capacity_per_shard=64)
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS)
+    slots = slots_with_padding(ki, 48)
+    state_np = {f: np.asarray(v) for f, v in table.state.items()}
+
+    monkeypatch.setenv("SMTPU_PALLAS_GATHER", "0")
+    want = TpuTransfer(mesh).pull(table.state, slots, access)
+    monkeypatch.setenv("SMTPU_PALLAS_GATHER", "1")
+    got = TpuTransfer(mesh).pull(table.state, slots, access)
+    for f in want:
+        np.testing.assert_allclose(np.asarray(got[f]),
+                                   np.asarray(want[f]), rtol=1e-6,
+                                   err_msg=f)
+    # and both match the oracle
+    ref = LocalTransfer().pull(state_np, slots, access)
+    for f in ref:
+        np.testing.assert_allclose(np.asarray(got[f]), ref[f], rtol=1e-6)
