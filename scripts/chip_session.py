@@ -65,6 +65,22 @@ def run(name, cmd, timeout_s, env_extra=None, tpu_env=True):
         return False, ""
 
 
+def _tpu_degraded(tail: str) -> bool:
+    """Did a bench.py PARENT run lose its TPU child ENTIRELY?  Only the
+    ``tpu_unavailable:`` entry means that; per-sub-bench errors
+    (``tpu.xxx:`` / ``cpu.xxx:``) mean the child ran and its headline
+    number landed — no reason to roll anything back."""
+    for line in reversed(tail.splitlines()):
+        if line.startswith("{"):
+            try:
+                d = json.loads(line)
+            except ValueError:
+                continue
+            return any(s.startswith("tpu_unavailable")
+                       for s in d.get("degraded", []))
+    return False
+
+
 def record_dense_verdict(tail):
     """Compare the dense-logits cell against THIS session's cached
     baseline chip number and record the calibration verdict that
@@ -157,11 +173,41 @@ def main():
         ("bench_tfm", [py, "bench.py", "--child", "tpu"], 600,
          {"BENCH_TFM": "1"}),
     ]
-    for name, cmd, timeout_s, env_extra in agenda:
+    retried_full = False
+    rolled_back = False
+    i = 0
+    while i < len(agenda):
+        name, cmd, timeout_s, env_extra = agenda[i]
+        i += 1
         # bench.py parent manages its own children's envs; everything
         # else pins to the chip
         tpu_env = name not in ("bench_full",)
+        if rolled_back:
+            # a kernel verdict just got rolled back as full-step-
+            # breaking: later micro stages must not re-record the same
+            # win and re-arm it (calibration.ab_verdict honors this)
+            env_extra = dict(env_extra or {})
+            env_extra["SMTPU_AB_RECORD"] = "0"
         ok, tail = run(name, cmd, timeout_s, env_extra, tpu_env=tpu_env)
+        if (name == "bench_full" and not retried_full
+                and _tpu_degraded(tail) and bench._tpu_alive()):
+            # the chip child died while the tunnel is LIVE — prime
+            # suspect is a calibration-gated kernel that won its
+            # microbench but breaks the full step.  Fail open: clear
+            # the kernel verdicts and re-run bench_full once.
+            from swiftmpi_tpu.ops import calibration
+
+            for kern in ("vmem_gather", "vmem_scatter", "dense_logits"):
+                calibration.clear(kern)
+            log({"stage": "verdict_rollback",
+                 "note": "bench_full degraded with live tunnel; "
+                         "cleared vmem_gather/vmem_scatter/dense_logits "
+                         "verdicts and retrying bench_full (later micro "
+                         "stages run with A/B recording disabled)"})
+            retried_full = True
+            rolled_back = True
+            i -= 1          # re-run this stage
+            continue
         if ok and name == "bench_w2v_dense":
             try:
                 record_dense_verdict(tail)

@@ -79,6 +79,32 @@ def record(name: str, platform: str, verdict: dict) -> None:
         _CACHE = data
 
 
+def clear(name: str) -> None:
+    """Remove every recorded verdict for ``name`` (all device kinds) —
+    the rollback path when a kernel that won its microbench A/B then
+    breaks the full step (the gate must fail open to the XLA path)."""
+    global _CACHE
+    with _LOCK:
+        path = _path()
+        try:
+            with open(path) as f:
+                data = json.load(f)
+        except (OSError, ValueError):
+            # match the on-disk state just observed: the in-process
+            # memo must not keep serving a verdict the caller believes
+            # was cleared
+            _CACHE = {}
+            return
+        kept = {k: v for k, v in data.items()
+                if not k.startswith(name + ":")}
+        if kept != data:
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(kept, f, indent=1, sort_keys=True)
+            os.replace(tmp, path)
+        _CACHE = kept
+
+
 def reset_cache() -> None:
     """Drop the in-process memo (tests; or after an external write)."""
     global _CACHE
@@ -130,6 +156,13 @@ def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
     verdict.update(extra or {})
     import jax
 
+    if os.environ.get("SMTPU_AB_RECORD", "1") == "0":
+        # rollback mode (chip_session verdict_rollback): measure and
+        # print, but never re-arm a verdict diagnosed as breaking the
+        # full step in this session
+        print(f"calibration NOT recorded (SMTPU_AB_RECORD=0): "
+              f"{name} -> {verdict}", flush=True)
+        return verdict
     if jax.devices()[0].platform == "tpu":
         key = device_key()
         record(name, key, verdict)

@@ -292,3 +292,22 @@ def test_vmem_gather_loop_variant_matches_take(devices8):
     a = vmem_gather(table, idx, idx_block=128, method="take")
     b = vmem_gather(table, idx, idx_block=128, method="loop")
     np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_calibration_clear_removes_only_named_kernel(monkeypatch,
+                                                     tmp_path):
+    """The rollback path (chip_session verdict_rollback): clearing one
+    kernel's verdicts must not touch other kernels' entries."""
+    from swiftmpi_tpu.ops import calibration
+
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(tmp_path / "c.json"))
+    calibration.reset_cache()
+    calibration.record("vmem_gather", "TPU v5 lite", {"win": True})
+    calibration.record("vmem_gather", "TPU v4", {"win": True})
+    calibration.record("vmem_scatter", "TPU v5 lite", {"win": True})
+    calibration.clear("vmem_gather")
+    assert calibration.lookup("vmem_gather", "TPU v5 lite") is None
+    assert calibration.lookup("vmem_gather", "TPU v4") is None
+    assert calibration.lookup("vmem_scatter", "TPU v5 lite")["win"]
+    calibration.clear("nonexistent")          # no-op, no crash
+    calibration.reset_cache()
