@@ -29,10 +29,15 @@ def run_cell(batch, scan, timeout_s=360):
     partial-result recovery, and error-tail logic (a cell whose child
     emits a w2v number then wedges on a later bench still yields the
     number)."""
-    res, err, _dt = bench._run_child(
-        "tpu", timeout_s,
-        extra_env={"BENCH_BATCH": str(batch), "BENCH_SCAN": str(scan),
-                   "BENCH_ONLY": "w2v"})
+    extra = {"BENCH_BATCH": str(batch), "BENCH_SCAN": str(scan),
+             "BENCH_ONLY": "w2v"}
+    if batch >= 49152:
+        # a promoted dense_logits rendering materializes (B, capacity)
+        # F/G buffers — ~4.5GB each at B=64K over the demo table, which
+        # crowds a 16GB chip; pin the big-batch cells to the gather
+        # rendering so a dense promotion can't OOM the sweep
+        extra["SMTPU_DENSE_LOGITS"] = "0"
+    res, err, _dt = bench._run_child("tpu", timeout_s, extra_env=extra)
     return res, err
 
 
