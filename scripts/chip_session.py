@@ -6,8 +6,9 @@ measurement agenda in priority order, each stage in its own subprocess
 with a timeout (a wedge costs one stage), appending every result to
 ``chip_session.jsonl``:
 
-  1. gather_micro.py --ab-only (records the vmem-gather calibration
-     verdict so everything after runs with the measured-best path)
+  1. gather_micro.py --ab-only + scatter_micro.py --ab-only (record
+     the vmem-kernel calibration verdicts so everything after runs
+     with the measured-best paths)
   2. full bench.py (headline + secondaries -> the driver-format line)
   3. bench.py TPU child, BENCH_ONLY=w2v, Pallas gates forced OFF (the
      step-level on/off delta for the record)
@@ -146,6 +147,8 @@ def main():
         # with the measured-best gather path
         ("gather_ab", [py, "scripts/gather_micro.py", "--ab-only"],
          360, None),
+        ("scatter_ab", [py, "scripts/scatter_micro.py", "--ab-only"],
+         360, None),
         ("bench_full", [py, "bench.py"], 1600, None),
         # step-level on/off delta for the record (gate forced off)
         ("bench_w2v_nopallas", [py, "bench.py", "--child", "tpu"], 600,
@@ -158,10 +161,11 @@ def main():
         # replacement for the random row gather/scatter (decision data)
         ("dense_micro", [py, "scripts/gather_micro.py", "--dense-only"],
          420, None),
-        # --no-ab: the A/B already ran as stage 1; don't re-burn window
+        # --no-ab: the A/Bs already ran as stage 1; don't re-burn window
         ("gather_micro", [py, "scripts/gather_micro.py", "--no-ab"],
          600, None),
-        ("scatter_micro", [py, "scripts/scatter_micro.py"], 600, None),
+        ("scatter_micro", [py, "scripts/scatter_micro.py", "--no-ab"],
+         600, None),
         ("step_sweep", [py, "scripts/step_sweep.py"], 2400, None),
         ("crossover_chip", [py, "scripts/crossover.py",
                             "--single-device", "--reps", "3"], 1800, None),
@@ -208,7 +212,10 @@ def main():
             rolled_back = True
             i -= 1          # re-run this stage
             continue
-        if ok and name == "bench_w2v_dense":
+        if ok and name == "bench_w2v_dense" and not rolled_back:
+            # (after a rollback the dense cell may still run for the
+            # record, but must not re-arm the verdict the session just
+            # diagnosed as full-step-breaking)
             try:
                 record_dense_verdict(tail)
             except Exception as e:      # a verdict bug must not end

@@ -1,53 +1,98 @@
-import time, numpy as np, jax, jax.numpy as jnp
+"""Microbench: scatter-add / sampling throughput on the live chip, plus
+the Pallas VMEM-scatter A/B that records the calibration verdict gating
+the push path (transfer/xla.py via ops/pallas_scatter.py).
+
+Run:          JAX_PLATFORMS=axon python scripts/scatter_micro.py
+A/B only:     ... scatter_micro.py --ab-only      (fast: the verdict
+              cell alone, for the front of a short tunnel window)
+"""
+
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
+    __file__))))
+
+import numpy as np  # noqa: E402
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
 
 def timeit(fn, *a, reps=16):
-    out = fn(*a); float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
-    t0 = time.perf_counter()
-    for _ in range(reps): out = fn(*a)
+    out = fn(*a)
     float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
-    return (time.perf_counter()-t0)/reps*1e3
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*a)
+    float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
+    return (time.perf_counter() - t0) / reps * 1e3
+
 
 rng = np.random.default_rng(0)
-N = 114688          # LR bench: 8192 rows x 14 nnz
-g = jnp.asarray(rng.standard_normal((N,1)), jnp.float32)
-for cap in (512, 65536):
-    idx = jnp.asarray(rng.integers(0, min(cap,124), N), jnp.int32)
-    scat = jax.jit(lambda i, g: jnp.zeros((cap,1), jnp.float32).at[i].add(g).sum())
-    print(f"cap={cap:6d} scatter : {timeit(scat, idx, g):7.2f} ms", flush=True)
-    if cap <= 4096:
-        def oh(i, g):
-            o = jax.nn.one_hot(i, cap, dtype=jnp.float32)   # (N, cap)
-            return (o.T @ g).sum()
-        print(f"cap={cap:6d} onehot  : {timeit(jax.jit(oh), idx, g):7.2f} ms", flush=True)
 capw, Nw, d = 17314, 344064, 100
 gi = jnp.asarray(rng.integers(0, capw, Nw), jnp.int32)
-gw = jnp.asarray(rng.standard_normal((Nw,d)), jnp.float32)
-scat2 = jax.jit(lambda i, g: jnp.zeros((capw,d), jnp.float32).at[i].add(g).sum())
-print(f"w2v dense scatter (344K x 100 -> 17314): {timeit(scat2, gi, gw):7.2f} ms", flush=True)
-cnt = jax.jit(lambda i: jnp.zeros((capw,), jnp.float32).at[i].add(1.0).sum())
-print(f"w2v counts scatter (344K scalars)      : {timeit(cnt, gi):7.2f} ms", flush=True)
-# fused [grads|count] single scatter (the mean=True dense-push layout)
-g1 = jnp.concatenate([gw, jnp.ones((Nw, 1), jnp.float32)], axis=1)
+# fused [grads|count] layout (the mean=True dense-push shape) built
+# directly — no exploratory-only (Nw, d) intermediate on the window-
+# critical --ab-only path
+_g1_np = rng.standard_normal((Nw, d + 1)).astype(np.float32)
+_g1_np[:, d] = 1.0
+g1 = jnp.asarray(_g1_np)
+del _g1_np
 fscat = jax.jit(lambda i, g: jnp.zeros((capw, d + 1), jnp.float32)
                 .at[i].add(g).sum())
-print(f"w2v fused grads+count scatter (x101)   : {timeit(fscat, gi, g1):7.2f} ms", flush=True)
-# alias sampling cost at bench shape: 2 scalar gathers per draw from the
-# 30K-entry alias arrays — is the sampler a hidden transaction cost?
-import sys, os
-sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
-from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
-counts = rng.zipf(1.5, 30000).astype(np.int64)
-prob, alias = build_unigram_alias(counts)
-prob_d, alias_d = jnp.asarray(prob), jnp.asarray(alias)
-samp = jax.jit(lambda k: sample_alias(k, prob_d, alias_d, (16384, 20)).sum())
-print(f"alias sampling (16384 x 20 draws)      : {timeit(samp, jax.random.key(0)):7.2f} ms", flush=True)
-# Pallas VMEM-resident scatter A/B (ops/pallas_scatter.py) at the w2v
-# fused grads+count shape — records the calibration verdict that gates
-# the push path (transfer/xla.py)
-from swiftmpi_tpu.ops import calibration
-from swiftmpi_tpu.ops.pallas_scatter import fits_vmem, vmem_scatter_add
-xla_ms = timeit(fscat, gi, g1)
-if fits_vmem(capw, d + 1):
+
+
+def exploratory_cells():
+    N = 114688          # LR bench: 8192 rows x 14 nnz
+    g = jnp.asarray(rng.standard_normal((N, 1)), jnp.float32)
+    gw = g1[:, :d]      # (Nw, d) grads view for the plain-scatter cell
+    for cap in (512, 65536):
+        idx = jnp.asarray(rng.integers(0, min(cap, 124), N), jnp.int32)
+        scat = jax.jit(lambda i, g, cap=cap:
+                       jnp.zeros((cap, 1), jnp.float32).at[i].add(g).sum())
+        print(f"cap={cap:6d} scatter : {timeit(scat, idx, g):7.2f} ms",
+              flush=True)
+        if cap <= 4096:
+            def oh(i, g, cap=cap):
+                o = jax.nn.one_hot(i, cap, dtype=jnp.float32)  # (N, cap)
+                return (o.T @ g).sum()
+            print(f"cap={cap:6d} onehot  : {timeit(jax.jit(oh), idx, g):7.2f} ms",
+                  flush=True)
+    scat2 = jax.jit(lambda i, g: jnp.zeros((capw, d), jnp.float32)
+                    .at[i].add(g).sum())
+    print(f"w2v dense scatter (344K x 100 -> 17314): "
+          f"{timeit(scat2, gi, gw):7.2f} ms", flush=True)
+    cnt = jax.jit(lambda i: jnp.zeros((capw,), jnp.float32)
+                  .at[i].add(1.0).sum())
+    print(f"w2v counts scatter (344K scalars)      : "
+          f"{timeit(cnt, gi):7.2f} ms", flush=True)
+    print(f"w2v fused grads+count scatter (x101)   : "
+          f"{timeit(fscat, gi, g1):7.2f} ms", flush=True)
+    # alias sampling cost at bench shape: 2 scalar gathers per draw from
+    # the 30K-entry alias arrays — a hidden transaction cost?
+    from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
+    counts = rng.zipf(1.5, 30000).astype(np.int64)
+    prob, alias = build_unigram_alias(counts)
+    prob_d, alias_d = jnp.asarray(prob), jnp.asarray(alias)
+    samp = jax.jit(lambda k: sample_alias(k, prob_d, alias_d,
+                                          (16384, 20)).sum())
+    print(f"alias sampling (16384 x 20 draws)      : "
+          f"{timeit(samp, jax.random.key(0)):7.2f} ms", flush=True)
+
+
+def pallas_ab():
+    """Pallas VMEM-resident scatter A/B at the w2v fused grads+count
+    shape — records the verdict that gates the push path."""
+    from swiftmpi_tpu.ops import calibration
+    from swiftmpi_tpu.ops.pallas_scatter import fits_vmem, vmem_scatter_add
+
+    print(f"A/B device: {jax.devices()[0]}", flush=True)
+    xla_ms = timeit(fscat, gi, g1)
+    print(f"xla fused scatter (x101 -> 17314)      : {xla_ms:7.2f} ms",
+          flush=True)
+    if not fits_vmem(capw, d + 1):
+        return
     try:
         # correctness first (duplicate-heavy small case), then timing
         si, sg = gi[:8192], g1[:8192]
@@ -66,3 +111,12 @@ if fits_vmem(capw, d + 1):
               f"{str(e)[:200]})", flush=True)
         calibration.ab_verdict("vmem_scatter", xla_ms,
                                error=f"{type(e).__name__}: {str(e)[:200]}")
+
+
+if __name__ == "__main__":
+    if "--ab-only" in sys.argv:
+        pallas_ab()
+    else:
+        exploratory_cells()
+        if "--no-ab" not in sys.argv:
+            pallas_ab()

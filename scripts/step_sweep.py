@@ -31,11 +31,13 @@ def run_cell(batch, scan, timeout_s=360):
     number)."""
     extra = {"BENCH_BATCH": str(batch), "BENCH_SCAN": str(scan),
              "BENCH_ONLY": "w2v"}
-    if batch >= 49152:
+    if batch >= 49152 and "SMTPU_DENSE_LOGITS" not in os.environ:
         # a promoted dense_logits rendering materializes (B, capacity)
         # F/G buffers — ~4.5GB each at B=64K over the demo table, which
         # crowds a 16GB chip; pin the big-batch cells to the gather
-        # rendering so a dense promotion can't OOM the sweep
+        # rendering so a dense promotion can't OOM the sweep (an
+        # operator's explicit env setting wins; each row prints the
+        # rendering that actually ran)
         extra["SMTPU_DENSE_LOGITS"] = "0"
     res, err, _dt = bench._run_child("tpu", timeout_s, extra_env=extra)
     return res, err
@@ -50,8 +52,8 @@ def main():
         cells = [tuple(int(x) for x in c.split(":"))
                  for c in os.environ["SWEEP_CELLS"].split(",")]
     best = None
-    print(f"{'batch':>7} {'scan':>5} {'words/s':>12} {'step_ms':>9}",
-          flush=True)
+    print(f"{'batch':>7} {'scan':>5} {'words/s':>12} {'step_ms':>9} "
+          f"{'rendering':>10}", flush=True)
     for batch, scan in cells:
         res, err = run_cell(batch, scan)
         w2v = (res or {}).get("w2v")
@@ -62,14 +64,20 @@ def main():
             continue
         w = w2v["words_per_sec"]
         s = w2v["step_ms"]
-        print(f"{batch:7d} {scan:5d} {w:12.0f} {s:9.2f}", flush=True)
+        # rendering per row: cells can legitimately differ (big-batch
+        # cells pin to gather) and a throughput delta must never be
+        # silently attributed to batch/scan alone
+        r = w2v.get("rendering") or "?"
+        print(f"{batch:7d} {scan:5d} {w:12.0f} {s:9.2f} {r:>10}",
+              flush=True)
         if best is None or w > best[2]:
-            best = (batch, scan, w)
+            best = (batch, scan, w, r)
     if best:
         print(f"\nbest: BENCH_BATCH={best[0]} BENCH_SCAN={best[1]} "
-              f"-> {best[2]:.0f} words/s", flush=True)
+              f"-> {best[2]:.0f} words/s ({best[3]})", flush=True)
         print(json.dumps({"best_batch": best[0], "best_scan": best[1],
-                          "best_words_per_sec": round(best[2], 1)}),
+                          "best_words_per_sec": round(best[2], 1),
+                          "best_rendering": best[3]}),
               flush=True)
 
 
