@@ -157,6 +157,11 @@ def main():
         # dense-logits parity rendering (MXU full-logits; same math)
         ("bench_w2v_dense", [py, "bench.py", "--child", "tpu"], 600,
          {"BENCH_ONLY": "w2v", "BENCH_DENSE": "1"}),
+        # bf16 table storage: round 2 measured it throughput-neutral
+        # (transaction-bound); with a VMEM gather win the step becomes
+        # byte-bound and half-width rows may finally pay
+        ("bench_w2v_bf16", [py, "bench.py", "--child", "tpu"], 600,
+         {"BENCH_ONLY": "w2v", "BENCH_DTYPE": "bfloat16"}),
         # dense vocab-matmul rendering cells: the MXU-shaped candidate
         # replacement for the random row gather/scatter (decision data)
         ("dense_micro", [py, "scripts/gather_micro.py", "--dense-only"],

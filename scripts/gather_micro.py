@@ -90,6 +90,21 @@ def main(ab=True):
     ms = timeit(jax.jit(onehot_mm), table, idx2) * 1e3
     print(f"onehot-matmul gather (bf16, cap=17314): {ms:7.2f} ms", flush=True)
 
+    # bf16 VMEM gather: with the kernel byte-bound (unlike XLA's
+    # transaction-bound HBM gather), half-width rows may halve the time
+    from swiftmpi_tpu.ops.pallas_gather import fits_vmem, vmem_gather
+    tb16 = jnp.asarray(rng.standard_normal((cap, 100)), jnp.bfloat16)
+    idxg = jnp.asarray(rng.integers(0, cap, N), jnp.int32)
+    if fits_vmem(tb16):
+        try:
+            pg16 = jax.jit(lambda t, i: vmem_gather(t, i).sum())
+            ms = timeit(pg16, tb16, idxg) * 1e3
+            print(f"pallas vmem gather (bf16, cap=17314): {ms:7.2f} ms",
+                  flush=True)
+        except Exception as e:
+            print(f"pallas vmem gather bf16: UNSUPPORTED "
+                  f"({type(e).__name__}: {str(e)[:160]})", flush=True)
+
     if ab:
         pallas_ab()
 
