@@ -1,0 +1,109 @@
+"""chip_session's decision hooks, offline: the dense-promotion verdict
+recorder and the degraded-bench detector.  These gate what runs on the
+scarce live tunnel, so their edge cases are pinned here rather than
+discovered mid-window."""
+
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "scripts"))
+
+import bench  # noqa: E402
+import chip_session  # noqa: E402
+
+from swiftmpi_tpu.ops import calibration  # noqa: E402
+
+KIND = "TPU v5 lite"
+
+
+@pytest.fixture
+def iso_cache(tmp_path, monkeypatch):
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    # the hooks log() to chip_session.jsonl — keep synthetic test rows
+    # out of the real session log
+    monkeypatch.setattr(chip_session, "OUT",
+                        str(tmp_path / "session.jsonl"))
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(tmp_path / "c.json"))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    calibration.reset_cache()
+    yield tmp_path
+    calibration.reset_cache()
+
+
+def _tail(wps, loss, rendering):
+    return "BENCH_CHILD " + json.dumps(
+        {"device_kind": KIND,
+         "w2v": {"words_per_sec": wps, "loss": loss,
+                 "rendering": rendering}})
+
+
+def _seed_baseline(wps, loss, rendering, age_s=0):
+    bench._cache_tpu_result(
+        {"w2v": {"words_per_sec": wps, "loss": loss,
+                 "rendering": rendering}, "device_kind": KIND})
+    if age_s:
+        path = os.path.join(bench.CACHE_DIR, "tpu_latest.json")
+        rec = json.load(open(path))
+        rec["ts"] -= age_s
+        json.dump(rec, open(path, "w"))
+
+
+def test_dense_win_recorded_against_fresh_gather_baseline(iso_cache):
+    _seed_baseline(800_000.0, 100.0, "gather")
+    chip_session.record_dense_verdict(_tail(1_500_000.0, 101.0, "dense"))
+    v = calibration.lookup("dense_logits", KIND)
+    assert v and v["win"] and v["loss_ok"]
+
+
+def test_dense_verdict_skipped_when_baseline_already_dense(iso_cache):
+    _seed_baseline(800_000.0, 100.0, "gather")
+    chip_session.record_dense_verdict(_tail(1_500_000.0, 101.0, "dense"))
+    v1 = calibration.lookup("dense_logits", KIND)
+    # promoted baseline: comparison must freeze, not oscillate
+    _seed_baseline(1_500_000.0, 101.0, "dense")
+    chip_session.record_dense_verdict(_tail(1_490_000.0, 101.0, "dense"))
+    assert calibration.lookup("dense_logits", KIND) == v1
+
+
+def test_dense_verdict_skipped_for_stale_baseline(iso_cache):
+    _seed_baseline(400_000.0, 100.0, "gather", age_s=2 * 3600)
+    chip_session.record_dense_verdict(_tail(1_500_000.0, 101.0, "dense"))
+    assert calibration.lookup("dense_logits", KIND) is None
+
+
+def test_dense_verdict_requires_loss_agreement(iso_cache):
+    _seed_baseline(800_000.0, 100.0, "gather")
+    chip_session.record_dense_verdict(_tail(1_500_000.0, 140.0, "dense"))
+    v = calibration.lookup("dense_logits", KIND)
+    assert v is not None and not v["win"] and not v["loss_ok"]
+
+
+def test_tpu_degraded_only_on_child_loss():
+    assert chip_session._tpu_degraded(json.dumps(
+        {"degraded": ["tpu_unavailable: probe hung"]}))
+    # per-sub-bench errors mean the headline landed — no rollback
+    assert not chip_session._tpu_degraded(json.dumps(
+        {"degraded": ["tpu.tfm: OOM", "cpu.w2v: ImportError"]}))
+    assert not chip_session._tpu_degraded(json.dumps({"metric": "x"}))
+    assert not chip_session._tpu_degraded("no json here")
+
+
+def test_ab_verdict_record_suppression(iso_cache, monkeypatch):
+    monkeypatch.setattr(calibration, "device_key", lambda: KIND)
+    import jax as _jax
+    monkeypatch.setattr(
+        _jax, "devices",
+        lambda *a: [type("D", (), {"platform": "tpu",
+                                   "device_kind": KIND})()])
+    monkeypatch.setenv("SMTPU_AB_RECORD", "0")
+    calibration.ab_verdict("vmem_gather", 5.0, 1.0, correct=True)
+    assert calibration.lookup("vmem_gather", KIND) is None
+    monkeypatch.delenv("SMTPU_AB_RECORD")
+    calibration.ab_verdict("vmem_gather", 5.0, 1.0, correct=True)
+    assert calibration.lookup("vmem_gather", KIND)["win"]
