@@ -114,6 +114,26 @@ def _stack_group(batches):
     return c, x, m
 
 
+def _cbow_targets(slot_of_vocab, alias_prob, alias_idx, centers,
+                  contexts, ctx_mask, key, K):
+    """Shared CBOW batch layout: draw the negatives and build the
+    target/context slot matrices + validity masks.  ONE copy used by
+    both the gather and dense renderings — their identical sampling
+    stream (the basis of the dense mode's parity guarantee) is
+    identical by construction, not by parallel maintenance."""
+    B = centers.shape[0]
+    negs = sample_alias(key, alias_prob, alias_idx, (B, K))
+    targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
+    t_slots = slot_of_vocab[targets_v]                    # (B, K+1)
+    ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
+    row_valid = ctx_mask.any(axis=1)
+    # negative == center is skipped (word2vec.h:584-586)
+    t_valid = jnp.concatenate(
+        [jnp.ones((B, 1), bool), negs != centers[:, None]], axis=1)
+    t_valid = t_valid & row_valid[:, None]
+    return t_slots, ctx_slots, t_valid
+
+
 def _assemble_push(tf, cf, h_flat, v_flat):
     """Lay out one push per gradient family: h-grads keyed by target
     slots, v-grads keyed by context slots, both with ``mean=True`` — the
@@ -465,15 +485,9 @@ class Word2Vec:
         def grads_fn(state, slot_of_vocab, alias_prob, alias_idx,
                      centers, contexts, ctx_mask, key):
             B, W2 = contexts.shape
-            negs = sample_alias(key, alias_prob, alias_idx, (B, K))
-            targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
-            t_slots = slot_of_vocab[targets_v]            # (B, K+1)
-            ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
-            row_valid = ctx_mask.any(axis=1)
-            # negative == center is skipped (word2vec.h:584-586)
-            t_valid = jnp.concatenate(
-                [jnp.ones((B, 1), bool), negs != centers[:, None]], axis=1)
-            t_valid = t_valid & row_valid[:, None]
+            t_slots, ctx_slots, t_valid = _cbow_targets(
+                slot_of_vocab, alias_prob, alias_idx, centers, contexts,
+                ctx_mask, key, K)
             t_slots = jnp.where(t_valid, t_slots, -1)
 
             # split pulls: targets need only h, contexts only v —
@@ -552,15 +566,9 @@ class Word2Vec:
                      centers, contexts, ctx_mask, key):
             B, W2 = contexts.shape
             cap = state["h"].shape[0]
-            negs = sample_alias(key, alias_prob, alias_idx, (B, K))
-            targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
-            t_slots = slot_of_vocab[targets_v]            # (B, K+1)
-            ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
-            row_valid = ctx_mask.any(axis=1)
-            t_valid = jnp.concatenate(
-                [jnp.ones((B, 1), bool), negs != centers[:, None]],
-                axis=1)
-            t_valid = t_valid & row_valid[:, None]
+            t_slots, ctx_slots, t_valid = _cbow_targets(
+                slot_of_vocab, alias_prob, alias_idx, centers, contexts,
+                ctx_mask, key, K)
             safe_t = jnp.clip(jnp.where(t_valid, t_slots, 0), 0, cap - 1)
 
             v_ctx = transfer.pull(
