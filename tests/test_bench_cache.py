@@ -42,3 +42,40 @@ def test_override_runs_do_not_clobber_canonical_latest(tmp_path,
 def test_no_cache_returns_none(tmp_path, monkeypatch):
     monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path / "empty"))
     assert bench._last_known_tpu() is None
+
+
+def test_parent_degraded_output_embeds_last_known_tpu(monkeypatch,
+                                                      tmp_path, capsys):
+    """The driver-format line from a tunnel-down parent run must carry
+    the cached chip evidence (the round-2 postmortem scenario, end to
+    end through parent_main with mocked children)."""
+    import json
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "device_kind": "TPU v5 lite",
+         "w2v": {"words_per_sec": 794365.3, "step_ms": 20.6,
+                 "loss": 3870319.5, "rendering": "gather"}})
+
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: False)
+
+    def fake_run_child(which, timeout_s, extra_env=None):
+        assert which == "cpu"       # the TPU child must be skipped
+        return ({"platform": "cpu", "device": "TFRT_CPU_0",
+                 "w2v": {"words_per_sec": 100000.0, "step_ms": 2.0,
+                         "loss": 5.0, "rendering": "gather"}},
+                None, 1.0)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench.parent_main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(line)
+    assert d["value"] == 100000.0                # honest: CPU headline
+    assert d["vs_baseline"] is None
+    assert any(s.startswith("tpu_unavailable") for s in d["degraded"])
+    lk = d["last_known_tpu"]
+    assert lk["words_per_sec"] == 794365.3
+    assert lk["age_hours"] < 1.0
+    assert lk["result"]["w2v"]["rendering"] == "gather"
