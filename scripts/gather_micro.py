@@ -195,32 +195,41 @@ def pallas_ab():
     small_idx = idx3[:8192]
     want = np.asarray(jnp.take(tf32, small_idx, axis=0))
     variants = {}      # full per-variant record, kept in the verdict
-    for method in ("take", "loop"):
+    # (method, idx_block): taa/take are expected Mosaic rejections on
+    # current TC lowerings (recorded as evidence); loop is the variant
+    # that lowers today (SMEM-scalar addressed row copies, unrolled x8)
+    # and its grid-step size is a tuning knob worth two cells
+    for method, blk in (("taa", 1024), ("take", 4096),
+                        ("loop", 4096), ("loop", 16384)):
+        tag = f"{method}{blk}" if method == "loop" else method
         try:
             # correctness first: a Mosaic-lowering divergence must
             # never flip the gate onto wrong numerics
-            got = np.asarray(vmem_gather(tf32, small_idx, method=method))
+            got = np.asarray(vmem_gather(tf32, small_idx,
+                                         idx_block=blk, method=method))
             correct = bool(np.allclose(got, want))
-            pg = jax.jit(lambda t, i, m=method:
-                         vmem_gather(t, i, method=m).sum())
+            pg = jax.jit(lambda t, i, m=method, b=blk:
+                         vmem_gather(t, i, idx_block=b, method=m).sum())
             ms = timeit(pg, tf32, idx3) * 1e3
-            print(f"pallas vmem gather[{method}] (fp32, cap={cap}): "
+            print(f"pallas vmem gather[{tag}] (fp32, cap={cap}): "
                   f"{ms:7.2f} ms  {gb / ms * 1e3:6.1f} GB/s  "
                   f"correct={correct}", flush=True)
-            variants[method] = {"correct": correct, "ms": round(ms, 3)}
+            variants[tag] = {"correct": correct, "ms": round(ms, 3),
+                             "method": method, "idx_block": blk}
         except Exception as e:
             msg = f"{type(e).__name__}: {str(e)[:160]}"
-            variants[method] = {"error": msg}
-            print(f"pallas vmem gather[{method}]: UNSUPPORTED ({msg})",
+            variants[tag] = {"error": msg}
+            print(f"pallas vmem gather[{tag}]: UNSUPPORTED ({msg})",
                   flush=True)
-    usable = {m: v["ms"] for m, v in variants.items()
+    usable = {t: v["ms"] for t, v in variants.items()
               if v.get("correct")}
     if usable:
         best = min(usable, key=usable.get)
         calibration.ab_verdict("vmem_gather", xla_ms, usable[best],
                                correct=True,
                                shape=f"cap={cap} d=100 fp32 N={N}",
-                               extra={"method": best,
+                               extra={"method": variants[best]["method"],
+                                      "idx_block": variants[best]["idx_block"],
                                       "variants": variants})
     else:
         # keep the per-variant record: an operator must be able to tell
