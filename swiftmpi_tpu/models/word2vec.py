@@ -50,7 +50,8 @@ from swiftmpi_tpu.cluster.cluster import Cluster
 from swiftmpi_tpu.data.text import (CBOWBatcher, Vocab, build_vocab,
                                     load_corpus)  # noqa: F401 (Vocab: API)
 from swiftmpi_tpu.io.checkpoint import dump_table_text, load_table_text
-from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
+from swiftmpi_tpu.ops.sampling import (build_unigram_alias, sample_alias,
+                                       sample_alias_slots)
 from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
 from swiftmpi_tpu.parameter import w2v_access
 from swiftmpi_tpu.transfer import PushSpec
@@ -122,9 +123,13 @@ def _cbow_targets(slot_of_vocab, alias_prob, alias_idx, centers,
     stream (the basis of the dense mode's parity guarantee) is
     identical by construction, not by parallel maintenance."""
     B = centers.shape[0]
-    negs = sample_alias(key, alias_prob, alias_idx, (B, K))
-    targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
-    t_slots = slot_of_vocab[targets_v]                    # (B, K+1)
+    # fused draw: negatives and their table slots from ONE packed row
+    # gather (sampling was ~6.5ms of the 17.7ms chip step as separate
+    # scalar gathers — see ops/sampling.sample_alias_slots)
+    negs, neg_slots = sample_alias_slots(
+        key, alias_prob, alias_idx, slot_of_vocab, (B, K))
+    t_slots = jnp.concatenate(
+        [slot_of_vocab[centers][:, None], neg_slots], axis=1)  # (B, K+1)
     ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
     row_valid = ctx_mask.any(axis=1)
     # negative == center is skipped (word2vec.h:584-586)
@@ -720,17 +725,18 @@ class Word2Vec:
         def grads_fn(state, slot_of_vocab, alias_prob, alias_idx,
                      centers, contexts, ctx_mask, key):
             B, W2 = contexts.shape
-            negs = sample_alias(key, alias_prob, alias_idx, (B, W2, K))
-            targets_v = jnp.concatenate(
-                [jnp.broadcast_to(centers[:, None, None], (B, W2, 1)), negs],
-                axis=2)                                       # (B, W2, K+1)
+            negs, neg_slots = sample_alias_slots(
+                key, alias_prob, alias_idx, slot_of_vocab, (B, W2, K))
             # negative == center is skipped (word2vec.h:584-586); padding
             # pairs are fully dead.
             t_valid = jnp.concatenate(
                 [jnp.ones((B, W2, 1), bool),
                  negs != centers[:, None, None]], axis=2)
             t_valid = t_valid & ctx_mask[..., None]
-            t_slots = jnp.where(t_valid, slot_of_vocab[targets_v], -1)
+            c_slots = jnp.broadcast_to(
+                slot_of_vocab[centers][:, None, None], (B, W2, 1))
+            t_slots = jnp.where(
+                t_valid, jnp.concatenate([c_slots, neg_slots], axis=2), -1)
             ctx_slots = jnp.where(ctx_mask, slot_of_vocab[contexts], -1)
 
             h_t = transfer.pull(

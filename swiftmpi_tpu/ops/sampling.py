@@ -55,14 +55,62 @@ def build_unigram_alias(counts: np.ndarray, power: float = 0.75
     return prob.astype(np.float32), alias
 
 
-def sample_alias(key: jax.Array, prob: jax.Array, alias: jax.Array,
-                 shape: Tuple[int, ...]) -> jax.Array:
-    """Device-side categorical draws from alias tables."""
+def _alias_draw_packed(key, prob, extra_cols, shape):
+    """Shared draw core: packs ``(prob_bits, *extra_cols)`` into one
+    (V, 1+len(extra_cols)) int32 table and resolves each draw with ONE
+    row gather.  The round-3 chip profile showed scalar gathers are
+    transaction-bound (~10ns each regardless of width), so collapsing
+    the per-draw lookups (prob, alias, and optionally the vocab->slot
+    map) into a single row halves-to-quarters the sampling phase.  One
+    copy of the (j, u, accept) sequence keeps every caller's draw
+    stream bit-identical by construction — the parity tests reproduce
+    training negatives through ``sample_alias`` while training itself
+    uses ``sample_alias_slots``.
+
+    Returns ``(j, accept, rows)``: bucket draws, acceptance mask, and
+    the gathered packed rows (prob bits in column 0)."""
     k1, k2 = jax.random.split(key)
     V = prob.shape[0]
     j = jax.random.randint(k1, shape, 0, V)
     u = jax.random.uniform(k2, shape)
-    return jnp.where(u < prob[j], j, alias[j]).astype(jnp.int32)
+    packed = jnp.stack(
+        [jax.lax.bitcast_convert_type(prob, jnp.int32)] + extra_cols,
+        axis=1)
+    rows = packed[j]                              # (*shape, 1+len(extra))
+    pj = jax.lax.bitcast_convert_type(rows[..., 0], jnp.float32)
+    return j, u < pj, rows
+
+
+def sample_alias(key: jax.Array, prob: jax.Array, alias: jax.Array,
+                 shape: Tuple[int, ...]) -> jax.Array:
+    """Device-side categorical draws from alias tables.  Draws are
+    bit-identical to the textbook two-gather form (same j, u, same
+    compared values; prob bits round-trip exactly through the pack's
+    bitcast)."""
+    j, accept, rows = _alias_draw_packed(key, prob, [alias], shape)
+    return jnp.where(accept, j, rows[..., 1]).astype(jnp.int32)
+
+
+def sample_alias_slots(key: jax.Array, prob: jax.Array, alias: jax.Array,
+                       slot_of_vocab: jax.Array, shape: Tuple[int, ...]
+                       ) -> Tuple[jax.Array, jax.Array]:
+    """Alias draws fused with the vocab->slot mapping: returns
+    ``(negs, neg_slots)`` with ``neg_slots == slot_of_vocab[negs]``.
+
+    One (V, 4) row — ``(prob_bits, alias, slot, slot_of_alias)`` — per
+    vocab id turns what was FOUR transaction-bound scalar gathers per
+    draw (prob, alias, then slot_of_vocab on the result) into one row
+    gather.  The pack itself is (V, 4) work, loop-invariant, and
+    hoisted out of inner-step scans by XLA; draw stream is bit-identical
+    to ``sample_alias`` + ``slot_of_vocab[negs]``."""
+    V = prob.shape[0]
+    j, accept, rows = _alias_draw_packed(
+        key, prob, [alias, slot_of_vocab[:V], slot_of_vocab[alias]],
+        shape)
+    negs = jnp.where(accept, j, rows[..., 1]).astype(jnp.int32)
+    neg_slots = jnp.where(accept, rows[..., 2],
+                          rows[..., 3]).astype(jnp.int32)
+    return negs, neg_slots
 
 
 def subsample_keep_prob(counts: np.ndarray, sample: float) -> np.ndarray:
