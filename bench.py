@@ -207,8 +207,15 @@ def _bench_lr(device, timed_calls):
         "worker": {"minibatch": LR_BATCH},
     })
     with jax.default_device(device):
+        # capacity sized to the dataset (a9a: 123 features + bias), as
+        # the reference's dense_hash_map would settle; at this size the
+        # model auto-selects the capacity-dense rendering (two MXU
+        # matmuls per step instead of B*F transaction-bound scalar
+        # gathers — the round-2/3 chip windows measured the sparse
+        # rendering at 0.06-0.12x the CPU baseline)
         model = LogisticRegression(
-            config=cfg, cluster=Cluster(cfg, devices=[device]).initialize())
+            config=cfg, cluster=Cluster(cfg, devices=[device]).initialize(),
+            capacity_per_shard=max(64, int(LR_DIM * 1.3) + 1))
         data = synthetic_dataset(LR_ROWS, LR_DIM, LR_NNZ, seed=3)
         F = max(len(f) for _, f in data)
         # drop_remainder: iter_minibatches pads the tail to batch_size, and
@@ -219,12 +226,15 @@ def _bench_lr(device, timed_calls):
         # per-batch dispatches cost ~5ms each through the tunnel, which
         # swamps a9a-scale step compute and made TPU lose to CPU 16x in
         # round 2's first on-chip run
-        multi = model._build_multi_step()
+        dense = model.dense_enabled()
+        multi = (model._build_dense_multi() if dense
+                 else model._build_multi_step())
         prepared = []
         for b in batches:
             slots = model.table.key_index.lookup(
                 np.where(b.mask, b.feat_ids, 0))
-            prepared.append((slots, b.feat_vals, b.mask, b.targets))
+            cols = (slots, b.feat_vals, b.mask, b.targets)
+            prepared.append(model._densify(*cols) if dense else cols)
         stacked = tuple(
             jax.device_put(jnp.asarray(np.stack(col)), device)
             for col in zip(*prepared))
@@ -255,7 +265,8 @@ def _bench_lr(device, timed_calls):
         dt = time.perf_counter() - t0
     rows = len(prepared) * LR_BATCH * E * timed_calls
     return {"rows_per_sec": rows / dt, "loss": float(loss),
-            "epochs_per_dispatch": E}
+            "epochs_per_dispatch": E,
+            "rendering": "dense" if dense else "sparse"}
 
 
 def _bench_s2v(device, timed_calls, model):

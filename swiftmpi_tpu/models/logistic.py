@@ -75,8 +75,15 @@ class LogisticRegression:
         self.inner_steps = (
             self.config.get("worker", "inner_steps").to_int32()
             if self.config.has("worker", "inner_steps") else 1)
+        # [worker] dense_features: auto|0|1 — capacity-dense rendering
+        # for small feature spaces (see _dense_core)
+        self.dense_features = (
+            self.config.get("worker", "dense_features").to_string()
+            if self.config.has("worker", "dense_features") else "auto")
         self._step = None
         self._multi = None
+        self._dense_step = None
+        self._dense_multi = None
 
     # -- fused minibatch step ---------------------------------------------
     def _step_core(self, state, slots, vals, mask, targets):
@@ -102,8 +109,8 @@ class LogisticRegression:
     def _build_step(self):
         return jax.jit(self._step_core)
 
-    def _build_multi_step(self):
-        """Scan the fused step over a stack of minibatches in ONE dispatch.
+    def _build_scan(self, core):
+        """Scan a fused step over a stack of minibatches in ONE dispatch.
 
         The reference amortizes per-batch overhead with 13 worker threads
         per rank (lr.cpp:225); on TPU the equivalent lever is fusing the
@@ -113,15 +120,87 @@ class LogisticRegression:
         losses/counts so the training-error log stays per-minibatch."""
 
         @jax.jit
-        def multi(state, slots, vals, mask, targets):
+        def multi(state, *cols):
             def body(state, xs):
-                state, loss, n = self._step_core(state, *xs)
+                state, loss, n = core(state, *xs)
                 return state, (loss, n)
-            state, (losses, ns) = jax.lax.scan(
-                body, state, (slots, vals, mask, targets))
+            state, (losses, ns) = jax.lax.scan(body, state, cols)
             return state, losses, ns
 
         return multi
+
+    def _build_multi_step(self):
+        return self._build_scan(self._step_core)
+
+    # -- dense-features rendering -----------------------------------------
+    # At a9a scale (123 features, capacity ~160) the padded-sparse step
+    # is transaction-bound: B*F scalar weight gathers + a scatter push,
+    # each ~10ns on chip regardless of width, cap the step far below
+    # both the MXU and the CPU baseline (round-2 live window: 0.06x
+    # CPU).  When the whole weight table is small, the TPU-first shape
+    # is capacity-DENSE: densify each minibatch host-side once and the
+    # step becomes two skinny MXU matmuls (X @ w, X^T @ err) plus a
+    # dense AdaGrad apply — identical math (same per-key contribution
+    # and count multiset, so the mean normalization and update rule
+    # match the sparse push bit-for-bit modulo float summation order),
+    # zero per-row transactions.  The sparse rendering remains the
+    # general path for url/kdd-scale feature spaces.
+
+    DENSE_CAP_LIMIT = 2048
+
+    def dense_enabled(self) -> bool:
+        mode = self.dense_features.lower()
+        if mode in ("0", "off", "false"):
+            return False
+        if mode in ("1", "on", "true"):
+            return True
+        # auto: an MXU play — on CPU the densified batches move ~5x the
+        # bytes of the padded-sparse layout and measure ~7x slower than
+        # the sparse step, so auto only flips when THIS model's devices
+        # are TPUs (not jax.devices()[0]: a process can expose both, and
+        # a CPU-pinned run must not inherit the TPU verdict)
+        dev = self.cluster.mesh.devices.flat[0]
+        return (dev.platform == "tpu"
+                and self.table.capacity <= self.DENSE_CAP_LIMIT)
+
+    def _densify(self, slots, vals, mask, targets):
+        """(B, F) padded-sparse batch -> capacity-dense ``(X, cnt, t, v)``:
+        ``X[b, slot] += val`` and ``cnt[slot] += 1`` per valid
+        (row, feature) occurrence — the same contribution and count
+        multiset the sparse push sees (duplicate features in one row
+        accumulate in both, as in the reference's per-key grad/count)."""
+        cap = self.table.capacity
+        B, F = slots.shape
+        X = np.zeros((B, cap), np.float32)
+        cnt = np.zeros((cap,), np.float32)
+        m = np.asarray(mask, bool)
+        rows = np.broadcast_to(np.arange(B)[:, None], (B, F))
+        np.add.at(X, (rows[m], np.asarray(slots)[m]),
+                  np.asarray(vals, np.float32)[m])
+        # only the per-slot total ever feeds the mean normalization, so
+        # ship the (cap,) reduction, not a (B, cap) presence matrix
+        np.add.at(cnt, np.asarray(slots)[m], 1.0)
+        return (X, cnt, np.asarray(targets, np.float32), m.any(axis=1))
+
+    def _dense_core(self, state, X, cnt, targets, valid):
+        access = self.access
+        w = state["val"][:, 0].astype(jnp.float32)        # (cap,)
+        predict = jax.nn.sigmoid(X @ w)
+        err = jnp.where(valid, targets - predict, 0.0)
+        grad = X.T @ err                                  # (cap,) MXU
+        mean_grad = grad / jnp.maximum(cnt, 1.0)
+        new_fields = access.apply_push(state,
+                                       {"val": mean_grad[:, None]})
+        state = {**state, **new_fields}
+        n = valid.sum()
+        loss = jnp.sum(err * err) / jnp.maximum(n, 1)
+        return state, loss, n
+
+    def _build_dense_step(self):
+        return jax.jit(self._dense_core)
+
+    def _build_dense_multi(self):
+        return self._build_scan(self._dense_core)
 
     # -- training (lr.cpp:157-240) ----------------------------------------
     def train(self, data, niters: int = 1,
@@ -157,18 +236,26 @@ class LogisticRegression:
             nonlocal state
             if not group:
                 return
-            if len(group) == inner and inner > 1:
+            entries = group
+            if self.dense_enabled():
+                entries = [self._densify(*e) for e in entries]
+                if self._dense_step is None:
+                    self._dense_step = self._build_dense_step()
+                    self._dense_multi = self._build_dense_multi()
+                one, many = self._dense_step, self._dense_multi
+            else:
+                one, many = self._step, self._multi
+            if len(entries) == inner and inner > 1:
                 stacked = tuple(
-                    jnp.asarray(np.stack(col)) for col in zip(*group))
-                state, ls, ns = self._multi(state, *stacked)
+                    jnp.asarray(np.stack(col)) for col in zip(*entries))
+                state, ls, ns = many(state, *stacked)
                 queue(ls, ns)
             else:
                 # tail (or pre-grow flush) smaller than a full group:
                 # per-batch dispatch avoids a recompile per distinct size
-                for slots, vals, mask, targets in group:
-                    state, loss, n = self._step(
-                        state, jnp.asarray(slots), jnp.asarray(vals),
-                        jnp.asarray(mask), jnp.asarray(targets))
+                for cols in entries:
+                    state, loss, n = one(
+                        state, *(jnp.asarray(c) for c in cols))
                     queue(loss, n)
             group.clear()
 
@@ -195,6 +282,11 @@ class LogisticRegression:
                         self._step = self._build_step()
                         self._multi = (self._build_multi_step()
                                        if inner > 1 else None)
+                        # dense programs bake in the old capacity too;
+                        # rebuilt lazily at next flush (growth may also
+                        # have pushed capacity past the dense limit)
+                        self._dense_step = None
+                        self._dense_multi = None
                         state = self.table.state
                 group.append((slots, batch.feat_vals, batch.mask,
                               batch.targets))
@@ -253,4 +345,6 @@ class LogisticRegression:
         # train()
         self._step = None
         self._multi = None
+        self._dense_step = None
+        self._dense_multi = None
         return n

@@ -69,6 +69,52 @@ def test_lr_inner_steps_matches_per_batch_training(devices8):
     np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
+def test_lr_dense_rendering_matches_sparse(devices8):
+    """[worker] dense_features: the capacity-dense rendering (two MXU
+    matmuls per step, host-densified batches) must reproduce the sparse
+    pull/push path — same per-key contribution and count multiset, so
+    identical losses and weights modulo float summation order.  Runs
+    both singly and through the inner_steps scan."""
+    data = synthetic_dataset(400, dim=50, nnz=5, seed=3)
+    want = make_model(worker={"minibatch": 50,
+                              "dense_features": "0"}).train(data, niters=3)
+    m = make_model(worker={"minibatch": 50, "dense_features": "1"})
+    assert m.dense_enabled()
+    got = m.train(data, niters=3)
+    np.testing.assert_allclose(got, want, rtol=2e-4)
+    want_scan = make_model(
+        worker={"minibatch": 50, "inner_steps": 4,
+                "dense_features": "0"}).train(data, niters=3)
+    got_scan = make_model(
+        worker={"minibatch": 50, "inner_steps": 4,
+                "dense_features": "1"}).train(data, niters=3)
+    np.testing.assert_allclose(got_scan, want_scan, rtol=2e-4)
+
+
+def test_lr_dense_auto_gate():
+    """auto = on only on a TPU device AND when the whole table fits the
+    dense limit; explicit 0/1 override either way."""
+    small = make_model()          # capacity 2048*2 > limit -> sparse
+    assert not small.dense_enabled()
+    cfg = ConfigParser().update({
+        "cluster": {"server_num": 1, "transfer": "xla"},
+        "worker": {"minibatch": 50},
+        "server": {"initial_learning_rate": 0.5, "frag_num": 200},
+    })
+    tiny = LogisticRegression(config=cfg, capacity_per_shard=256)
+    # tests run on the CPU platform: auto stays sparse there (the dense
+    # rendering is an MXU play, ~7x slower than sparse on CPU)
+    assert not tiny.dense_enabled()
+    assert LogisticRegression(
+        config=cfg.update({"worker": {"dense_features": "1",
+                                      "minibatch": 50}}),
+        capacity_per_shard=256).dense_enabled()
+    assert not LogisticRegression(
+        config=cfg.update({"worker": {"dense_features": "0",
+                                      "minibatch": 50}}),
+        capacity_per_shard=256).dense_enabled()
+
+
 def test_lr_predict_range_and_shape(devices8):
     data = synthetic_dataset(60, dim=30, nnz=4, seed=1)
     model = make_model()
