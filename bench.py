@@ -241,7 +241,7 @@ def _bench_lr(device, timed_calls):
         state = {f: jax.device_put(v, device)
                  for f, v in model.table.state.items()}
 
-        E = int(os.environ.get("BENCH_LR_EPOCHS", "8"))
+        E = int(os.environ.get("BENCH_LR_EPOCHS", "32"))
 
         @jax.jit
         def epochs_fn(state):

@@ -204,10 +204,14 @@ def pallas_ab():
         tag = f"{method}{blk}" if method == "loop" else method
         try:
             # correctness first: a Mosaic-lowering divergence must
-            # never flip the gate onto wrong numerics
-            got = np.asarray(vmem_gather(tf32, small_idx,
+            # never flip the gate onto wrong numerics (slice must be a
+            # block multiple: one block for big-block variants)
+            chk = idx3[:max(8192, blk)]
+            want_chk = want if chk.shape[0] == small_idx.shape[0] \
+                else np.asarray(jnp.take(tf32, chk, axis=0))
+            got = np.asarray(vmem_gather(tf32, chk,
                                          idx_block=blk, method=method))
-            correct = bool(np.allclose(got, want))
+            correct = bool(np.allclose(got, want_chk))
             pg = jax.jit(lambda t, i, m=method, b=blk:
                          vmem_gather(t, i, idx_block=b, method=m).sum())
             ms = timeit(pg, tf32, idx3) * 1e3

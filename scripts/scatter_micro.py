@@ -43,6 +43,18 @@ fscat = jax.jit(lambda i, g: jnp.zeros((capw, d + 1), jnp.float32)
                 .at[i].add(g).sum())
 
 
+def replica_scatter(i, g, lane, R):
+    """The replica-spread formulation both the exploratory cell and the
+    verdict-recording A/B measure — one copy so tuning it (e.g. lane
+    hashing) can't make the exploratory numbers drift from the gate."""
+    return jnp.zeros((R, capw, d + 1), jnp.float32).at[lane, i].add(
+        g).sum(axis=0)
+
+
+def replica_lanes(R):
+    return jnp.asarray(np.arange(Nw) % R, jnp.int32)
+
+
 def exploratory_cells():
     N = 114688          # LR bench: 8192 rows x 14 nnz
     g = jnp.asarray(rng.standard_normal((N, 1)), jnp.float32)
@@ -69,6 +81,34 @@ def exploratory_cells():
           f"{timeit(cnt, gi):7.2f} ms", flush=True)
     print(f"w2v fused grads+count scatter (x101)   : "
           f"{timeit(fscat, gi, g1):7.2f} ms", flush=True)
+    # replica-spread scatter: with ~20x slot duplication the RMW chains
+    # serialize; spreading colliding rows over R replica tables (then
+    # one dense reduce) shortens the chains R-fold at the cost of R x
+    # table memory + a streaming sum.  If the 7ms fused scatter is
+    # collision-serialization-bound this wins; if it's RMW-transaction-
+    # bound it won't move.  (Round-3: scatter is now ~60% of the step.)
+    for R in (4, 8):
+        fn = jax.jit(lambda i, g, l, R=R: replica_scatter(i, g, l, R).sum())
+        print(f"w2v replica-{R} scatter (x101)          : "
+              f"{timeit(fn, gi, g1, replica_lanes(R)):7.2f} ms", flush=True)
+    # bf16 payload: half the scatter write bytes (RMW read stays fp32
+    # accumulate? no — whole table bf16) — tells transaction- vs
+    # byte-bound apart on the write side
+    g1h = g1.astype(jnp.bfloat16)
+    fscat16 = jax.jit(lambda i, g: jnp.zeros((capw, d + 1), jnp.bfloat16)
+                      .at[i].add(g).sum())
+    print(f"w2v fused scatter bf16 (x101)          : "
+          f"{timeit(fscat16, gi, g1h):7.2f} ms", flush=True)
+    # pre-dedup via 16-bit sort: keys < 2^15, values carried as the
+    # PERMUTATION (argsort) — jnp.argsort of int32 was the 16ms cost;
+    # sort_key_val on (key, iota) may beat it
+    def sortseg(i, g):
+        si, order = jax.lax.sort_key_val(i, jnp.arange(Nw, dtype=jnp.int32))
+        sg = g[order]
+        return jnp.zeros((capw, d + 1), jnp.float32).at[si].add(
+            sg, indices_are_sorted=True).sum()
+    print(f"w2v sorted scatter (sort_key_val)      : "
+          f"{timeit(jax.jit(sortseg), gi, g1):7.2f} ms", flush=True)
     # alias sampling cost at bench shape: 2 scalar gathers per draw from
     # the 30K-entry alias arrays — a hidden transaction cost?
     from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
@@ -79,6 +119,47 @@ def exploratory_cells():
                                           (16384, 20)).sum())
     print(f"alias sampling (16384 x 20 draws)      : "
           f"{timeit(samp, jax.random.key(0)):7.2f} ms", flush=True)
+
+
+def replica_ab():
+    """Replica-spread scatter A/B at the w2v fused grads+count shape —
+    records the ``replica_scatter`` verdict gating transfer/xla.py's
+    push (see _push_dense._scatter).  Correctness checked per R before
+    timing; a loss records win=False and the gate stays closed."""
+    from swiftmpi_tpu.ops import calibration
+
+    print(f"replica A/B device: {jax.devices()[0]}", flush=True)
+    xla_ms = timeit(fscat, gi, g1)
+    print(f"xla fused scatter (x101 -> 17314)      : {xla_ms:7.2f} ms",
+          flush=True)
+    nchk = 16384
+    want = np.asarray(jnp.zeros((capw, d + 1), jnp.float32)
+                      .at[gi[:nchk]].add(g1[:nchk]))
+    cells = {}
+    for R in (4, 8):
+        lane = replica_lanes(R)
+        got = np.asarray(jax.jit(
+            lambda i, g, l, R=R: replica_scatter(i, g, l, R))(
+            gi[:nchk], g1[:nchk], lane[:nchk]))
+        ok = bool(np.allclose(got, want, rtol=1e-5, atol=1e-5))
+        ms = timeit(jax.jit(lambda i, g, l, R=R:
+                            replica_scatter(i, g, l, R).sum()),
+                    gi, g1, lane)
+        print(f"replica-{R} scatter: {ms:7.2f} ms  correct={ok}",
+              flush=True)
+        if ok:
+            cells[R] = ms
+    if cells:
+        best = min(cells, key=cells.get)
+        calibration.ab_verdict("replica_scatter", xla_ms, cells[best],
+                               correct=True,
+                               shape=f"cap={capw} w={d+1} fp32 N={Nw}",
+                               extra={"R": best, "cells": {
+                                   str(r): round(m, 3)
+                                   for r, m in cells.items()}})
+    else:
+        calibration.ab_verdict("replica_scatter", xla_ms,
+                               error="no correct replica cell")
 
 
 def pallas_ab():
@@ -116,7 +197,9 @@ def pallas_ab():
 if __name__ == "__main__":
     if "--ab-only" in sys.argv:
         pallas_ab()
+        replica_ab()
     else:
         exploratory_cells()
         if "--no-ab" not in sys.argv:
             pallas_ab()
+            replica_ab()

@@ -96,6 +96,34 @@ def test_push_mean_equivalence(w2v_setup):
                 err_msg=f"{backend.name}:{f}")
 
 
+def test_push_replica_scatter_gate_matches_plain(w2v_setup, monkeypatch):
+    """With a (simulated) recorded replica_scatter win, the dense push
+    routes through R replica tables + a fold-back sum — results must be
+    bit-close to the ungated scatter; and the gate must stay closed on
+    budget overflow."""
+    from swiftmpi_tpu.ops import calibration
+    from swiftmpi_tpu.transfer import xla as xla_mod
+
+    mesh, access, table, slots, grads, state_np = w2v_setup
+    want = XlaTransfer(dense_apply=True).push(
+        table.state, slots, grads, access, mean=True)
+    monkeypatch.setattr(calibration, "on_tpu", lambda: True)
+    monkeypatch.setattr(calibration, "device_key", lambda: "fake-tpu")
+    monkeypatch.setattr(
+        calibration, "lookup",
+        lambda name, key: {"win": True, "R": 4}
+        if name == "replica_scatter" else None)
+    assert xla_mod._replica_R(100, 10) == 4
+    got = XlaTransfer(dense_apply=True).push(
+        table.state, slots, grads, access, mean=True)
+    for f in access.fields:
+        np.testing.assert_allclose(
+            np.asarray(want[f]), np.asarray(got[f]), rtol=1e-5,
+            atol=1e-6, err_msg=f)
+    # budget: R * capacity * width * 4 over ~256MB closes the gate
+    assert xla_mod._replica_R(1 << 20, 128) == 0
+
+
 def test_push_sums_duplicate_slots(devices8):
     # Two pushes of the same slot in one batch must combine by SUM before a
     # single AdaGrad application (api.py semantics).
