@@ -87,7 +87,7 @@ def exploratory_cells():
     # table memory + a streaming sum.  If the 7ms fused scatter is
     # collision-serialization-bound this wins; if it's RMW-transaction-
     # bound it won't move.  (Round-3: scatter is now ~60% of the step.)
-    for R in (4, 8):
+    for R in (4, 8, 16):
         fn = jax.jit(lambda i, g, l, R=R: replica_scatter(i, g, l, R).sum())
         print(f"w2v replica-{R} scatter (x101)          : "
               f"{timeit(fn, gi, g1, replica_lanes(R)):7.2f} ms", flush=True)
@@ -136,7 +136,7 @@ def replica_ab():
     want = np.asarray(jnp.zeros((capw, d + 1), jnp.float32)
                       .at[gi[:nchk]].add(g1[:nchk]))
     cells = {}
-    for R in (4, 8):
+    for R in (4, 8, 16):
         lane = replica_lanes(R)
         got = np.asarray(jax.jit(
             lambda i, g, l, R=R: replica_scatter(i, g, l, R))(
