@@ -172,15 +172,19 @@ class XlaTransfer(Transfer):
 
         inv = None
         if mean:
+            # seg_ids ascend (cumsum of non-negatives): tell XLA so the
+            # scatter lowering can skip the general collision machinery
             seg_counts = jnp.zeros((B,), jnp.float32).at[seg_ids].add(
-                valid[order].astype(jnp.float32), mode="drop")
+                valid[order].astype(jnp.float32), mode="drop",
+                indices_are_sorted=True)
             inv = (1.0 / jnp.maximum(seg_counts, 1.0))[:, None]
         combined = {}
         for f in grads:
             g = jnp.asarray(grads[f])[order]
             width = g.shape[1]
             acc = jnp.zeros((B, width), g.dtype)
-            acc = acc.at[seg_ids].add(g, mode="drop")
+            acc = acc.at[seg_ids].add(g, mode="drop",
+                                      indices_are_sorted=True)
             combined[f] = acc * inv if mean else acc
 
         # only the fields this push's grad families actually update are
@@ -192,6 +196,13 @@ class XlaTransfer(Transfer):
 
         out = dict(state)
         for f in updated:
-            # Unused segments' representatives stay == capacity: OOB, dropped.
-            out[f] = state[f].at[rep_slots].set(updated[f], mode="drop")
+            # Unused segments' representatives stay == capacity: OOB,
+            # dropped.  rep_slots are ascending AND one-per-segment by
+            # construction (duplicates exist only among the dropped
+            # capacity-fill tail), so the scatter-set needs no collision
+            # handling — the hints cut the large-capacity scatter cost
+            # (the 1M-vocab step's measured bound).
+            out[f] = state[f].at[rep_slots].set(
+                updated[f], mode="drop", indices_are_sorted=True,
+                unique_indices=True)
         return out
