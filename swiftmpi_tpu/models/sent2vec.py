@@ -36,7 +36,8 @@ import numpy as np
 
 from swiftmpi_tpu.data.text import tokenize
 from swiftmpi_tpu.models.word2vec import Word2Vec
-from swiftmpi_tpu.ops.sampling import build_unigram_alias, sample_alias
+from swiftmpi_tpu.ops.sampling import (build_unigram_alias,
+                                       sample_alias_slots)
 from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
 from swiftmpi_tpu.utils.config import ConfigParser
 from swiftmpi_tpu.utils.hashing import bkdr_hash
@@ -86,8 +87,11 @@ class Sent2Vec:
                 sent_vec, key = carry
                 key, kb, kn = jax.random.split(key, 3)
                 b = jax.random.randint(kb, (S, L), 0, W)    # window shrink
-                negs_v = sample_alias(kn, alias_prob, alias_idx, (S, L, K))
-                neg_slots = slot_of_vocab[negs_v]
+                # fused draw+slot lookup: (S, L, K) negatives per pass
+                # is the dominant transaction count of the whole
+                # inference — see ops/sampling.sample_alias_slots
+                negs_v, neg_slots = sample_alias_slots(
+                    kn, alias_prob, alias_idx, slot_of_vocab, (S, L, K))
 
                 def pos_step(sv, p):
                     ctx_idx = p + offsets                    # (2W,)
