@@ -49,15 +49,23 @@ def main():
     mask = jax.device_put(jnp.asarray(b0.ctx_mask), dev)
     key = jax.random.key(3)
 
-    from swiftmpi_tpu.models.word2vec import _assemble_push
-    from swiftmpi_tpu.ops.sampling import sample_alias
+    from swiftmpi_tpu.models.word2vec import _assemble_push, _cbow_targets
+    from swiftmpi_tpu.ops.sampling import sample_alias_slots
     from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
 
+    def phase_a0(state, key):
+        # sampling alone (the fused (V,4)-row draw, as the real step
+        # samples — round-3's biggest single step win; this cell is the
+        # before/after record)
+        negs, neg_slots = sample_alias_slots(key, ap, ai, sov, (B, K))
+        return negs.sum() + neg_slots.sum() + state["h"][0, 0]
+
     def phase_a(state, key):
-        negs = sample_alias(key, ap, ai, (B, K))
-        targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
-        t_slots = sov[targets_v]
-        ctx_slots = jnp.where(mask, sov[contexts], -1)
+        # target assembly + row pulls, via the SAME shared helper the
+        # real step uses (_cbow_targets) so this ablation can't drift
+        # from the production phase structure
+        t_slots, ctx_slots, t_valid = _cbow_targets(
+            sov, ap, ai, centers, contexts, mask, key, K)
         h_t = jnp.take(state["h"], jnp.clip(t_slots.reshape(-1), 0, cap - 1),
                        axis=0)
         v_ctx = jnp.take(state["v"],
@@ -65,14 +73,8 @@ def main():
         return h_t.sum() + v_ctx.sum()
 
     def _grads(state, key):
-        negs = sample_alias(key, ap, ai, (B, K))
-        targets_v = jnp.concatenate([centers[:, None], negs], axis=1)
-        t_slots = sov[targets_v]
-        ctx_slots = jnp.where(mask, sov[contexts], -1)
-        row_valid = mask.any(axis=1)
-        t_valid = jnp.concatenate(
-            [jnp.ones((B, 1), bool), negs != centers[:, None]], axis=1)
-        t_valid = t_valid & row_valid[:, None]
+        t_slots, ctx_slots, t_valid = _cbow_targets(
+            sov, ap, ai, centers, contexts, mask, key, K)
         t_slots = jnp.where(t_valid, t_slots, -1)
         h_t = jnp.take(state["h"], jnp.clip(t_slots.reshape(-1), 0, cap - 1),
                        axis=0).reshape(B, K + 1, d)
@@ -120,7 +122,9 @@ def main():
                        f"AdaGrad sweep {cap * d * 4 * 2 * mb:.0f} MB",
     }
     reps = int(os.environ.get("PROFILE_REPS", "8"))
-    for name, fn in (("a_gathers", phase_a), ("b_+gradmath", phase_b),
+    notes["a0_sampling"] = f"~{B * K * 16e-6:.0f} MB packed rows"
+    for name, fn in (("a0_sampling", phase_a0),
+                     ("a_gathers", phase_a), ("b_+gradmath", phase_b),
                      ("c_+meanscale", phase_c), ("d_full_step", phase_d)):
         jf = jax.jit(fn)
         out = jf(state, key)
