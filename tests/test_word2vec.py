@@ -26,6 +26,28 @@ def test_alias_sampler_matches_unigram_075():
     np.testing.assert_allclose(freq, expect, atol=0.01)
 
 
+def test_sample_alias_slots_is_fused_sample_plus_lookup():
+    """The fused sampler must stay draw-stream BIT-IDENTICAL to
+    sample_alias + slot_of_vocab[negs] — training uses the fused form
+    while the oracle-parity tests reproduce negatives via sample_alias,
+    so any drift would silently unpin the golden checks."""
+    import numpy as np
+    rng = np.random.default_rng(5)
+    counts = rng.integers(1, 500, 777)
+    prob, alias = build_unigram_alias(counts)
+    prob_d, alias_d = jnp.asarray(prob), jnp.asarray(alias)
+    sov = jnp.asarray(rng.permutation(2048)[:777].astype(np.int32))
+    from swiftmpi_tpu.ops.sampling import sample_alias_slots
+    for shape in ((64, 20), (8, 4, 5)):
+        key = jax.random.key(11)
+        negs, neg_slots = sample_alias_slots(
+            key, prob_d, alias_d, sov, shape)
+        want = sample_alias(key, prob_d, alias_d, shape)
+        np.testing.assert_array_equal(np.asarray(negs), np.asarray(want))
+        np.testing.assert_array_equal(
+            np.asarray(neg_slots), np.asarray(sov)[np.asarray(negs)])
+
+
 def test_subsample_keep_prob_rule():
     counts = np.array([1000, 10], np.float64)
     keep = subsample_keep_prob(counts, sample=0.01)
