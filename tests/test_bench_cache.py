@@ -79,3 +79,164 @@ def test_parent_degraded_output_embeds_last_known_tpu(monkeypatch,
     assert lk["words_per_sec"] == 794365.3
     assert lk["age_hours"] < 1.0
     assert lk["result"]["w2v"]["rendering"] == "gather"
+
+
+def test_merge_cached_tpu_fields(tmp_path, monkeypatch):
+    """A standalone BENCH_ONLY=lr chip cell merged into the canonical
+    cache must surface in degraded output's last_known_tpu (the
+    short-window scenario the bench_lr agenda stage exists for)."""
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "device_kind": "TPU v5 lite",
+         "w2v": {"words_per_sec": 1402717.3, "rendering": "gather"},
+         "lr": {"rows_per_sec": 3000676.1, "rendering": "dense"}})
+    assert bench._merge_cached_tpu_fields(
+        {"lr": {"rows_per_sec": 14000000.0, "rendering": "dense"}}) is None
+    lk = bench._last_known_tpu()
+    assert lk["result"]["lr"]["rows_per_sec"] == 14000000.0
+    assert lk["result"]["w2v"]["words_per_sec"] == 1402717.3  # untouched
+    assert "lr" in lk["merged"]
+
+
+def test_merge_without_canonical_cache_creates_minimal_record(tmp_path,
+                                                              monkeypatch):
+    """First chip evidence of a fresh checkout: a standalone cell must
+    still become canonical (review finding: silent drop)."""
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path / "none"))
+    assert bench._merge_cached_tpu_fields(
+        {"lr": {"rows_per_sec": 1.0}}) is None
+    lk = bench._last_known_tpu()
+    assert lk["result"]["lr"]["rows_per_sec"] == 1.0
+    assert "lr" in lk["merged"]
+
+
+def test_partial_full_result_carries_forward_merged_fields(tmp_path,
+                                                           monkeypatch):
+    """A timed-out bench_full child whose partial result lacks the lr
+    cell must not erase a fresher standalone-merged lr from the
+    canonical cache (review finding: partial overwrite data loss)."""
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 1.0e6},
+         "lr": {"rows_per_sec": 3.0e6}})
+    bench._merge_cached_tpu_fields({"lr": {"rows_per_sec": 1.4e7}})
+    # partial full-bench result: w2v only (child killed before lr)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 1.1e6}})
+    lk = bench._last_known_tpu()
+    assert lk["result"]["w2v"]["words_per_sec"] == 1.1e6   # new cell
+    assert lk["result"]["lr"]["rows_per_sec"] == 1.4e7     # preserved
+    assert "lr" in lk["merged"]                            # provenance
+
+
+def test_degraded_output_carries_merged_provenance(monkeypatch, tmp_path,
+                                                   capsys):
+    import json
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 1.0e6}})
+    bench._merge_cached_tpu_fields({"lr": {"rows_per_sec": 1.4e7}})
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: False)
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda which, t, extra_env=None: (
+            {"platform": "cpu", "device": "TFRT_CPU_0",
+             "w2v": {"words_per_sec": 1.0e5, "step_ms": 2.0,
+                     "loss": 5.0, "rendering": "gather"}}, None, 1.0))
+    bench.parent_main()
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["last_known_tpu"]["result"]["lr"]["rows_per_sec"] == 1.4e7
+    assert "lr" in d["last_known_tpu"]["merged"]
+
+
+def test_partial_chip_run_folds_cached_fields_into_secondary(monkeypatch,
+                                                             tmp_path,
+                                                             capsys):
+    """bench_full child dies after the w2v cell; the cache still holds
+    a fresh bench_lr merge — the artifact's lr_a9a secondary must carry
+    that chip cell, labeled with its provenance (review finding)."""
+    import json
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 1.0e6}})
+    bench._merge_cached_tpu_fields(
+        {"lr": {"rows_per_sec": 1.4e7, "rendering": "dense"}})
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: True)
+
+    def fake_run_child(which, timeout_s, extra_env=None):
+        if which == "tpu":       # partial: died before the lr secondary
+            return ({"platform": "tpu", "device": "TPU v5 lite0",
+                     "w2v": {"words_per_sec": 1.1e6, "step_ms": 11.0,
+                             "loss": 5.0, "rendering": "gather"},
+                     "errors": {"_timeout": "child killed after 840s"}},
+                    None, 850.0)
+        return ({"platform": "cpu", "device": "TFRT_CPU_0",
+                 "w2v": {"words_per_sec": 1.0e5, "step_ms": 2.0,
+                         "loss": 5.0, "rendering": "gather"},
+                 "lr": {"rows_per_sec": 1.1e7}}, None, 1.0)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench.parent_main()
+    d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert d["value"] == 1.1e6                      # this run's chip w2v
+    sec = d["secondary"]["lr_a9a"]
+    assert sec["tpu"] == 1.4e7                      # cache-carried cell
+    assert sec["vs_baseline"] == round(1.4e7 / 1.1e7, 2)
+    assert "lr" in d["tpu_merged_from_cache"]       # labeled provenance
+
+
+def test_clean_full_run_does_not_inherit_stale_errors(tmp_path,
+                                                      monkeypatch):
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 1.0e6},
+         "errors": {"_timeout": "child killed after 840s"}})
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 1.1e6},
+         "lr": {"rows_per_sec": 1.0e7}})
+    lk = bench._last_known_tpu()
+    assert "errors" not in lk["result"]             # stale status dropped
+    assert lk["result"]["lr"]["rows_per_sec"] == 1.0e7
+
+
+def test_merge_on_fresh_cache_seeds_from_newest_archive(tmp_path,
+                                                        monkeypatch):
+    """No canonical record yet, but override-shape archives exist: the
+    created tpu_latest must inherit their measurements instead of
+    shadowing them with an lr-only record (review finding)."""
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("BENCH_ONLY", "w2v")      # override-shape archive
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 9.9e5},
+         "errors": {"_timeout": "x"}})
+    monkeypatch.delenv("BENCH_ONLY")
+    assert bench._merge_cached_tpu_fields(
+        {"lr": {"rows_per_sec": 1.4e7}}) is None
+    lk = bench._last_known_tpu()
+    assert lk["result"]["lr"]["rows_per_sec"] == 1.4e7
+    assert lk["result"]["w2v"]["words_per_sec"] == 9.9e5   # inherited
+    assert "errors" not in lk["result"]                    # status dropped
+    assert lk["seeded_from"]["overrides"] == {"BENCH_ONLY": "w2v"}
+
+
+def test_merge_on_corrupt_canonical_reports_diagnosis(tmp_path,
+                                                      monkeypatch):
+    import os
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    os.makedirs(str(tmp_path), exist_ok=True)
+    with open(os.path.join(str(tmp_path), "tpu_latest.json"), "w") as f:
+        f.write("{truncated")
+    err = bench._merge_cached_tpu_fields({"lr": {"rows_per_sec": 1.0}})
+    assert err is not None and "JSONDecodeError" in err
