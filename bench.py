@@ -209,7 +209,12 @@ def _bench_lr(device, timed_calls):
     cfg = ConfigParser().update({
         "cluster": {"transfer": "xla", "server_num": 1},
         "server": {"initial_learning_rate": 0.05, "frag_num": 2000},
-        "worker": {"minibatch": LR_BATCH},
+        "worker": {"minibatch": LR_BATCH,
+                   # per-epoch inner scan is only ~4 iterations at
+                   # B=8192; unrolling removes loop overhead per step
+                   # (chip A/B via the lr_unroll session stage)
+                   "scan_unroll": int(os.environ.get(
+                       "BENCH_LR_UNROLL", "1"))},
     })
     with jax.default_device(device):
         # capacity sized to the dataset (a9a: 123 features + bias), as
@@ -258,7 +263,9 @@ def _bench_lr(device, timed_calls):
             def ebody(st, _):
                 st, losses, ns = multi(st, *stacked)
                 return st, losses[-1]
-            st, lasts = jax.lax.scan(ebody, state, None, length=E)
+            st, lasts = jax.lax.scan(
+                ebody, state, None, length=E,
+                unroll=int(os.environ.get("BENCH_LR_EPOCH_UNROLL", "1")))
             return st, lasts[-1]
 
         state, loss = epochs_fn(state)                # warmup/compile
@@ -563,6 +570,7 @@ def child_main(which: str) -> None:
         # the LR measurement in its own ~1-compile child
         out["lr"] = _bench_lr(device, max(timed // 4, 1))
         print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
         return
     # emit after EVERY bench so a timeout/crash in a later (secondary)
     # bench never discards an already-measured number — the parent takes
@@ -618,6 +626,19 @@ def child_main(which: str) -> None:
         except Exception as e:
             out.setdefault("errors", {})[name] = f"{type(e).__name__}: {e}"
         print("BENCH_CHILD " + json.dumps(out), flush=True)
+    _cache_own_child_result(out, device)
+
+
+def _cache_own_child_result(out, device) -> None:
+    """DIRECT ``--child tpu`` invocations (chip_session's standalone
+    stages: BENCH_TEXT8/BENCH_SCALE/BENCH_ONLY=lr/...) never pass
+    through parent_main, which is where caching lives — the 01:43 UTC
+    window's text8 epoch cell (the north star's literal metric) was
+    measured on chip and yet absent from every .bench_cache archive.
+    Cache here unless the parent will (it sets BENCH_PARENT for its
+    children to avoid double archives)."""
+    if device.platform == "tpu" and not os.environ.get("BENCH_PARENT"):
+        _cache_tpu_result(out)
 
 
 # --------------------------------------------------------------------------
@@ -679,7 +700,19 @@ def _tpu_alive(timeout_s: float = 75) -> bool:
 CACHE_DIR = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                          ".bench_cache")
 _SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
-              "BENCH_SCALE", "BENCH_TFM", "BENCH_TEXT8", "BENCH_DENSE")
+              "BENCH_SCALE", "BENCH_TFM", "BENCH_TEXT8", "BENCH_DENSE",
+              "BENCH_LR_UNROLL", "BENCH_LR_EPOCH_UNROLL")
+
+
+def _atomic_write_json(path: str, obj) -> None:
+    """tmp + rename: a kill mid-write (window closing, OOM) must never
+    leave a truncated tpu_latest.json — _last_known_tpu would see the
+    file, fail to parse it, and return None without falling back to
+    the archives (the exact evidence loss this cache exists to stop)."""
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f)
+    os.replace(tmp, path)
 
 
 def _cache_tpu_result(tpu_res):
@@ -701,9 +734,8 @@ def _cache_tpu_result(tpu_res):
                # dict (parent_main distinguishes this-run fields from
                # cache-carried ones for provenance labeling)
                "result": dict(tpu_res)}
-        with open(os.path.join(CACHE_DIR,
-                               f"tpu_{int(rec['ts'])}.json"), "w") as f:
-            json.dump(rec, f)
+        _atomic_write_json(os.path.join(
+            CACHE_DIR, f"tpu_{int(rec['ts'])}.json"), rec)
         if not rec["overrides"]:
             latest = os.path.join(CACHE_DIR, "tpu_latest.json")
             # a PARTIAL new result (timed-out child) must not erase
@@ -725,8 +757,7 @@ def _cache_tpu_result(tpu_res):
             except (OSError, ValueError, KeyError, TypeError,
                     AttributeError):
                 pass
-            with open(latest, "w") as f:
-                json.dump(rec, f)
+            _atomic_write_json(latest, rec)
             return rec
     except OSError:
         pass      # caching must never break the bench
@@ -768,6 +799,13 @@ def _merge_cached_tpu_fields(fields: dict):
                     rec["seeded_from"] = {
                         "file": os.path.basename(cands[-1]),
                         "overrides": seed.get("overrides") or {}}
+                    # the record's own age/shape must reflect the SEED,
+                    # not the merge moment: a freshly-stamped copy of an
+                    # old override archive would pass freshness guards
+                    # (record_dense_verdict's 1h window) and present
+                    # override-shape numbers as a new canonical run
+                    rec["ts"] = seed.get("ts", rec["ts"])
+                    rec["iso"] = seed.get("iso", rec["iso"])
                 except Exception:
                     pass    # unreadable archive: plain minimal record
         if not isinstance(rec, dict):
@@ -775,8 +813,7 @@ def _merge_cached_tpu_fields(fields: dict):
         rec.setdefault("result", {}).update(fields)
         iso = time.strftime("%Y-%m-%dT%H:%M:%SZ", time.gmtime())
         rec.setdefault("merged", {}).update({k: iso for k in fields})
-        with open(path, "w") as f:
-            json.dump(rec, f)
+        _atomic_write_json(path, rec)
         return None
     except Exception as e:   # caching must never break the bench/session
         return f"{type(e).__name__}: {e}"
@@ -818,7 +855,8 @@ def _run_child(which: str, timeout_s: float, extra_env=None):
     env.update(extra_env or {})
     t0 = time.time()
     try:
-        proc = subprocess.run(
+        env["BENCH_PARENT"] = "1"    # parent does the caching; the
+        proc = subprocess.run(       # child must not double-archive
             [sys.executable, os.path.abspath(__file__), "--child", which],
             capture_output=True, text=True, timeout=timeout_s, env=env,
             cwd=os.path.dirname(os.path.abspath(__file__)))
@@ -1019,6 +1057,11 @@ def parent_main() -> None:
                 # window than measured_at (standalone-cell merges or
                 # carry-forwards past a partial full-bench result)
                 out["last_known_tpu"]["merged"] = lk["merged"]
+            if lk.get("seeded_from"):
+                # the record was bootstrapped from an override-shape
+                # archive (fresh cache) — label it, don't pass those
+                # numbers off as a canonical full run
+                out["last_known_tpu"]["seeded_from"] = lk["seeded_from"]
     print(json.dumps(out), flush=True)
 
 

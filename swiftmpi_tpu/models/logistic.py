@@ -80,6 +80,13 @@ class LogisticRegression:
         self.dense_features = (
             self.config.get("worker", "dense_features").to_string()
             if self.config.has("worker", "dense_features") else "auto")
+        # [worker] scan_unroll: lax.scan unroll factor for the fused
+        # multi-batch step — at a9a scale each iteration is microseconds
+        # of MXU work, so per-iteration loop overhead can dominate;
+        # unrolling lets XLA pipeline iterations (A/B'd on chip)
+        self.scan_unroll = (
+            self.config.get("worker", "scan_unroll").to_int32()
+            if self.config.has("worker", "scan_unroll") else 1)
         self._step = None
         self._multi = None
         self._dense_step = None
@@ -119,12 +126,15 @@ class LogisticRegression:
         Inputs carry a leading ``n_batches`` axis; returns per-batch
         losses/counts so the training-error log stays per-minibatch."""
 
+        unroll = max(1, self.scan_unroll)
+
         @jax.jit
         def multi(state, *cols):
             def body(state, xs):
                 state, loss, n = core(state, *xs)
                 return state, (loss, n)
-            state, (losses, ns) = jax.lax.scan(body, state, cols)
+            state, (losses, ns) = jax.lax.scan(body, state, cols,
+                                               unroll=unroll)
             return state, losses, ns
 
         return multi

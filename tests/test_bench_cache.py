@@ -240,3 +240,80 @@ def test_merge_on_corrupt_canonical_reports_diagnosis(tmp_path,
         f.write("{truncated")
     err = bench._merge_cached_tpu_fields({"lr": {"rows_per_sec": 1.0}})
     assert err is not None and "JSONDecodeError" in err
+
+
+def test_child_self_cache_guard(tmp_path, monkeypatch):
+    """Direct --child tpu invocations must archive their own results
+    (the 01:43 UTC text8 cell was measured and never cached); children
+    spawned by parent_main must not double-archive."""
+    import glob
+    import os
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    tpu_dev = type("D", (), {"platform": "tpu"})()
+    cpu_dev = type("D", (), {"platform": "cpu"})()
+    out = {"platform": "tpu", "w2v_text8": {"epoch_wall_s": 2.96}}
+
+    monkeypatch.setenv("BENCH_PARENT", "1")
+    bench._cache_own_child_result(out, tpu_dev)
+    assert not glob.glob(os.path.join(str(tmp_path), "tpu_*.json"))
+
+    monkeypatch.delenv("BENCH_PARENT")
+    bench._cache_own_child_result(out, cpu_dev)      # cpu: never cached
+    assert not glob.glob(os.path.join(str(tmp_path), "tpu_*.json"))
+
+    monkeypatch.setenv("BENCH_TEXT8", "1")           # override-shape
+    bench._cache_own_child_result(out, tpu_dev)
+    recs = glob.glob(os.path.join(str(tmp_path), "tpu_*.json"))
+    assert len(recs) == 1                            # archived
+    assert not os.path.exists(
+        os.path.join(str(tmp_path), "tpu_latest.json"))  # not canonical
+
+
+def test_merge_seed_inherits_archive_timestamp(tmp_path, monkeypatch):
+    """Seeding a fresh canonical record from an old override archive
+    must inherit the archive's ts/iso — a now-stamped copy would pass
+    freshness guards (e.g. the dense-verdict 1h window) and present
+    override-shape numbers as a new canonical run (review finding)."""
+    import json
+    import os
+    import time
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("BENCH_DTYPE", "bfloat16")      # override shape
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 9.9e5}})
+    monkeypatch.delenv("BENCH_DTYPE")
+    # age the archive by 2h
+    arch = [p for p in os.listdir(str(tmp_path)) if p != "tpu_latest.json"]
+    path = os.path.join(str(tmp_path), arch[0])
+    rec = json.load(open(path))
+    rec["ts"] -= 2 * 3600
+    rec["iso"] = "2026-07-31T00:00:00Z"
+    json.dump(rec, open(path, "w"))
+    assert bench._merge_cached_tpu_fields(
+        {"lr": {"rows_per_sec": 1.4e7}}) is None
+    lk = bench._last_known_tpu()
+    assert lk["age_hours"] >= 2.0                       # honest age
+    assert lk["seeded_from"]["overrides"] == {"BENCH_DTYPE": "bfloat16"}
+    assert lk["merged"]["lr"] != "2026-07-31T00:00:00Z"  # fresh field
+
+
+def test_cache_writes_are_atomic(tmp_path, monkeypatch):
+    """No writer may leave a truncated tpu_latest.json behind: all
+    paths go through _atomic_write_json (tmp + rename)."""
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    calls = []
+    real = bench._atomic_write_json
+    monkeypatch.setattr(bench, "_atomic_write_json",
+                        lambda p, o: (calls.append(p), real(p, o)))
+    bench._cache_tpu_result({"platform": "tpu",
+                             "w2v": {"words_per_sec": 1.0}})
+    bench._merge_cached_tpu_fields({"lr": {"rows_per_sec": 2.0}})
+    latest = [p for p in calls if p.endswith("tpu_latest.json")]
+    assert len(latest) == 2            # canonical write + merge write
+    assert len(calls) == 3             # + the timestamped archive

@@ -107,3 +107,23 @@ def test_ab_verdict_record_suppression(iso_cache, monkeypatch):
     monkeypatch.delenv("SMTPU_AB_RECORD")
     calibration.ab_verdict("vmem_gather", 5.0, 1.0, correct=True)
     assert calibration.lookup("vmem_gather", KIND)["win"]
+
+
+def test_nopallas_skip_predicate(iso_cache):
+    """The forced-gates-off bench cell only earns window time when a
+    kernel gate is actually armed (a recorded A/B win) FOR THIS
+    session's device kind — a v5e win never gates a v6e kernel."""
+    assert not chip_session._any_gate_armed()          # empty verdicts
+    calibration.record("vmem_gather", KIND,
+                       {"win": False, "pallas_ms": 5.4, "xla_ms": 5.0})
+    calibration.record("replica_scatter", KIND, {"win": False})
+    assert not chip_session._any_gate_armed()          # all losses
+    calibration.record("vmem_gather", KIND,
+                       {"win": True, "pallas_ms": 2.0, "xla_ms": 5.0})
+    assert chip_session._any_gate_armed()              # armed, any kind
+    assert chip_session._any_gate_armed(KIND)          # armed, this kind
+    # a win inherited from another TPU generation must not force the
+    # cell on this one
+    assert not chip_session._any_gate_armed("TPU v6e")
+    # unknown kind: errs toward running the cell
+    assert chip_session._any_gate_armed(None)
