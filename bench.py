@@ -136,7 +136,13 @@ def _build_w2v(device, w2v_overrides=None, inner_steps=None):
     with jax.default_device(device):
         model = Word2Vec(
             config=cfg, cluster=Cluster(cfg, devices=[device]).initialize())
-        corpus = synthetic_corpus(SENTENCES, VOCAB, SENT_LEN, seed=11)
+        # corpus scales with the batch so big-batch sweep cells can fill
+        # at least one full batch: at sample=1e-5 subsampling keeps only
+        # ~15-20% of tokens as centers (the 01:13 UTC sweep's 49152/65536
+        # cells died on the fixed 600-sentence corpus).  The default
+        # shape keeps the recorded 600-sentence corpus bit-for-bit.
+        n_sent = max(SENTENCES, (BATCH * 8) // SENT_LEN)
+        corpus = synthetic_corpus(n_sent, VOCAB, SENT_LEN, seed=11)
         model.build(corpus)
         step = model._build_multi_step(n_inner)
         batcher = CBOWBatcher(corpus, model.vocab, model.window,
@@ -328,7 +334,11 @@ def _bench_w2v_1m(device, timed_calls):
         "cluster": {"transfer": "xla", "server_num": 1},
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
                      "sample": -1, "learning_rate": 0.05},
-        "server": {"initial_learning_rate": 0.7, "frag_num": 1000},
+        # BENCH_DTYPE: the 1M-vocab regime is where half-width storage
+        # may pay (byte-bound gathers at large capacity — the 01:09 UTC
+        # grid halved the cap=262K gather in bf16)
+        "server": {"initial_learning_rate": 0.7, "frag_num": 1000,
+                   "dtype": os.environ.get("BENCH_DTYPE", "float32")},
         "worker": {"minibatch": 5000},
     })
     with jax.default_device(device):
@@ -582,6 +592,7 @@ def child_main(which: str) -> None:
         # tuning sweeps re-run the child across a shape grid; compiling
         # the five secondary programs per cell (~minutes of scarce
         # tunnel time each) would dwarf the one measurement they want
+        _cache_own_child_result(out, device)
         return
     def _shared():
         # TPU-first shared-negative-pool mode (docs/ARCHITECTURE.md):
@@ -608,8 +619,12 @@ def child_main(which: str) -> None:
         secondaries.append(("oracle", _bench_oracle))
         secondaries.append(("cpp_oracle", _bench_cpp_oracle))
     if os.environ.get("BENCH_SCALE"):
-        secondaries.append(
-            ("w2v_1m", lambda: _bench_w2v_1m(device, max(timed // 2, 1))))
+        # dedicated stage (chip_session bench_scale/_bf16): the 1M-vocab
+        # cell is the only secondary worth its wall-time there — running
+        # the five default secondaries first would spend the stage's
+        # budget before the cell it exists for (the BENCH_TEXT8 pattern)
+        secondaries = [
+            ("w2v_1m", lambda: _bench_w2v_1m(device, max(timed // 2, 1)))]
     if os.environ.get("BENCH_TFM"):
         secondaries.append(
             ("tfm", lambda: _bench_tfm(device, max(timed // 2, 1))))

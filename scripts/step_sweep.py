@@ -21,7 +21,8 @@ sys.path.insert(0, REPO)
 import bench  # noqa: E402
 
 DEFAULT_CELLS = [(8192, 16), (16384, 8), (16384, 16), (24576, 8),
-                 (32768, 8), (32768, 16), (49152, 4), (65536, 4)]
+                 (32768, 8), (32768, 16), (49152, 4), (49152, 8),
+                 (65536, 4), (65536, 8)]
 
 
 def run_cell(batch, scan, timeout_s=360):
