@@ -362,7 +362,10 @@ def _bench_w2v_1m(device, timed_calls):
                                     jax.random.key(0))
     return {"words_per_sec": B * INNER_STEPS * timed_calls / dt,
             "step_ms": dt / (timed_calls * INNER_STEPS) * 1e3,
-            "vocab": V, "capacity": model.table.capacity}
+            "vocab": V, "capacity": model.table.capacity,
+            # self-describing: the fp32 and bf16 scale cells must be
+            # distinguishable by content, not by stage/env metadata
+            "dtype": os.environ.get("BENCH_DTYPE", "float32")}
 
 
 def _write_corpus(corpus) -> str:
@@ -582,6 +585,15 @@ def child_main(which: str) -> None:
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
+    if os.environ.get("BENCH_ONLY") == "scale":
+        # dedicated 1M-vocab cell (chip_session bench_scale/_bf16):
+        # skipping the demo-shape primary build saves its compile —
+        # which the bf16 stage would pay TWICE over (BENCH_DTYPE
+        # changes the program) before reaching the one cell it wants
+        out["w2v_1m"] = _bench_w2v_1m(device, max(timed // 2, 1))
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
     # emit after EVERY bench so a timeout/crash in a later (secondary)
     # bench never discards an already-measured number — the parent takes
     # the last BENCH_CHILD line it can find
@@ -779,6 +791,24 @@ def _cache_tpu_result(tpu_res):
     return None
 
 
+# overrides that only SELECT which cells a child runs — results are
+# still canonical-shaped and safe to seed a fresh tpu_latest.json from.
+# Shape/dtype overrides (BENCH_BATCH/SCAN/DTYPE/...) are NOT: their
+# numbers mean something different under the canonical field names
+# (e.g. a bfloat16 w2v_1m seeded under the fp32 key).
+_SELECTION_ENV = {"BENCH_ONLY", "BENCH_SCALE", "BENCH_TFM",
+                  "BENCH_TEXT8"}
+
+
+def _seedable(path: str) -> bool:
+    try:
+        with open(path) as f:
+            rec = json.load(f)
+        return set((rec.get("overrides") or {})) <= _SELECTION_ENV
+    except Exception:
+        return False
+
+
 def _merge_cached_tpu_fields(fields: dict):
     """Merge freshly-measured sub-bench results (e.g. the standalone
     ``BENCH_ONLY=lr`` cell) into ``tpu_latest.json`` so a degraded
@@ -801,8 +831,8 @@ def _merge_cached_tpu_fields(fields: dict):
                    "iso": time.strftime("%Y-%m-%dT%H:%M:%SZ",
                                         time.gmtime()),
                    "overrides": {}, "result": {}}
-            cands = sorted(glob.glob(os.path.join(CACHE_DIR,
-                                                  "tpu_*.json")))
+            cands = [p for p in sorted(glob.glob(os.path.join(
+                CACHE_DIR, "tpu_*.json"))) if _seedable(p)]
             if cands:
                 try:
                     with open(cands[-1]) as f:

@@ -1,0 +1,282 @@
+"""Embedding similarity / analogy queries over trained word2vec output.
+
+The reference has no embedding eval at all — its word2vec README ends at
+the text dump (`/root/reference/src/apps/word2vec/README.md`; row layout
+word2vec.h:100-110), leaving nearest-neighbor checks to external
+scripts.  This closes that loop, and TPU-first: the entire similarity
+pass is ONE normalized matmul ``(V, d) @ (d, Q)`` on the MXU plus a
+``top_k`` — never a per-row host loop, so querying 1 word and 10K words
+cost the same dispatch.
+
+CLI (reference-style single-dash flags, `utils/cmdline.py`):
+
+    python -m swiftmpi_tpu.apps.w2v_eval -embeddings out.txt \
+        -query king,man [-topk 10] [-hash int|bkdr] [-words vocab.txt]
+    python -m swiftmpi_tpu.apps.w2v_eval -embeddings out.txt \
+        -analogy king:man::woman [-topk 5]
+
+``-hash`` mirrors the training key conventions (`data/text.py
+tokenize`): ``int`` = tokens are integer ids (sync variant),
+``bkdr`` = BKDR-hashed strings (async variant).  With ``bkdr``, pass
+``-words`` (any text file; its whitespace tokens are hashed) so results
+can be printed as words instead of raw keys.
+"""
+
+from __future__ import annotations
+
+import sys
+from functools import partial
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from swiftmpi_tpu.data.text import tokenize
+from swiftmpi_tpu.utils import CMDLine
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger("apps.w2v_eval")
+
+
+def _topk_scores(vecs, qt, k):
+    """One (V, d) @ (d, Q) matmul + top_k.  Module-level and jitted
+    with static k so repeated queries against the same index reuse the
+    compiled program (a per-call closure would re-trace every query).
+    Exclusions are handled host-side by the caller (over-fetch + drop)
+    so no (Q, V) mask is ever materialized."""
+    import jax
+
+    global _topk_scores_jit
+    if _topk_scores_jit is None:
+        @partial(jax.jit, static_argnames=("k",))
+        def f(vecs, qt, k):
+            return jax.lax.top_k((vecs @ qt).T, k)   # (Q, V) — MXU
+        _topk_scores_jit = f
+    return _topk_scores_jit(vecs, qt, k)
+
+
+_topk_scores_jit = None
+
+
+class EmbeddingIndex:
+    """In-memory cosine-similarity index over dumped embeddings.
+
+    Rows are L2-normalized once at construction; every query batch is a
+    single ``(V, d) @ (d, Q)`` matmul + ``top_k``.
+    """
+
+    def __init__(self, keys: np.ndarray, vecs: np.ndarray):
+        if len(keys) != len(vecs):
+            raise ValueError(f"{len(keys)} keys vs {len(vecs)} vectors")
+        self.keys = np.asarray(keys, np.uint64)
+        vecs = np.asarray(vecs, np.float32)
+        norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+        self.vecs = vecs / np.maximum(norms, 1e-12)
+        self._row_of = {int(k): i for i, k in enumerate(self.keys)}
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @classmethod
+    def from_text(cls, path: str, field: str = "v") -> "EmbeddingIndex":
+        """Parse a ``dump_table_text`` w2v dump: ``key TAB v-floats TAB
+        h-floats`` per row (reference WParam operator<< layout,
+        word2vec.h:100-110).  ``field`` picks the input-side (``v``) or
+        output-side (``h``) vectors."""
+        if field not in ("v", "h"):
+            raise ValueError(f"field must be 'v' or 'h', got {field!r}")
+        col = 1 if field == "v" else 2
+        # native C++ reader (the same one load_table_text routes
+        # through): millions of Python float() calls vs one pass
+        d = None
+        with open(path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) > col:
+                    d = len(parts[col].split())
+                break
+        if d:
+            from swiftmpi_tpu.data import native
+
+            if native.available():
+                try:
+                    keys_np, arrs = native.load_rows_native(path, [d, d])
+                    if len(keys_np):
+                        return cls(keys_np, arrs[col - 1])
+                except Exception:
+                    pass          # fall through to the python parser
+        keys: List[int] = []
+        rows: List[np.ndarray] = []
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                parts = line.split("\t")
+                if len(parts) <= col:
+                    raise ValueError(
+                        f"{path}:{ln}: expected key\\tv\\th layout")
+                keys.append(int(parts[0]) & ((1 << 64) - 1))
+                rows.append(np.array(parts[col].split(), np.float32))
+        if not rows:
+            raise ValueError(f"{path}: no embedding rows")
+        return cls(np.array(keys, np.uint64), np.stack(rows))
+
+    def row(self, key: int) -> Optional[int]:
+        return self._row_of.get(int(key) & ((1 << 64) - 1))
+
+    def topk(self, queries: np.ndarray, k: int = 10,
+             exclude_rows: Sequence[Sequence[int]] = ()) -> Tuple[
+                 np.ndarray, np.ndarray]:
+        """Top-k cosine neighbors for each query VECTOR.
+
+        ``queries``: (Q, d).  ``exclude_rows``: per-query row indices to
+        mask out (e.g. the query word itself).  Returns (keys (Q, k'),
+        scores (Q, k')) with ``k' = min(k, rows)``; masked rows never
+        resurface (their -inf scores are clipped off per query by the
+        caller-visible arrays being uniformly sized to k', with any
+        still--inf trailing entries belonging to queries that excluded
+        more rows — callers drop them via the returned scores)."""
+        import jax.numpy as jnp
+
+        q = np.asarray(queries, np.float32)
+        q = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        # no dense (Q, V) exclusion mask (10GB at Q=10K over a 1M-row
+        # table): over-fetch k + max_excluded, drop excluded host-side
+        max_excl = max((len(r) for r in exclude_rows), default=0)
+        k_fetch = min(k + max_excl, len(self))
+        scores, idx = _topk_scores(jnp.asarray(self.vecs),
+                                   jnp.asarray(q.T), k_fetch)
+        idx, scores = np.asarray(idx), np.asarray(scores)
+        Q = q.shape[0]
+        k_eff = min(k, len(self) - max_excl) if max_excl else min(
+            k, len(self))
+        out_i = np.empty((Q, k_eff), np.int64)
+        out_s = np.empty((Q, k_eff), np.float32)
+        for qi in range(Q):
+            excl = set(exclude_rows[qi]) if qi < len(exclude_rows) \
+                else set()
+            keep = [j for j in range(k_fetch) if idx[qi, j] not in excl]
+            keep = (keep + [keep[-1]] * k_eff)[:k_eff] if keep else []
+            out_i[qi] = idx[qi, keep]
+            out_s[qi] = scores[qi, keep]
+        return self.keys[out_i], out_s
+
+    def neighbors(self, key: int, k: int = 10) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+        """Top-k neighbors of one stored key (itself excluded)."""
+        ks, ss = self.neighbors_batch([key], k)
+        return ks[0], ss[0]
+
+    def neighbors_batch(self, keys: Sequence[int], k: int = 10) -> Tuple[
+            List[np.ndarray], List[np.ndarray]]:
+        """Neighbors for MANY stored keys in ONE matmul + top_k
+        dispatch (each query's own row excluded); -inf (masked-out)
+        entries are dropped per query."""
+        rows = []
+        for key in keys:
+            r = self.row(key)
+            if r is None:
+                raise KeyError(f"key {int(key)} not in embeddings")
+            rows.append(r)
+        ks, ss = self.topk(self.vecs[np.array(rows)], k,
+                           exclude_rows=[[r] for r in rows])
+        return list(ks), list(ss)
+
+    def analogy(self, a: int, b: int, c: int, k: int = 5) -> Tuple[
+            np.ndarray, np.ndarray]:
+        """``a - b + c`` in embedding space (a:b :: result:c), query
+        words excluded from candidates."""
+        rows = [self.row(x) for x in (a, b, c)]
+        missing = [x for x, r in zip((a, b, c), rows) if r is None]
+        if missing:
+            raise KeyError(f"keys not in embeddings: {missing}")
+        q = (self.vecs[rows[0]] - self.vecs[rows[1]] + self.vecs[rows[2]])
+        ks, ss = self.topk(q[None, :], k, exclude_rows=[rows])
+        return ks[0], ss[0]
+
+
+def _word_maps(cmd: CMDLine, mode: str):
+    """word -> key (training convention) and key -> word (for output;
+    only derivable when a -words file enumerates the vocabulary)."""
+    to_key = lambda w: tokenize(w, mode)[0]     # noqa: E731
+    key_to_word: Dict[int, str] = {}
+    if cmd.hasParameter("words"):
+        with open(cmd.getValue("words")) as f:
+            words = f.read().split()
+        for w, k in zip(words, tokenize(" ".join(words), mode)):
+            key_to_word.setdefault(int(k), w)
+    return to_key, key_to_word
+
+
+def main(argv=None) -> int:
+    cmd = CMDLine(argv)
+    cmd.registerParameter("help", "this screen")
+    cmd.registerParameter("embeddings", "path of the trained embedding "
+                          "dump (w2v -output / Word2Vec.save)")
+    cmd.registerParameter("query", "comma-separated words: top-k "
+                          "nearest neighbors each")
+    cmd.registerParameter("analogy", "a:b::c — solve a-b+c")
+    cmd.registerParameter("topk", "neighbors per query (default 10)")
+    cmd.registerParameter("hash", "word->key convention: int | bkdr "
+                          "(default int, the sync-variant keys)")
+    cmd.registerParameter("field", "which vectors: v (input, default) "
+                          "| h (output)")
+    cmd.registerParameter("words", "vocabulary text file for printing "
+                          "results as words (required to name bkdr "
+                          "neighbors)")
+    if cmd.hasParameter("help") or not cmd.hasParameter("embeddings") \
+            or not (cmd.hasParameter("query")
+                    or cmd.hasParameter("analogy")):
+        cmd.print_help()
+        return 0
+
+    mode = cmd.getValue("hash", "int")
+    if mode not in ("int", "bkdr"):
+        log.error("unknown -hash %r (expected int|bkdr)", mode)
+        return 1
+    try:
+        k = int(cmd.getValue("topk", "10"))
+    except ValueError:
+        log.error("-topk wants an integer, got %r",
+                  cmd.getValue("topk"))
+        return 1
+    try:
+        index = EmbeddingIndex.from_text(
+            cmd.getValue("embeddings"), field=cmd.getValue("field", "v"))
+    except (ValueError, OSError) as e:
+        log.error("%s", e)
+        return 1
+    log.info("loaded %d embeddings (d=%d)", len(index),
+             index.vecs.shape[1])
+    to_key, key_to_word = _word_maps(cmd, mode)
+    name = lambda key: key_to_word.get(int(key), str(int(key)))  # noqa: E731
+
+    try:
+        if cmd.hasParameter("analogy"):
+            spec = cmd.getValue("analogy")
+            ab, _, c = spec.partition("::")
+            a, _, b = ab.partition(":")
+            if not (a and b and c):
+                log.error("-analogy wants a:b::c, got %r", spec)
+                return 1
+            ks, ss = index.analogy(to_key(a), to_key(b), to_key(c), k)
+            print(f"{a} - {b} + {c} =")
+            for key, s in zip(ks, ss):
+                print(f"  {name(key)}\t{s:.4f}")
+        if cmd.hasParameter("query"):
+            words = [w.strip() for w in cmd.getValue("query").split(",")
+                     if w.strip()]
+            all_ks, all_ss = index.neighbors_batch(
+                [to_key(w) for w in words], k)      # ONE dispatch
+            for w, ks, ss in zip(words, all_ks, all_ss):
+                print(f"{w}:")
+                for key, s in zip(ks, ss):
+                    print(f"  {name(key)}\t{s:.4f}")
+    except KeyError as e:
+        log.error("%s", e)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

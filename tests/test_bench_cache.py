@@ -282,10 +282,10 @@ def test_merge_seed_inherits_archive_timestamp(tmp_path, monkeypatch):
     import time
 
     monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
-    monkeypatch.setenv("BENCH_DTYPE", "bfloat16")      # override shape
+    monkeypatch.setenv("BENCH_ONLY", "w2v")   # selection-only override
     bench._cache_tpu_result(
         {"platform": "tpu", "w2v": {"words_per_sec": 9.9e5}})
-    monkeypatch.delenv("BENCH_DTYPE")
+    monkeypatch.delenv("BENCH_ONLY")
     # age the archive by 2h
     arch = [p for p in os.listdir(str(tmp_path)) if p != "tpu_latest.json"]
     path = os.path.join(str(tmp_path), arch[0])
@@ -297,7 +297,7 @@ def test_merge_seed_inherits_archive_timestamp(tmp_path, monkeypatch):
         {"lr": {"rows_per_sec": 1.4e7}}) is None
     lk = bench._last_known_tpu()
     assert lk["age_hours"] >= 2.0                       # honest age
-    assert lk["seeded_from"]["overrides"] == {"BENCH_DTYPE": "bfloat16"}
+    assert lk["seeded_from"]["overrides"] == {"BENCH_ONLY": "w2v"}
     assert lk["merged"]["lr"] != "2026-07-31T00:00:00Z"  # fresh field
 
 
@@ -317,3 +317,33 @@ def test_cache_writes_are_atomic(tmp_path, monkeypatch):
     latest = [p for p in calls if p.endswith("tpu_latest.json")]
     assert len(latest) == 2            # canonical write + merge write
     assert len(calls) == 3             # + the timestamped archive
+
+
+def test_seed_skips_shape_override_archives(tmp_path, monkeypatch):
+    """A fresh tpu_latest must never be seeded from a shape/dtype
+    override archive — a bfloat16 w2v_1m seeded under the canonical
+    fp32 key would mislabel the round summary (review finding).
+    Selection-only overrides (BENCH_ONLY etc.) remain seedable."""
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("BENCH_ONLY", "scale")
+    monkeypatch.setenv("BENCH_DTYPE", "bfloat16")
+    bench._cache_tpu_result(
+        {"platform": "tpu",
+         "w2v_1m": {"words_per_sec": 3.0e5, "dtype": "bfloat16"}})
+    monkeypatch.delenv("BENCH_DTYPE")
+    import os
+    import time
+    time.sleep(1.1)        # distinct archive timestamp
+    bench._cache_tpu_result(
+        {"platform": "tpu",
+         "w2v_1m": {"words_per_sec": 1.8e5, "dtype": "float32"}})
+    # two archives, no canonical yet (both runs had overrides)
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "tpu_latest.json"))
+    monkeypatch.delenv("BENCH_ONLY")
+    assert bench._merge_cached_tpu_fields(
+        {"lr": {"rows_per_sec": 1.0}}) is None
+    lk = bench._last_known_tpu()
+    # seeded from the fp32 (selection-only) archive, not the bf16 one —
+    # even though bf16's file sorts first and fp32's is newest-seedable
+    assert lk["result"]["w2v_1m"]["dtype"] == "float32"

