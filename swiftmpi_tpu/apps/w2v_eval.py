@@ -81,25 +81,27 @@ class EmbeddingIndex:
         """Parse a ``dump_table_text`` w2v dump: ``key TAB v-floats TAB
         h-floats`` per row (reference WParam operator<< layout,
         word2vec.h:100-110).  ``field`` picks the input-side (``v``) or
-        output-side (``h``) vectors."""
+        output-side (``h``) vectors.  Single-vector dumps — sent2vec's
+        ``sent_id TAB vec`` output (sent2vec.cpp:82-86) or an LR weight
+        dump — parse as ``v`` (requesting ``h`` from one is an error)."""
         if field not in ("v", "h"):
             raise ValueError(f"field must be 'v' or 'h', got {field!r}")
         col = 1 if field == "v" else 2
         # native C++ reader (the same one load_table_text routes
         # through): millions of Python float() calls vs one pass
-        d = None
+        dims = None
         with open(path) as f:
             for line in f:
                 parts = line.rstrip("\n").split("\t")
                 if len(parts) > col:
-                    d = len(parts[col].split())
+                    dims = [len(p.split()) for p in parts[1:]]
                 break
-        if d:
+        if dims:
             from swiftmpi_tpu.data import native
 
             if native.available():
                 try:
-                    keys_np, arrs = native.load_rows_native(path, [d, d])
+                    keys_np, arrs = native.load_rows_native(path, dims)
                     if len(keys_np):
                         return cls(keys_np, arrs[col - 1])
                 except Exception:

@@ -154,3 +154,49 @@ def test_neighbors_batch_matches_single():
     sk, ss = idx.neighbors(10, k=2)
     assert list(bk[0]) == list(sk) and np.allclose(bs[0], ss)
     assert 12 not in bk[1]                          # own-row exclusion
+
+
+def test_sent2vec_single_column_dump(tmp_path):
+    """sent2vec output (sent_id TAB vec, no h column) indexes as v;
+    asking for h is a clear layout error."""
+    path = str(tmp_path / "sents.txt")
+    with open(path, "w") as f:
+        f.write("100\t1.0 0.0\n101\t0.9 0.1\n102\t0.0 1.0\n")
+    idx = EmbeddingIndex.from_text(path, field="v")
+    keys, _ = idx.neighbors(100, k=1)
+    assert keys[0] == 101
+    with pytest.raises(ValueError):
+        EmbeddingIndex.from_text(path, field="h")
+
+
+def test_sent2vec_model_output_roundtrip(tmp_path):
+    """End to end: infer sentence vectors through the real Sent2Vec
+    pipeline, write the reference-format output, index and query it."""
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.models.sent2vec import Sent2Vec
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "word2vec": {"len_vec": 8, "window": 2, "negative": 2,
+                     "learning_rate": 0.1},
+        "server": {"initial_learning_rate": 0.5, "frag_num": 100},
+        "worker": {"minibatch": 32},
+    })
+    m = Word2Vec(config=cfg, cluster=Cluster(cfg).initialize())
+    rng = np.random.default_rng(1)
+    corpus = [[int(x) for x in rng.integers(1, 20, 12)] for _ in range(20)]
+    m.build(corpus)
+    m.train(corpus, niters=1)
+    s2v = Sent2Vec(m, seed=3)
+    lines = [" ".join(str(w) for w in s) for s in corpus[:6]]
+    results = s2v.infer_sentences(lines, niters=3)
+    path = str(tmp_path / "out.txt")
+    s2v.write(results, path)
+    idx = EmbeddingIndex.from_text(path)
+    assert len(idx) == 6
+    # sent ids are the BKDR hash of the raw line (sent2vec.cpp:75)
+    from swiftmpi_tpu.utils.hashing import bkdr_hash
+    ks, ss = idx.neighbors(bkdr_hash(lines[0]), k=3)
+    assert len(ks) == 3 and np.all(np.isfinite(ss))
