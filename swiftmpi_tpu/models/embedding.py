@@ -1,0 +1,179 @@
+"""Cosine-similarity index over trained embeddings (shared by the
+live-model query API and the ``w2v_eval`` CLI).
+
+TPU-first: the whole similarity pass is ONE normalized matmul
+``(V, d) @ (d, Q)`` on the MXU plus a ``top_k`` (module-cached jit);
+exclusions are handled host-side by over-fetch + drop so no ``(Q, V)``
+mask is ever materialized.  The reference has no embedding eval at all
+(its word2vec README ends at the text dump; row layout
+word2vec.h:100-110).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import List, Sequence, Tuple
+
+import numpy as np
+
+
+def _topk_scores(vecs, qt, k):
+    """One (V, d) @ (d, Q) matmul + top_k.  Module-level and jitted
+    with static k so repeated queries against the same index reuse the
+    compiled program (a per-call closure would re-trace every query).
+    Exclusions are handled host-side by the caller (over-fetch + drop)
+    so no (Q, V) mask is ever materialized."""
+    import jax
+
+    global _topk_scores_jit
+    if _topk_scores_jit is None:
+        @partial(jax.jit, static_argnames=("k",))
+        def f(vecs, qt, k):
+            return jax.lax.top_k((vecs @ qt).T, k)   # (Q, V) — MXU
+        _topk_scores_jit = f
+    return _topk_scores_jit(vecs, qt, k)
+
+
+_topk_scores_jit = None
+
+
+class EmbeddingIndex:
+    """In-memory cosine-similarity index over dumped embeddings.
+
+    Rows are L2-normalized once at construction; every query batch is a
+    single ``(V, d) @ (d, Q)`` matmul + ``top_k``.
+    """
+
+    def __init__(self, keys: np.ndarray, vecs: np.ndarray):
+        if len(keys) != len(vecs):
+            raise ValueError(f"{len(keys)} keys vs {len(vecs)} vectors")
+        self.keys = np.asarray(keys, np.uint64)
+        vecs = np.asarray(vecs, np.float32)
+        norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+        self.vecs = vecs / np.maximum(norms, 1e-12)
+        self._row_of = {int(k): i for i, k in enumerate(self.keys)}
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @classmethod
+    def from_text(cls, path: str, field: str = "v") -> "EmbeddingIndex":
+        """Parse a ``dump_table_text`` w2v dump: ``key TAB v-floats TAB
+        h-floats`` per row (reference WParam operator<< layout,
+        word2vec.h:100-110).  ``field`` picks the input-side (``v``) or
+        output-side (``h``) vectors.  Single-vector dumps — sent2vec's
+        ``sent_id TAB vec`` output (sent2vec.cpp:82-86) or an LR weight
+        dump — parse as ``v`` (requesting ``h`` from one is an error)."""
+        if field not in ("v", "h"):
+            raise ValueError(f"field must be 'v' or 'h', got {field!r}")
+        col = 1 if field == "v" else 2
+        # native C++ reader (the same one load_table_text routes
+        # through): millions of Python float() calls vs one pass
+        dims = None
+        with open(path) as f:
+            for line in f:
+                parts = line.rstrip("\n").split("\t")
+                if len(parts) > col:
+                    dims = [len(p.split()) for p in parts[1:]]
+                break
+        if dims:
+            from swiftmpi_tpu.data import native
+
+            if native.available():
+                try:
+                    keys_np, arrs = native.load_rows_native(path, dims)
+                    if len(keys_np):
+                        return cls(keys_np, arrs[col - 1])
+                except Exception:
+                    pass          # fall through to the python parser
+        keys: List[int] = []
+        rows: List[np.ndarray] = []
+        with open(path) as f:
+            for ln, line in enumerate(f, 1):
+                line = line.rstrip("\n")
+                if not line:
+                    continue
+                parts = line.split("\t")
+                if len(parts) <= col:
+                    raise ValueError(
+                        f"{path}:{ln}: expected key\\tv\\th layout")
+                keys.append(int(parts[0]) & ((1 << 64) - 1))
+                rows.append(np.array(parts[col].split(), np.float32))
+        if not rows:
+            raise ValueError(f"{path}: no embedding rows")
+        return cls(np.array(keys, np.uint64), np.stack(rows))
+
+    def row(self, key: int) -> Optional[int]:
+        return self._row_of.get(int(key) & ((1 << 64) - 1))
+
+    def topk(self, queries: np.ndarray, k: int = 10,
+             exclude_rows: Sequence[Sequence[int]] = ()) -> Tuple[
+                 np.ndarray, np.ndarray]:
+        """Top-k cosine neighbors for each query VECTOR.
+
+        ``queries``: (Q, d).  ``exclude_rows``: per-query row indices to
+        mask out (e.g. the query word itself).  Returns (keys (Q, k'),
+        scores (Q, k')) with ``k' = min(k, rows)``; masked rows never
+        resurface (their -inf scores are clipped off per query by the
+        caller-visible arrays being uniformly sized to k', with any
+        still--inf trailing entries belonging to queries that excluded
+        more rows — callers drop them via the returned scores)."""
+        import jax.numpy as jnp
+
+        q = np.asarray(queries, np.float32)
+        q = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+        # no dense (Q, V) exclusion mask (10GB at Q=10K over a 1M-row
+        # table): over-fetch k + max_excluded, drop excluded host-side
+        max_excl = max((len(r) for r in exclude_rows), default=0)
+        k_fetch = min(k + max_excl, len(self))
+        scores, idx = _topk_scores(jnp.asarray(self.vecs),
+                                   jnp.asarray(q.T), k_fetch)
+        idx, scores = np.asarray(idx), np.asarray(scores)
+        Q = q.shape[0]
+        k_eff = min(k, len(self) - max_excl) if max_excl else min(
+            k, len(self))
+        out_i = np.empty((Q, k_eff), np.int64)
+        out_s = np.empty((Q, k_eff), np.float32)
+        for qi in range(Q):
+            excl = set(exclude_rows[qi]) if qi < len(exclude_rows) \
+                else set()
+            keep = [j for j in range(k_fetch) if idx[qi, j] not in excl]
+            keep = (keep + [keep[-1]] * k_eff)[:k_eff] if keep else []
+            out_i[qi] = idx[qi, keep]
+            out_s[qi] = scores[qi, keep]
+        return self.keys[out_i], out_s
+
+    def neighbors(self, key: int, k: int = 10) -> Tuple[np.ndarray,
+                                                        np.ndarray]:
+        """Top-k neighbors of one stored key (itself excluded)."""
+        ks, ss = self.neighbors_batch([key], k)
+        return ks[0], ss[0]
+
+    def neighbors_batch(self, keys: Sequence[int], k: int = 10) -> Tuple[
+            List[np.ndarray], List[np.ndarray]]:
+        """Neighbors for MANY stored keys in ONE matmul + top_k
+        dispatch (each query's own row excluded); -inf (masked-out)
+        entries are dropped per query."""
+        rows = []
+        for key in keys:
+            r = self.row(key)
+            if r is None:
+                raise KeyError(f"key {int(key)} not in embeddings")
+            rows.append(r)
+        ks, ss = self.topk(self.vecs[np.array(rows)], k,
+                           exclude_rows=[[r] for r in rows])
+        return list(ks), list(ss)
+
+    def analogy(self, a: int, b: int, c: int, k: int = 5) -> Tuple[
+            np.ndarray, np.ndarray]:
+        """``a - b + c`` in embedding space (a:b :: result:c), query
+        words excluded from candidates."""
+        rows = [self.row(x) for x in (a, b, c)]
+        missing = [x for x, r in zip((a, b, c), rows) if r is None]
+        if missing:
+            raise KeyError(f"keys not in embeddings: {missing}")
+        q = (self.vecs[rows[0]] - self.vecs[rows[1]] + self.vecs[rows[2]])
+        ks, ss = self.topk(q[None, :], k, exclude_rows=[rows])
+        return ks[0], ss[0]
+
+

@@ -1049,6 +1049,12 @@ class Word2Vec:
                 "w2v", self.access, self._capacity_per_shard)
         n = load_table_text(self.table, path, fields=("v", "h"))
         self._step = None    # text load may have grown the table
+        if self.vocab is not None:
+            # growth remaps slots (KeyIndex.grow re-lays out
+            # shard*cap+local); a stale cached map would make
+            # embedding_index()/the fused step gather unrelated rows
+            slots = self.table.key_index.lookup(self.vocab.keys)
+            self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
         return n
 
     def embedding(self, key: int) -> Optional[np.ndarray]:
@@ -1057,3 +1063,21 @@ class Word2Vec:
             return None
         slot = self.table.key_index.slot(key)
         return np.asarray(self.table.state["v"][slot])  # one-row transfer
+
+    def embedding_index(self, field: str = "v"):
+        """Cosine-similarity index over the LIVE table (no dump round
+        trip): ``model.embedding_index().neighbors(key)`` /
+        ``.analogy(a, b, c)``.  Snapshot semantics — build after
+        training (or rebuild to see newer updates).  The reference has
+        no in-process query path at all (dump + external scripts)."""
+        from swiftmpi_tpu.models.embedding import EmbeddingIndex
+
+        if self.vocab is None:
+            # load() restores table rows but not a vocab; a dump-only
+            # workflow should index the dump file directly
+            raise RuntimeError(
+                "no vocab; build()/build_from_vocab() first (after a "
+                "bare load(), use EmbeddingIndex.from_text on the dump)")
+        slots = np.asarray(self._slot_of_vocab)
+        vecs = np.asarray(self.table.state[field])[slots]
+        return EmbeddingIndex(self.vocab.keys, vecs)

@@ -200,3 +200,78 @@ def test_sent2vec_model_output_roundtrip(tmp_path):
     from swiftmpi_tpu.utils.hashing import bkdr_hash
     ks, ss = idx.neighbors(bkdr_hash(lines[0]), k=3)
     assert len(ks) == 3 and np.all(np.isfinite(ss))
+
+
+def test_live_model_embedding_index(tmp_path):
+    """model.embedding_index() queries the live table and agrees with
+    the dump-then-index path bit for bit."""
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "word2vec": {"len_vec": 8, "window": 2, "negative": 2,
+                     "learning_rate": 0.1},
+        "server": {"initial_learning_rate": 0.5, "frag_num": 100},
+        "worker": {"minibatch": 64},
+    })
+    m = Word2Vec(config=cfg, cluster=Cluster(cfg).initialize())
+    rng = np.random.default_rng(2)
+    corpus = [[int(x) for x in rng.integers(1, 25, 15)] for _ in range(30)]
+    m.build(corpus)
+    m.train(corpus, niters=1)
+    live = m.embedding_index()
+    path = str(tmp_path / "emb.txt")
+    m.save(path)
+    dumped = EmbeddingIndex.from_text(path)
+    key = int(m.vocab.keys[3])
+    lk, ls = live.neighbors(key, k=4)
+    dk, ds = dumped.neighbors(key, k=4)
+    assert list(lk) == list(dk)
+    assert np.allclose(ls, ds, atol=1e-6)
+    # h-field works too
+    assert m.embedding_index("h").vecs.shape == live.vecs.shape
+
+
+def test_embedding_index_valid_after_growing_load(tmp_path):
+    """load() of a dump larger than the table forces growth, which
+    remaps EVERY slot; the cached vocab->slot map must be rebuilt or
+    embedding_index()/the fused step gather unrelated rows (review
+    finding)."""
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+
+    def cfg():
+        # TWO shards: single-shard growth happens to preserve slot
+        # values (slot = 0*cap + local), so only a multi-shard table
+        # exposes a stale vocab->slot map after growth
+        return ConfigParser().update({
+            "cluster": {"transfer": "xla", "server_num": 2},
+            "word2vec": {"len_vec": 4, "window": 2, "negative": 2,
+                         "learning_rate": 0.1},
+            "server": {"initial_learning_rate": 0.5, "frag_num": 100},
+            "worker": {"minibatch": 32},
+        })
+
+    rng = np.random.default_rng(5)
+    big = [[int(x) for x in rng.integers(1, 200, 15)] for _ in range(60)]
+    a = Word2Vec(config=cfg(), cluster=Cluster(cfg()).initialize())
+    a.build(big)
+    path = str(tmp_path / "big.txt")
+    a.save(path)
+
+    small_corpus = [[1, 2, 3, 4, 5, 6]] * 4
+    b = Word2Vec(config=cfg(), cluster=Cluster(cfg()).initialize(),
+                 capacity_per_shard=16)
+    b.build(small_corpus)
+    cap_before = b.table.capacity
+    b.load(path)                      # far more keys than capacity
+    assert b.table.capacity > cap_before        # growth really happened
+    idx = b.embedding_index()
+    for key in b.vocab.keys:
+        want = np.asarray(a.embedding(int(key)), np.float32)
+        want = want / max(np.linalg.norm(want), 1e-12)
+        got = idx.vecs[idx.row(int(key))]
+        assert np.allclose(got, want, atol=1e-6), int(key)
