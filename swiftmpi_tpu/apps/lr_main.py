@@ -23,7 +23,9 @@ log = get_logger("apps.lr")
 def main(argv=None) -> int:
     cmd = CMDLine(argv)
     cmd.registerParameter("help", "this screen")
-    cmd.registerParameter("mode", "train/predict")
+    cmd.registerParameter("mode", "train/predict/eval (eval = the "
+                          "reference tools/evaluate.py flow in-process: "
+                          "threshold-at-0.5 error rate on a labeled set)")
     cmd.registerParameter("config", "path of config file")
     cmd.registerParameter("dataset", "path of dataset (libSVM format)")
     cmd.registerParameter("niters", "number of training iterations")
@@ -54,6 +56,21 @@ def main(argv=None) -> int:
         out = cmd.getValue("output", "predict.txt")
         np.savetxt(out, scores, fmt="%.6f")
         log.info("wrote %d predictions -> %s", len(scores), out)
+        return 0
+
+    if mode == "eval":
+        # reference: predictions file + labels -> tools/evaluate.py
+        # (26-line offline error-rate script); here one mode does the
+        # predict + threshold-at-0.5 compare in-process
+        if not cmd.hasParameter("param"):
+            # unlike predict (whose all-0.5 output file is visibly
+            # degenerate), an untrained model's error rate is a
+            # plausible-looking wrong scalar — refuse instead
+            log.error("-mode eval requires -param <weights>")
+            return 1
+        model.load(cmd.getValue("param"))
+        err = model.error_rate(cmd.getValue("dataset"))
+        print(f"error rate: {err:.6f}")
         return 0
 
     log.error("unknown mode %r", mode)

@@ -152,12 +152,26 @@ def test_lr_cli(tmp_path, devices8):
                 f"{k}:{v:.4f}" for k, v in feats) + "\n")
     weights = str(tmp_path / "w.txt")
     assert main(["lr", "-mode", "train", "-dataset", str(train_file),
-                 "-niters", "2", "-output", weights]) == 0
+                 "-niters", "25", "-output", weights]) == 0
     assert len(open(weights).readlines()) > 0
     preds = str(tmp_path / "p.txt")
     assert main(["lr", "-mode", "predict", "-dataset", str(train_file),
                  "-param", weights, "-output", preds]) == 0
     assert len(open(preds).readlines()) == 80
+    # -mode eval: the reference tools/evaluate.py flow in-process
+    import contextlib
+    import io
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        assert main(["lr", "-mode", "eval", "-dataset", str(train_file),
+                     "-param", weights]) == 0
+    err = float(buf.getvalue().split()[-1])
+    # trained-on-set error must beat the majority class (the 2-iter
+    # variant of this test sat at exactly the class prior, 0.5625)
+    assert 0.0 <= err < 0.4, err
+    # eval without -param would print the class prior as a plausible
+    # wrong number — it must refuse instead
+    assert main(["lr", "-mode", "eval", "-dataset", str(train_file)]) == 1
 
 
 def test_lr_train_after_growing_load(tmp_path, devices8):
