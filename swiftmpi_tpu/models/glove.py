@@ -1,0 +1,259 @@
+"""GloVe (global word-vector factorization) on the TPU parameter server.
+
+Beyond the reference's app set (SURVEY.md §2.5 lists LR, word2vec,
+sent2vec) — included to show the framework's worker API generalizes past
+its three ported apps: GloVe's original trainer is **server-side AdaGrad
+over a sharded sparse table**, exactly the reference's parameter-server
+contract (accessmethod.h plugins + pull/push), so the whole model is an
+access-method schema plus one fused jitted step.
+
+Math (Pennington et al. 2014): for each co-occurrence count x_ij,
+
+    J_ij = w_i . wt_j + b_i + bt_j - log(x_ij)
+    loss = f(x_ij) * J_ij^2,   f(x) = min((x / x_max)^alpha, 1)
+
+with symmetric-window counts weighted 1/distance, trained by AdaGrad on
+(w, b) of the focus word and (wt, bt) of the context word.  The final
+embedding is the standard w + wt sum.
+
+TPU-first shape: the co-occurrence set is built ONCE host-side as COO
+arrays, then every epoch is a shuffled `lax.scan` over fused minibatch
+steps — two row gathers, elementwise math, two mean-normalized pushes
+through the transfer layer (the same path word2vec's h/v families
+take).  No per-pair host work, no dynamic shapes.
+
+Config section ``[glove]``: ``len_vec`` (default 100), ``window`` (10),
+``x_max`` (100), ``alpha`` (0.75), ``learning_rate`` (0.05),
+``minibatch`` (4096), plus ``[worker] inner_steps`` like the other
+models.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from swiftmpi_tpu.cluster.cluster import Cluster
+from swiftmpi_tpu.data.text import Vocab, build_vocab
+from swiftmpi_tpu.io.checkpoint import dump_table_text
+from swiftmpi_tpu.parameter.access import (AdaGradAccess, AdaGradRule,
+                                           FieldSpec, vec_rand_init,
+                                           zeros_init)
+from swiftmpi_tpu.utils.config import ConfigParser, global_config
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+
+def glove_access(learning_rate: float, len_vec: int) -> AdaGradAccess:
+    """One table keyed by word: focus (w, b) and context (wt, bt)
+    families with per-element AdaGrad sums — the optimizer GloVe
+    shipped with, already the framework's native access method."""
+    return AdaGradAccess(
+        learning_rate,
+        rules=(AdaGradRule("w", "w2sum", "w"),
+               AdaGradRule("wt", "wt2sum", "wt"),
+               AdaGradRule("b", "b2sum", "b"),
+               AdaGradRule("bt", "bt2sum", "bt")),
+        fields={"w": FieldSpec(len_vec, vec_rand_init),
+                "wt": FieldSpec(len_vec, vec_rand_init),
+                "b": FieldSpec(1, zeros_init),
+                "bt": FieldSpec(1, zeros_init),
+                "w2sum": FieldSpec(len_vec, zeros_init),
+                "wt2sum": FieldSpec(len_vec, zeros_init),
+                "b2sum": FieldSpec(1, zeros_init),
+                "bt2sum": FieldSpec(1, zeros_init)},
+        pull_fields=("w", "wt", "b", "bt"),
+    )
+
+
+def cooccurrence(sentences, vocab: Vocab, window: int
+                 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Symmetric-window co-occurrence counts, weight ``1/distance``
+    (the GloVe paper's decreasing weighting).  Returns COO arrays
+    (focus_idx, ctx_idx, weight) over VOCAB indices, deduplicated.
+
+    Vectorized per offset: for distance k every in-sentence token pair
+    (t, t+k) contributes 1/k to BOTH (i,j) and (j,i); pairs are folded
+    by combined int64 key with ``np.unique`` — no per-pair python."""
+    V = len(vocab.keys)
+    idx_rows: List[np.ndarray] = []
+    wts: List[np.ndarray] = []
+    for sent in sentences:
+        ids = [vocab.index_of(k) for k in sent]
+        t = np.asarray([i for i in ids if i is not None], np.int64)
+        if len(t) < 2:
+            continue
+        for k in range(1, min(window, len(t) - 1) + 1):
+            a, b = t[:-k], t[k:]
+            idx_rows.append(a * V + b)
+            idx_rows.append(b * V + a)
+            w = np.full(len(a), 1.0 / k, np.float32)
+            wts.append(w)
+            wts.append(w)
+    if not idx_rows:
+        return (np.zeros(0, np.int32), np.zeros(0, np.int32),
+                np.zeros(0, np.float32))
+    combined = np.concatenate(idx_rows)
+    weights = np.concatenate(wts)
+    uniq, inv = np.unique(combined, return_inverse=True)
+    x = np.zeros(len(uniq), np.float32)
+    np.add.at(x, inv, weights)
+    return ((uniq // V).astype(np.int32), (uniq % V).astype(np.int32), x)
+
+
+class GloVe:
+    def __init__(self, config: Optional[ConfigParser] = None,
+                 cluster: Optional[Cluster] = None,
+                 capacity_per_shard: Optional[int] = None, seed: int = 0):
+        self.config = config if config is not None else global_config()
+        g = self.config.get_or
+        self.len_vec = g("glove", "len_vec", 100).to_int32()
+        self.window = g("glove", "window", 10).to_int32()
+        self.x_max = g("glove", "x_max", 100.0).to_float()
+        self.alpha = g("glove", "alpha", 0.75).to_float()
+        lr = g("glove", "learning_rate", 0.05).to_float()
+        self.minibatch = g("glove", "minibatch", 4096).to_int32()
+        self.inner_steps = g("worker", "inner_steps", 1).to_int32()
+        self.cluster = cluster or Cluster(self.config).initialize()
+        self.access = glove_access(lr, self.len_vec)
+        self.transfer = self.cluster.transfer
+        self.seed = seed
+        self._capacity_per_shard = capacity_per_shard
+        self.table = None
+        self.vocab: Optional[Vocab] = None
+        self._slot_of_vocab = None
+        self._coo = None
+        self._step = None
+
+    # -- build: vocab + co-occurrence + table ------------------------------
+    def build(self, sentences) -> "GloVe":
+        self.vocab = build_vocab(sentences)
+        V = len(self.vocab.keys)
+        cap = self._capacity_per_shard or max(
+            64, int(V * 1.3) // self.cluster.n_servers + 1)
+        self.table = self.cluster.create_table(
+            "glove", self.access, cap, seed=self.seed)
+        slots = self.table.key_index.lookup(self.vocab.keys)
+        self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
+        fi, ci, x = cooccurrence(sentences, self.vocab, self.window)
+        self._coo = (fi, ci, x)
+        log.info("glove: vocab %d, %d co-occurrence cells (window %d)",
+                 V, len(x), self.window)
+        return self
+
+    # -- fused step --------------------------------------------------------
+    def _build_step(self):
+        # fx/logx arrive precomputed from train() — the weighting
+        # function itself never enters the jitted step
+        access, transfer = self.access, self.transfer
+
+        def one(state, fs, cs, logx, fx):
+            rows_f = transfer.pull(state, fs, access, fields=("w", "b"))
+            rows_c = transfer.pull(state, cs, access, fields=("wt", "bt"))
+            w, b = rows_f["w"], rows_f["b"][:, 0]
+            wt, bt = rows_c["wt"], rows_c["bt"][:, 0]
+            J = jnp.sum(w * wt, axis=1) + b + bt - logx
+            g = fx * J                                   # dJ/d(dot)
+            loss = jnp.sum(fx * J * J)
+            # AdaGradAccess ADDS lr*g (the reference's ascent
+            # convention, lr.cpp:68-75) — push the NEGATIVE gradient
+            gw = (-g)[:, None] * wt
+            gwt = (-g)[:, None] * w
+            gb = (-g)[:, None]
+            state = transfer.push(state, fs, {"w": gw, "b": gb},
+                                  access, mean=True)
+            state = transfer.push(state, cs, {"wt": gwt, "bt": gb},
+                                  access, mean=True)
+            return state, loss
+
+        def multi(state, fs, cs, logx, fx):
+            def body(st, xs):
+                st, loss = one(st, *xs)
+                return st, loss
+            state, losses = jax.lax.scan(body, state, (fs, cs, logx, fx))
+            return state, losses.sum()
+
+        return jax.jit(multi, donate_argnums=(0,))
+
+    # -- training ----------------------------------------------------------
+    def train(self, sentences=None, niters: int = 1) -> List[float]:
+        if self.table is None:
+            if sentences is None:
+                raise RuntimeError("build() first or pass sentences")
+            self.build(sentences)
+        if self._step is None:
+            self._step = self._build_step()
+        fi, ci, x = self._coo
+        n = len(x)
+        if n == 0:
+            raise RuntimeError("empty co-occurrence set")
+        sov = np.asarray(self._slot_of_vocab)
+        logx = np.log(x)
+        fx = np.minimum((x / self.x_max) ** self.alpha, 1.0).astype(
+            np.float32)
+        B = min(self.minibatch, n)
+        inner = max(1, self.inner_steps)
+        rng = np.random.default_rng(self.seed)
+        state = self.table.state
+        losses = []
+        for it in range(niters):
+            order = rng.permutation(n)
+            # pad the tail by CYCLING the permutation (static shapes);
+            # repeats are extra stochastic samples of real cells, and
+            # per-slot mean normalization keeps their scale right.
+            # np.resize cycles, so this holds even when the pad exceeds
+            # n (tiny co-occurrence sets under large B*inner)
+            n_groups = -(-n // (B * inner))
+            order = np.resize(order, n_groups * B * inner)
+            total = 0.0
+            for gstart in range(0, len(order), B * inner):
+                sel = order[gstart:gstart + B * inner]
+                fs = jnp.asarray(sov[fi[sel]].reshape(inner, B))
+                cs = jnp.asarray(sov[ci[sel]].reshape(inner, B))
+                lx = jnp.asarray(logx[sel].reshape(inner, B))
+                fw = jnp.asarray(fx[sel].reshape(inner, B))
+                state, loss = self._step(state, fs, cs, lx, fw)
+                total += float(loss)
+            mean_loss = total / len(order)
+            losses.append(mean_loss)
+            log.info("glove iter %d: %d cells  loss %.6f", it, n, mean_loss)
+        self.table.state = state
+        return losses
+
+    # -- outputs -----------------------------------------------------------
+    def _vectors(self) -> np.ndarray:
+        """The exported embedding: standard w + wt sum, vocab order —
+        ONE definition shared by the live index and the dump."""
+        if self.vocab is None:
+            raise RuntimeError("build() first")
+        slots = np.asarray(self._slot_of_vocab)
+        return (np.asarray(self.table.state["w"])[slots]
+                + np.asarray(self.table.state["wt"])[slots])
+
+    def embedding_index(self):
+        """Cosine index over the standard w + wt embedding sum."""
+        from swiftmpi_tpu.models.embedding import EmbeddingIndex
+
+        return EmbeddingIndex(self.vocab.keys, self._vectors())
+
+    def save(self, path: str) -> int:
+        """``key TAB (w + wt)-vector`` — the standard GloVe export, in
+        the single-vector dump layout ``w2v_eval`` indexes directly."""
+        vecs = self._vectors()
+        n = 0
+        with open(path, "w") as f:
+            for key, vec in zip(self.vocab.keys, vecs):
+                f.write(f"{int(key)}\t"
+                        + " ".join(repr(float(v)) for v in vec) + "\n")
+                n += 1
+        return n
+
+    def save_full(self, path: str) -> int:
+        """All fields (both families + AdaGrad sums) in the reference
+        checkpoint text format."""
+        return dump_table_text(self.table, path,
+                               fields=("w", "wt", "b", "bt"))
