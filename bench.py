@@ -471,6 +471,53 @@ def _bench_w2v_text8(device):
             "loss": float(losses[-1])}
 
 
+def _bench_glove(device, timed_calls):
+    """GloVe training cells/s (beyond-reference model family on the
+    same pull/push contract; opt-in via BENCH_ONLY=glove).  Synthetic
+    Zipf corpus at the primary bench's vocab scale; the whole epoch is
+    pre-staged COO minibatches scanned on device."""
+    import jax
+    import numpy as np
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.data.text import synthetic_corpus
+    from swiftmpi_tpu.models.glove import GloVe
+    from swiftmpi_tpu.utils import ConfigParser
+
+    B, INNER = 8192, 8
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "glove": {"len_vec": 100, "window": 8, "learning_rate": 0.05,
+                  "minibatch": B},
+        "worker": {"inner_steps": INNER},
+        "server": {"frag_num": 1000},
+    })
+    with jax.default_device(device):
+        m = GloVe(config=cfg,
+                  cluster=Cluster(cfg, devices=[device]).initialize())
+        corpus = synthetic_corpus(SENTENCES, VOCAB, SENT_LEN, seed=11)
+        m.build(corpus)
+        if m._step is None:
+            m._step = m._build_step()
+        n = len(m._coo[2])
+        rng = np.random.default_rng(0)
+        # model-owned staging: same slot mapping and f(x) weighting as
+        # train() by construction (GloVe.stage)
+        fs, cs, lx, fw = m.stage(rng.permutation(n)[:B * INNER],
+                                 INNER, B)
+        state = {f: jax.device_put(v, device)
+                 for f, v in m.table.state.items()}
+        state, loss = m._step(state, fs, cs, lx, fw)     # compile
+        _fence(state, loss)
+        t0 = time.perf_counter()
+        for _ in range(timed_calls):
+            state, loss = m._step(state, fs, cs, lx, fw)
+        _fence(state, loss)
+        dt = time.perf_counter() - t0
+    return {"cells_per_sec": B * INNER * timed_calls / dt,
+            "step_ms": dt / (timed_calls * INNER) * 1e3,
+            "nnz": int(n), "loss": float(loss) / (B * INNER)}
+
+
 def _bench_tfm(device, timed_calls):
     """Transformer-LM training tokens/s (beyond-reference model family;
     opt-in via BENCH_TFM=1 so the default driver run's time budget is
@@ -582,6 +629,12 @@ def child_main(which: str) -> None:
         # compile) so a short/degraded tunnel window can still capture
         # the LR measurement in its own ~1-compile child
         out["lr"] = _bench_lr(device, max(timed // 4, 1))
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
+    if os.environ.get("BENCH_ONLY") == "glove":
+        # beyond-reference family cell, own child (skips the w2v build)
+        out["glove"] = _bench_glove(device, max(timed // 2, 1))
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
@@ -1044,14 +1097,17 @@ def parent_main() -> None:
                               ("w2v_text8_epoch_wall", "epoch_wall_s",
                                "s"),
                               ("transformer_lm", "tokens_per_sec",
-                               "tokens/s")):
+                               "tokens/s"),
+                              ("glove_cooc", "cells_per_sec",
+                               "cells/s")):
         key = {"w2v_epoch_wall": "w2v_epoch",
                "lr_a9a": "lr", "sent2vec": "s2v",
                "w2v_shared_negatives": "w2v_shared",
                "w2v_skipgram": "w2v_sg",
                "w2v_1m_vocab": "w2v_1m",
                "w2v_text8_epoch_wall": "w2v_text8",
-               "transformer_lm": "tfm"}[name]
+               "transformer_lm": "tfm",
+               "glove_cooc": "glove"}[name]
         entry = {"unit": unit}
         tpu_raw = tpu_res[key][field] if tpu_res and key in tpu_res \
             else None

@@ -179,6 +179,24 @@ class GloVe:
 
         return jax.jit(multi, donate_argnums=(0,))
 
+    # -- minibatch staging -------------------------------------------------
+    def stage(self, sel: np.ndarray, inner: int, B: int):
+        """COO selection -> device-ready ``(fs, cs, logx, fx)`` stacks
+        of shape (inner, B): the ONE definition of slot mapping and the
+        f(x) = min((x/x_max)^alpha, 1) weighting, shared by train() and
+        the benchmark cell so a weighting change can't silently fork."""
+        fi, ci, x = self._coo
+        sov = np.asarray(self._slot_of_vocab)
+        sel = np.resize(sel, inner * B)
+        xs = x[sel]
+        fs = jnp.asarray(sov[fi[sel]].reshape(inner, B))
+        cs = jnp.asarray(sov[ci[sel]].reshape(inner, B))
+        lx = jnp.asarray(np.log(xs).reshape(inner, B))
+        fw = jnp.asarray(np.minimum((xs / self.x_max) ** self.alpha,
+                                    1.0).astype(np.float32)
+                         .reshape(inner, B))
+        return fs, cs, lx, fw
+
     # -- training ----------------------------------------------------------
     def train(self, sentences=None, niters: int = 1) -> List[float]:
         if self.table is None:
@@ -187,14 +205,9 @@ class GloVe:
             self.build(sentences)
         if self._step is None:
             self._step = self._build_step()
-        fi, ci, x = self._coo
-        n = len(x)
+        n = len(self._coo[2])
         if n == 0:
             raise RuntimeError("empty co-occurrence set")
-        sov = np.asarray(self._slot_of_vocab)
-        logx = np.log(x)
-        fx = np.minimum((x / self.x_max) ** self.alpha, 1.0).astype(
-            np.float32)
         B = min(self.minibatch, n)
         inner = max(1, self.inner_steps)
         rng = np.random.default_rng(self.seed)
@@ -202,21 +215,18 @@ class GloVe:
         losses = []
         for it in range(niters):
             order = rng.permutation(n)
-            # pad the tail by CYCLING the permutation (static shapes);
-            # repeats are extra stochastic samples of real cells, and
-            # per-slot mean normalization keeps their scale right.
-            # np.resize cycles, so this holds even when the pad exceeds
-            # n (tiny co-occurrence sets under large B*inner)
+            # pad the tail by CYCLING the permutation (static shapes,
+            # via stage()'s np.resize — holds even when one fused
+            # group exceeds n); repeats are extra stochastic samples
+            # of real cells, and per-slot mean normalization keeps
+            # their scale right
             n_groups = -(-n // (B * inner))
             order = np.resize(order, n_groups * B * inner)
             total = 0.0
             for gstart in range(0, len(order), B * inner):
                 sel = order[gstart:gstart + B * inner]
-                fs = jnp.asarray(sov[fi[sel]].reshape(inner, B))
-                cs = jnp.asarray(sov[ci[sel]].reshape(inner, B))
-                lx = jnp.asarray(logx[sel].reshape(inner, B))
-                fw = jnp.asarray(fx[sel].reshape(inner, B))
-                state, loss = self._step(state, fs, cs, lx, fw)
+                state, loss = self._step(state,
+                                         *self.stage(sel, inner, B))
                 total += float(loss)
             mean_loss = total / len(order)
             losses.append(mean_loss)
