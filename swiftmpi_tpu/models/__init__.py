@@ -2,10 +2,12 @@
 transformer LM that exercises the long-context / multi-axis parallelism.
 
 logistic (lr.cpp), word2vec sync+async (word2vec.h / word2vec_global.h),
-sent2vec (sent2vec.cpp); transformer is new surface (no reference
-counterpart — SURVEY.md §2.7).
+sent2vec (sent2vec.cpp); transformer, GloVe, and the embedding query
+index are new surface (no reference counterpart — SURVEY.md §2.7).
 """
 
+from swiftmpi_tpu.models.embedding import EmbeddingIndex
+from swiftmpi_tpu.models.glove import GloVe
 from swiftmpi_tpu.models.logistic import LogisticRegression
 from swiftmpi_tpu.models.word2vec import Word2Vec
 from swiftmpi_tpu.models.sent2vec import Sent2Vec, build_word_model_from_dump
@@ -14,7 +16,8 @@ from swiftmpi_tpu.models.transformer import (TransformerConfig, forward,
                                              lm_loss, param_shardings,
                                              sgd_step)
 
-__all__ = ["LogisticRegression", "Word2Vec", "Sent2Vec",
+__all__ = ["EmbeddingIndex", "GloVe", "LogisticRegression",
+           "Word2Vec", "Sent2Vec",
            "build_word_model_from_dump", "TransformerConfig", "forward",
            "forward_pipelined", "init_params", "lm_loss",
            "param_shardings", "sgd_step", "TrainState", "Trainer",
