@@ -138,3 +138,57 @@ def test_glove_tiny_set_large_inner_steps():
     # 3-word corpus: a handful of cells << 16*4 per fused group
     losses = m.train([[1, 2, 3], [2, 3, 1]], niters=2)
     assert np.isfinite(losses).all()
+
+
+def test_glove_step_grads_match_numpy():
+    """One fused step vs a direct numpy transcription of the GloVe
+    update (AdaGrad, mean-normalized per slot like the transfer's dedup
+    pass) — the same golden-math rigor the w2v CBOW/SG steps carry."""
+    cfg = make_cfg(len_vec=4, minibatch=8)
+    m = GloVe(config=cfg, cluster=Cluster(cfg).initialize())
+    m.build([[1, 2, 3, 4], [2, 3, 4, 5], [5, 1, 3, 2]])
+    m._step = m._build_step()
+    n = len(m._coo[2])
+    sel = np.arange(min(8, n))
+    fs, cs, lx, fw = m.stage(sel, 1, len(sel))
+    state0 = {k: np.asarray(v).copy() for k, v in m.table.state.items()}
+    state1, loss = m._step(dict(m.table.state), fs, cs, lx, fw)
+
+    # numpy transcription
+    fsn, csn = np.asarray(fs)[0], np.asarray(cs)[0]
+    lxn, fwn = np.asarray(lx)[0], np.asarray(fw)[0]
+    w, wt = state0["w"][fsn], state0["wt"][csn]
+    b, bt = state0["b"][fsn, 0], state0["bt"][csn, 0]
+    J = (w * wt).sum(1) + b + bt - lxn
+    g = fwn * J
+    want_loss = float((fwn * J * J).sum())
+    assert np.isclose(float(loss), want_loss, rtol=1e-5)
+
+    lr = m.access.learning_rate
+    fudge = m.access.fudge_factor
+
+    def apply(base, accum, slots, grads):
+        out_p, out_a = base.copy(), accum.copy()
+        # mean-normalize per unique slot, then one AdaGrad apply each
+        for s in np.unique(slots):
+            sel_ = slots == s
+            gm = grads[sel_].mean(0)
+            a = out_a[s] + gm * gm
+            out_a[s] = a
+            out_p[s] = out_p[s] + lr * gm / np.sqrt(a + fudge)
+        return out_p, out_a
+
+    want_w, want_w2 = apply(state0["w"], state0["w2sum"], fsn,
+                            (-g)[:, None] * wt)
+    want_wt, want_wt2 = apply(state0["wt"], state0["wt2sum"], csn,
+                              (-g)[:, None] * w)
+    want_b, want_b2 = apply(state0["b"], state0["b2sum"], fsn,
+                            (-g)[:, None])
+    want_bt, want_bt2 = apply(state0["bt"], state0["bt2sum"], csn,
+                              (-g)[:, None])
+    for field, want in (("w", want_w), ("wt", want_wt), ("b", want_b),
+                        ("bt", want_bt), ("w2sum", want_w2),
+                        ("wt2sum", want_wt2), ("b2sum", want_b2),
+                        ("bt2sum", want_bt2)):
+        assert np.allclose(np.asarray(state1[field]), want,
+                           atol=1e-5), field
