@@ -51,8 +51,10 @@ LR_ROWS = 32561        # a9a shape
 LR_DIM = 123
 LR_NNZ = 14
 LR_BATCH = 8192
-S2V_SENTS = 1024     # one dispatch per 1024 sentences: at 256 the
-                     # ~5ms tunnel dispatch was ~20% of the batch wall
+S2V_SENTS = int(os.environ.get("BENCH_S2V_SENTS", 1024))
+                     # one dispatch per 1024 sentences: at 256 the
+                     # ~5ms tunnel dispatch was ~20% of the batch wall;
+                     # env hook for window sweeps (archives labeled)
 S2V_NITERS = 10
 
 # budget: ~6 distinct programs compile through the remote-compile tunnel
@@ -789,7 +791,7 @@ _SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
               "BENCH_SCALE", "BENCH_TFM", "BENCH_TEXT8", "BENCH_DENSE",
               "BENCH_LR_UNROLL", "BENCH_LR_EPOCH_UNROLL",
               "BENCH_TEXT8_MB", "BENCH_TEXT8_VOCAB", "BENCH_TEXT8_SENTS",
-              "BENCH_TEXT8_LEN")
+              "BENCH_TEXT8_LEN", "BENCH_S2V_SENTS")
 
 
 def _atomic_write_json(path: str, obj) -> None:
