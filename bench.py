@@ -791,7 +791,14 @@ _SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
               "BENCH_SCALE", "BENCH_TFM", "BENCH_TEXT8", "BENCH_DENSE",
               "BENCH_LR_UNROLL", "BENCH_LR_EPOCH_UNROLL",
               "BENCH_TEXT8_MB", "BENCH_TEXT8_VOCAB", "BENCH_TEXT8_SENTS",
-              "BENCH_TEXT8_LEN", "BENCH_S2V_SENTS")
+              "BENCH_TEXT8_LEN", "BENCH_S2V_SENTS",
+              # kernel-gate forces (chip_session's nopallas stage): a
+              # gates-off archive is NOT a canonical measurement the
+              # moment any calibration verdict is armed — record them so
+              # _seedable never seeds tpu_latest.json from one
+              # (round-3 advisor, medium)
+              "SMTPU_PALLAS_GATHER", "SMTPU_PALLAS_SCATTER",
+              "SMTPU_DENSE_LOGITS", "SMTPU_CALIBRATION")
 
 
 def _atomic_write_json(path: str, obj) -> None:
@@ -1173,7 +1180,131 @@ def parent_main() -> None:
                 # archive (fresh cache) — label it, don't pass those
                 # numbers off as a canonical full run
                 out["last_known_tpu"]["seeded_from"] = lk["seeded_from"]
-    print(json.dumps(out), flush=True)
+    emit_final(out)
+
+
+# --------------------------------------------------------------------------
+# final-line emission: the driver keeps only the LAST ~2000 bytes of
+# stdout, so the one JSON line must fit that tail or the round's official
+# artifact arrives truncated and unparseable (round-3 postmortem:
+# BENCH_r03.json rc=0 but parsed=null — the inlined last_known_tpu
+# evidence blob pushed the line past the capture window, and the round
+# that met the north star has no machine-readable record).
+# --------------------------------------------------------------------------
+
+MAX_LINE_BYTES = 1800     # r02's parsed artifact was 1,335B; ~200B margin
+                          # under the driver's ~2000B tail capture
+FULL_REPORT = "BENCH_REPORT.json"
+FULL_REPORT_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                FULL_REPORT)
+
+
+def _compact_final(out: dict) -> dict:
+    """The byte-budgeted rendering of the full bench record: every
+    number survives; long prose notes and the raw chip-evidence blob
+    live only in the FULL_REPORT sidecar this line points at."""
+    c = {"metric": out.get("metric"), "value": out.get("value"),
+         "unit": out.get("unit"), "vs_baseline": out.get("vs_baseline")}
+    d = out.get("detail") or {}
+    cd = {k: d[k] for k in (
+        "config", "device", "step_ms", "cpu_baseline_words_per_sec",
+        "cpp_oracle_words_per_sec", "vs_8rank_reference_estimate")
+        if d.get(k) is not None}
+    if cd:
+        c["detail"] = cd
+    if out.get("secondary"):
+        # entry dicts copied: shrink steps mutate c, never the caller's
+        # full record (the sidecar must keep what the line drops)
+        c["secondary"] = {k: dict(v) for k, v in out["secondary"].items()}
+    if out.get("degraded"):
+        more = len(out["degraded"]) - 3
+        c["degraded"] = [e[:100] for e in out["degraded"][:3]]
+        if more > 0:
+            c["degraded"].append(f"+{more} more (see {FULL_REPORT})")
+    if out.get("tpu_merged_from_cache"):
+        # dates only — full per-field ISO provenance is in the sidecar
+        c["tpu_cells_from_cache"] = sorted(out["tpu_merged_from_cache"])
+    lk = out.get("last_known_tpu")
+    if lk:
+        res = lk.get("result") or {}
+        t8 = res.get("w2v_text8") or {}
+        c["last_known_tpu"] = {
+            "measured_at": lk.get("measured_at"),
+            "age_hours": lk.get("age_hours"),
+            "device": res.get("device_kind") or res.get("device"),
+            "words_per_sec": lk.get("words_per_sec"),
+            "text8_epoch_wall_s": (round(t8["epoch_wall_s"], 3)
+                                   if "epoch_wall_s" in t8 else None),
+            "note": ("cached chip evidence (tunnel down this run); "
+                     f"full record in {FULL_REPORT}"),
+        }
+        if lk.get("seeded_from"):
+            c["last_known_tpu"]["seeded_from_overrides"] = \
+                (lk["seeded_from"] or {}).get("overrides")
+    c["full_report"] = FULL_REPORT
+    return c
+
+
+def _shrink_steps(c: dict, n_degraded: int):
+    """Ordered, least-valuable-first droppers applied only while the
+    line still exceeds MAX_LINE_BYTES.  Each mutates ``c`` in place.
+    ``n_degraded`` is the ORIGINAL degraded count (c's list may already
+    carry a '+N more' marker, which must not be counted as an entry)."""
+    def drop_lk_note(c):
+        (c.get("last_known_tpu") or {}).pop("note", None)
+
+    def drop_detail_extras(c):
+        d = c.get("detail") or {}
+        for k in ("cpp_oracle_words_per_sec",
+                  "vs_8rank_reference_estimate", "config"):
+            d.pop(k, None)
+
+    def squeeze_degraded(c):
+        if c.get("degraded"):
+            c["degraded"] = [c["degraded"][0][:60],
+                             f"+{n_degraded - 1} more"]
+
+    def drop_cache_labels(c):
+        c.pop("tpu_cells_from_cache", None)
+
+    def drop_secondary_units(c):
+        for e in (c.get("secondary") or {}).values():
+            e.pop("unit", None)
+
+    def drop_secondary_cpu(c):
+        # keep tpu + vs_baseline (the ratio already encodes the cpu side)
+        for e in (c.get("secondary") or {}).values():
+            if "vs_baseline" in e:
+                e.pop("cpu", None)
+
+    def drop_secondary(c):
+        if "secondary" in c:
+            c["secondary_dropped"] = len(c.pop("secondary"))
+
+    return [drop_lk_note, drop_detail_extras, squeeze_degraded,
+            drop_cache_labels, drop_secondary_units, drop_secondary_cpu,
+            drop_secondary]
+
+
+def render_final_line(out: dict) -> str:
+    """Compact ``out`` into a single JSON line guaranteed (and
+    test-asserted) to fit MAX_LINE_BYTES."""
+    c = _compact_final(out)
+    line = json.dumps(c)
+    for step in _shrink_steps(c, len(out.get("degraded") or ())):
+        if len(line.encode()) <= MAX_LINE_BYTES:
+            break
+        step(c)
+        line = json.dumps(c)
+    return line
+
+
+def emit_final(out: dict) -> None:
+    try:
+        _atomic_write_json(FULL_REPORT_PATH, out)
+    except OSError:
+        pass              # the sidecar must never block the one line
+    print(render_final_line(out), flush=True)
 
 
 def main() -> None:
@@ -1189,7 +1320,8 @@ def main() -> None:
         print(json.dumps({
             "metric": "word2vec_cbow_ns_words_per_sec", "value": 0.0,
             "unit": "words/s", "vs_baseline": None,
-            "degraded": [f"bench_crashed: {type(e).__name__}: {e}"],
+            "degraded": [f"bench_crashed: {type(e).__name__}: "
+                         f"{str(e)[:200]}"],
         }), flush=True)
 
 

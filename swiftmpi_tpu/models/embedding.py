@@ -12,7 +12,7 @@ word2vec.h:100-110).
 from __future__ import annotations
 
 from functools import partial
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -113,11 +113,11 @@ class EmbeddingIndex:
 
         ``queries``: (Q, d).  ``exclude_rows``: per-query row indices to
         mask out (e.g. the query word itself).  Returns (keys (Q, k'),
-        scores (Q, k')) with ``k' = min(k, rows)``; masked rows never
-        resurface (their -inf scores are clipped off per query by the
-        caller-visible arrays being uniformly sized to k', with any
-        still--inf trailing entries belonging to queries that excluded
-        more rows — callers drop them via the returned scores)."""
+        scores (Q, k')) with ``k' = min(k, rows)``.  A query with fewer
+        survivors than k' (its exclusions ate into the fetch, or every
+        fetched row was excluded) pads its tail with -inf scores —
+        callers drop those by score, and the batched wrappers below do
+        so automatically."""
         import jax.numpy as jnp
 
         q = np.asarray(queries, np.float32)
@@ -130,17 +130,20 @@ class EmbeddingIndex:
                                    jnp.asarray(q.T), k_fetch)
         idx, scores = np.asarray(idx), np.asarray(scores)
         Q = q.shape[0]
-        k_eff = min(k, len(self) - max_excl) if max_excl else min(
-            k, len(self))
-        out_i = np.empty((Q, k_eff), np.int64)
-        out_s = np.empty((Q, k_eff), np.float32)
+        k_out = min(k, len(self))
+        # per-query survivor count (round-3 advisor: a uniform
+        # min(k, V - max_excl) silently shrank k for EVERY query in a
+        # mixed-exclusion batch, and an all-excluded query crashed)
+        out_i = np.zeros((Q, k_out), np.int64)
+        out_s = np.full((Q, k_out), -np.inf, np.float32)
         for qi in range(Q):
             excl = set(exclude_rows[qi]) if qi < len(exclude_rows) \
                 else set()
-            keep = [j for j in range(k_fetch) if idx[qi, j] not in excl]
-            keep = (keep + [keep[-1]] * k_eff)[:k_eff] if keep else []
-            out_i[qi] = idx[qi, keep]
-            out_s[qi] = scores[qi, keep]
+            keep = [j for j in range(k_fetch)
+                    if idx[qi, j] not in excl][:k_out]
+            if keep:
+                out_i[qi, :len(keep)] = idx[qi, keep]
+                out_s[qi, :len(keep)] = scores[qi, keep]
         return self.keys[out_i], out_s
 
     def neighbors(self, key: int, k: int = 10) -> Tuple[np.ndarray,
@@ -162,7 +165,9 @@ class EmbeddingIndex:
             rows.append(r)
         ks, ss = self.topk(self.vecs[np.array(rows)], k,
                            exclude_rows=[[r] for r in rows])
-        return list(ks), list(ss)
+        kept = [np.isfinite(s) for s in ss]
+        return ([kk[m] for kk, m in zip(ks, kept)],
+                [s[m] for s, m in zip(ss, kept)])
 
     def analogy(self, a: int, b: int, c: int, k: int = 5) -> Tuple[
             np.ndarray, np.ndarray]:
@@ -174,6 +179,7 @@ class EmbeddingIndex:
             raise KeyError(f"keys not in embeddings: {missing}")
         q = (self.vecs[rows[0]] - self.vecs[rows[1]] + self.vecs[rows[2]])
         ks, ss = self.topk(q[None, :], k, exclude_rows=[rows])
-        return ks[0], ss[0]
+        m = np.isfinite(ss[0])
+        return ks[0][m], ss[0][m]
 
 

@@ -227,11 +227,17 @@ class GloVe:
                 sel = order[gstart:gstart + B * inner]
                 state, loss = self._step(state,
                                          *self.stage(sel, inner, B))
+                # the step donates the state buffers: reassign NOW, not
+                # after the loop, or an exception mid-epoch (staging
+                # error, KeyboardInterrupt) leaves self.table.state
+                # pointing at donated/deleted device buffers and a
+                # previously valid model can no longer save()
+                # (round-3 advisor)
+                self.table.state = state
                 total += float(loss)
             mean_loss = total / len(order)
             losses.append(mean_loss)
             log.info("glove iter %d: %d cells  loss %.6f", it, n, mean_loss)
-        self.table.state = state
         return losses
 
     # -- outputs -----------------------------------------------------------

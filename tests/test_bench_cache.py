@@ -52,6 +52,8 @@ def test_parent_degraded_output_embeds_last_known_tpu(monkeypatch,
     import json
 
     monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "FULL_REPORT_PATH",
+                        str(tmp_path / "BENCH_REPORT.json"))
     for var in bench._SHAPE_ENV:
         monkeypatch.delenv(var, raising=False)
     bench._cache_tpu_result(
@@ -78,7 +80,10 @@ def test_parent_degraded_output_embeds_last_known_tpu(monkeypatch,
     lk = d["last_known_tpu"]
     assert lk["words_per_sec"] == 794365.3
     assert lk["age_hours"] < 1.0
-    assert lk["result"]["w2v"]["rendering"] == "gather"
+    # the full evidence blob lives in the sidecar the line points at
+    assert d["full_report"] == bench.FULL_REPORT
+    full = json.load(open(str(tmp_path / "BENCH_REPORT.json")))
+    assert full["last_known_tpu"]["result"]["w2v"]["rendering"] == "gather"
 
 
 def test_merge_cached_tpu_fields(tmp_path, monkeypatch):
@@ -138,6 +143,8 @@ def test_degraded_output_carries_merged_provenance(monkeypatch, tmp_path,
     import json
 
     monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "FULL_REPORT_PATH",
+                        str(tmp_path / "BENCH_REPORT.json"))
     for var in bench._SHAPE_ENV:
         monkeypatch.delenv(var, raising=False)
     bench._cache_tpu_result(
@@ -152,8 +159,10 @@ def test_degraded_output_carries_merged_provenance(monkeypatch, tmp_path,
                      "loss": 5.0, "rendering": "gather"}}, None, 1.0))
     bench.parent_main()
     d = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
-    assert d["last_known_tpu"]["result"]["lr"]["rows_per_sec"] == 1.4e7
-    assert "lr" in d["last_known_tpu"]["merged"]
+    assert d["last_known_tpu"]["words_per_sec"] == 1.0e6
+    full = json.load(open(str(tmp_path / "BENCH_REPORT.json")))
+    assert full["last_known_tpu"]["result"]["lr"]["rows_per_sec"] == 1.4e7
+    assert "lr" in full["last_known_tpu"]["merged"]
 
 
 def test_partial_chip_run_folds_cached_fields_into_secondary(monkeypatch,
@@ -165,6 +174,8 @@ def test_partial_chip_run_folds_cached_fields_into_secondary(monkeypatch,
     import json
 
     monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "FULL_REPORT_PATH",
+                        str(tmp_path / "BENCH_REPORT.json"))
     for var in bench._SHAPE_ENV:
         monkeypatch.delenv(var, raising=False)
     bench._cache_tpu_result(
@@ -192,7 +203,7 @@ def test_partial_chip_run_folds_cached_fields_into_secondary(monkeypatch,
     sec = d["secondary"]["lr_a9a"]
     assert sec["tpu"] == 1.4e7                      # cache-carried cell
     assert sec["vs_baseline"] == round(1.4e7 / 1.1e7, 2)
-    assert "lr" in d["tpu_merged_from_cache"]       # labeled provenance
+    assert "lr" in d["tpu_cells_from_cache"]        # labeled provenance
 
 
 def test_clean_full_run_does_not_inherit_stale_errors(tmp_path,
@@ -317,6 +328,42 @@ def test_cache_writes_are_atomic(tmp_path, monkeypatch):
     latest = [p for p in calls if p.endswith("tpu_latest.json")]
     assert len(latest) == 2            # canonical write + merge write
     assert len(calls) == 3             # + the timestamped archive
+
+
+def test_gates_off_archives_are_labeled_and_not_seedable(tmp_path,
+                                                         monkeypatch):
+    """chip_session's nopallas stage (SMTPU_PALLAS_*=0) measures with
+    kernel gates forced off; once any calibration verdict is armed those
+    numbers differ from canonical.  The archive must record the gate
+    overrides, never refresh tpu_latest, and never seed a fresh cache
+    (round-3 advisor, medium)."""
+    import glob as g
+    import os
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("BENCH_ONLY", "w2v")
+    monkeypatch.setenv("SMTPU_PALLAS_GATHER", "0")
+    monkeypatch.setenv("SMTPU_PALLAS_SCATTER", "0")
+    bench._cache_tpu_result(
+        {"platform": "tpu", "w2v": {"words_per_sec": 5.0e5}})
+    arch = g.glob(os.path.join(str(tmp_path), "tpu_*.json"))
+    assert len(arch) == 1
+    import json as j
+    rec = j.load(open(arch[0]))
+    assert rec["overrides"]["SMTPU_PALLAS_GATHER"] == "0"   # labeled
+    assert not os.path.exists(os.path.join(str(tmp_path),
+                                           "tpu_latest.json"))
+    assert not bench._seedable(arch[0])                     # non-seedable
+    monkeypatch.delenv("SMTPU_PALLAS_GATHER")
+    monkeypatch.delenv("SMTPU_PALLAS_SCATTER")
+    monkeypatch.delenv("BENCH_ONLY")
+    # a fresh-cache merge must NOT inherit the gates-off number
+    assert bench._merge_cached_tpu_fields(
+        {"lr": {"rows_per_sec": 1.0}}) is None
+    lk = bench._last_known_tpu()
+    assert "w2v" not in lk["result"]
 
 
 def test_seed_skips_shape_override_archives(tmp_path, monkeypatch):

@@ -55,6 +55,41 @@ def test_batched_topk_one_matmul_shape():
     assert keys.shape == (2, 2) and scores.shape == (2, 2)
 
 
+def test_mixed_exclusion_counts_keep_full_k():
+    """A query excluding FEWER rows must still get its full k neighbors
+    (round-3 advisor: uniform k_eff shrank every query to the worst
+    exclusion count)."""
+    idx = _toy_index()
+    keys, scores = idx.topk(idx.vecs[:2], k=3,
+                            exclude_rows=[[0, 1], [1]])
+    assert keys.shape == (2, 3)
+    # query 1 excluded only row 1: all three survivors are real
+    assert np.isfinite(scores[1]).all()
+    assert 11 not in keys[1][np.isfinite(scores[1])]
+    # query 0 excluded rows 0 and 1: two survivors + one -inf pad
+    fin0 = np.isfinite(scores[0])
+    assert fin0.sum() == 2
+    assert not {10, 11} & set(keys[0][fin0].tolist())
+
+
+def test_all_rows_excluded_pads_instead_of_crashing():
+    """V <= exclusions edge: every fetched row excluded for a query
+    must yield an all--inf row, not a shape error (round-3 advisor)."""
+    idx = _toy_index()
+    keys, scores = idx.topk(idx.vecs[:1], k=4,
+                            exclude_rows=[[0, 1, 2, 3]])
+    assert keys.shape == (1, 4)
+    assert not np.isfinite(scores[0]).any()
+
+
+def test_neighbors_batch_drops_inf_padding():
+    idx = _toy_index()
+    ks, ss = idx.neighbors_batch([10, 12], k=10)   # k > V
+    for k_arr, s_arr in zip(ks, ss):
+        assert np.isfinite(s_arr).all()            # pads dropped
+        assert len(k_arr) == 3                     # V-1 real neighbors
+
+
 def test_from_text_roundtrip_with_model_dump(tmp_path):
     """End to end against the REAL dump layout: train a tiny model,
     save(), load via from_text, and check a known co-occurrence pair
