@@ -24,7 +24,7 @@ def _fat_chip_result():
         "device_kind": "TPU v5 lite",
         "w2v": {"words_per_sec": 1402717.2962867722,
                 "step_ms": 11.680186765623546, "loss": 2640918.5,
-                "rendering": "gather"},
+                "rendering": "gather", "hbm_gbps": 81.4, "hbm_pct": 9.9},
         "w2v_epoch": {"epoch_wall_s": 0.27676871100002427,
                       "tokens": 300000, "loss": 4.1},
         "lr": {"rows_per_sec": 3000676.0650775912, "auc_proxy": 0.9,
@@ -32,6 +32,8 @@ def _fat_chip_result():
         "s2v": {"sents_per_sec": 6297.874, "batch": 1024},
         "w2v_shared": {"words_per_sec": 1480000.1, "pool": 4096},
         "w2v_sg": {"words_per_sec": 169783.4, "step_ms": 96.5},
+        "w2v_sg_shared": {"words_per_sec": 1250000.0, "step_ms": 13.1,
+                          "rendering": "sg_shared"},
         "w2v_text8": {"epoch_wall_s": 2.9639317830001346,
                       "corpus_tokens_per_sec": 5735624.58,
                       "corpus_tokens": 17000000, "vocab": 69645,
@@ -39,7 +41,7 @@ def _fat_chip_result():
         "w2v_1m": {"words_per_sec": 181187.0, "step_ms": 90.4,
                    "vocab": 1000000},
         "tfm": {"tokens_per_sec": 155000.0, "step_ms": 52.0,
-                "params_m": 29.1},
+                "params_m": 29.1, "mfu_pct": 10.2},
         "glove": {"cells_per_sec": 900000.0, "loss": 0.04},
     }
 
@@ -182,6 +184,7 @@ def test_healthy_two_sided_line_unchanged_in_spirit(monkeypatch,
            "w2v": {"words_per_sec": 112000.0, "step_ms": 146.0,
                    "loss": 2640919.0},
            "lr": {"rows_per_sec": 11544900.0},
+           "w2v_sg": {"words_per_sec": 13585.9},
            "cpp_oracle": {"words_per_sec": 120000.0}}
     monkeypatch.setattr(
         bench, "_run_child",
@@ -196,3 +199,53 @@ def test_healthy_two_sided_line_unchanged_in_spirit(monkeypatch,
     assert d["secondary"]["lr_a9a"]["vs_baseline"] == round(
         3000676.0650775912 / 11544900.0, 2)
     assert "last_known_tpu" not in d          # chip ran; no cache block
+    # roofline position rides the line (round-3 verdict Weak #5)
+    assert d["detail"]["hbm_pct"] == 9.9
+    assert d["secondary"]["transformer_lm"]["mfu_pct"] == 10.2
+    # the MXU-first sg rendering is paired against CPU PARITY sg,
+    # labeled explicitly (it has no meaningful CPU twin)
+    sgs = d["secondary"]["w2v_sg_shared"]
+    assert "vs_baseline" not in sgs
+    assert sgs["vs_cpu_sg"] == round(1250000.0 / 13585.9, 2)
+
+
+def test_roofline_models():
+    """Utilization fields from the documented traffic/FLOP models."""
+    import numpy as np
+
+    class Dev:
+        device_kind = "TPU v5 lite"
+
+    class Table:
+        state = {"h": np.zeros((1, 1), np.float32)}
+
+    class M:
+        len_vec = 100
+        window = 4
+        negative = 20
+        shared_pool = 4096
+        resolved_rendering = "gather"
+        table = Table()
+
+    # parity CBOW at bench shape: (B*(K+1) + B*2W) rows pulled, same
+    # pushed at 4 row-passes -> 5 passes total
+    b = bench._w2v_step_bytes(M(), 16384)
+    rows = 16384 * 21 + 16384 * 8
+    assert b == rows * 100 * 4 + rows * 100 * (2 * 4 + 2 * 4)
+    r = bench._roofline(Dev(), 0.01168, hbm_bytes=b)
+    assert r["hbm_gbps"] == round(b / 0.01168 / 1e9, 1)
+    assert r["hbm_pct"] == round(100 * b / 0.01168 / 1e9 / 819.0, 1)
+    # sg_shared collapses the target gather to B + pool rows
+    M.resolved_rendering = "sg_shared"
+    assert bench._w2v_step_bytes(M(), 16384) < b
+    # dense-logits is not a row-transaction rendering
+    M.resolved_rendering = "dense"
+    assert bench._w2v_step_bytes(M(), 16384) is None
+    # MFU against the bf16 peak
+    r = bench._roofline(Dev(), 0.052, flops=6.0 * 29.1e6 * 64 * 512)
+    assert r["mfu_pct"] == round(
+        100 * 6.0 * 29.1e6 * 64 * 512 / 0.052 / 1e12 / 197.0, 1)
+    # unknown device kind: no utilization fields, never a KeyError
+    class Unknown:
+        device_kind = "TPU v99"
+    assert bench._roofline(Unknown(), 0.01, hbm_bytes=1e9) == {}

@@ -565,6 +565,110 @@ def test_w2v_shared_negatives_grads_match_numpy(devices8):
                                rtol=2e-3, atol=1e-6)
 
 
+def test_w2v_sg_shared_trains(devices8):
+    """Skip-gram + shared pool (sg: 1, shared_negatives: 1): the
+    TPU-first rendering of BASELINE config #2 — target gather collapses
+    from B*2W*(K+1) rows to B + pool (round-3 verdict Weak #6)."""
+    corpus = synthetic_corpus(150, vocab_size=50, length=12, seed=9)
+    model = make_model(word2vec={"sg": 1, "shared_negatives": 1,
+                                 "shared_pool": 256})
+    model.build(corpus)
+    losses = model.train(corpus, niters=4, batch_size=128)
+    assert model.resolved_rendering == "sg_shared"
+    assert losses[-1] < losses[0], losses
+
+
+def test_w2v_sg_shared_cooccurrence(devices8):
+    rng = np.random.default_rng(0)
+    topic_a = list(range(1, 21))
+    topic_b = list(range(21, 41))
+    corpus = [[int(w) for w in rng.choice(
+        topic_a if i % 2 == 0 else topic_b, size=12)] for i in range(120)]
+    model = make_model(word2vec={"sg": 1, "shared_negatives": 1,
+                                 "shared_pool": 256})
+    model.train(corpus, niters=8, batch_size=128)
+
+    def vec(k):
+        v = model.embedding(k)
+        return v / (np.linalg.norm(v) + 1e-9)
+
+    within = np.mean([vec(topic_a[i]) @ vec(topic_a[j])
+                      for i in range(5) for j in range(5) if i != j])
+    across = np.mean([vec(topic_a[i]) @ vec(topic_b[j])
+                      for i in range(5) for j in range(5)])
+    assert within > across, (within, across)
+
+
+def test_w2v_sg_shared_grads_match_numpy(devices8):
+    """Golden check of the sg shared-pool gradient phase: per-PAIR
+    positive grads (mean-normalized at push), one summed pool family
+    (no mean attenuation), per-pair v grads from both terms."""
+    model = make_model(word2vec={"sg": 1, "shared_negatives": 1,
+                                 "shared_pool": 16, "negative": 4,
+                                 "len_vec": 8, "window": 2})
+    corpus = synthetic_corpus(10, vocab_size=30, length=10, seed=5)
+    model.build(corpus)
+    B, W2 = 24, 4
+    V = len(model.vocab)
+    rng = np.random.default_rng(2)
+    centers = np.zeros(B, np.int32)
+    centers[12:] = rng.integers(0, V, size=12)
+    contexts = rng.integers(0, V, size=(B, W2)).astype(np.int32)
+    mask = np.ones((B, W2), bool)
+    mask[3, 1:] = False                       # padded pairs must be dead
+    key = jax.random.key(11)
+
+    grads_fn = jax.jit(model._build_grads())
+    pushes, es, ec = grads_fn(
+        model.table.state, model._slot_of_vocab, model._alias_prob,
+        model._alias_idx, jnp.asarray(centers), jnp.asarray(contexts),
+        jnp.asarray(mask), key)
+    ((pos_slots, pos_g, pos_mean), (neg_slots, neg_g, neg_mean),
+     (ctx_slots, ctx_g, ctx_mean)) = pushes
+    assert pos_mean and ctx_mean and not neg_mean
+
+    K = model.shared_pool
+    negs = np.asarray(sample_alias(key, model._alias_prob,
+                                   model._alias_idx, (K,)))
+    sov = np.asarray(model._slot_of_vocab)
+    h = np.asarray(model.table.state["h"])
+    v = np.asarray(model.table.state["v"])
+    alpha, ratio = model.alpha, model.negative / K
+    d = 8
+    sig = lambda f: 1.0 / (1.0 + np.exp(-np.clip(f, -6, 6)))
+
+    v_in = v[sov[contexts]]                                  # (B, W2, d)
+    want_pos = np.zeros((B, W2, d))
+    want_neg = np.zeros((K, d))
+    want_ctx = np.zeros((B, W2, d))
+    for b in range(B):
+        h_c = h[sov[centers[b]]]
+        for w in range(W2):
+            if not mask[b, w]:
+                continue
+            g_pos = (1.0 - sig(float(v_in[b, w] @ h_c))) * alpha
+            want_pos[b, w] = g_pos * v_in[b, w]
+            want_ctx[b, w] = g_pos * h_c
+            for k in range(K):
+                if negs[k] == centers[b]:
+                    continue
+                g = (0.0 - sig(float(v_in[b, w] @ h[sov[negs[k]]]))) \
+                    * alpha * ratio
+                want_neg[k] += g * v_in[b, w]
+                want_ctx[b, w] += g * h[sov[negs[k]]]
+    np.testing.assert_allclose(np.asarray(pos_g["h"]),
+                               want_pos.reshape(-1, d),
+                               rtol=2e-3, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(neg_g["h"]), want_neg,
+                               rtol=2e-3, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(ctx_g["v"]),
+                               want_ctx.reshape(-1, d),
+                               rtol=2e-3, atol=1e-6)
+    # dead pair slots are masked out of the positive/context families
+    assert np.asarray(pos_slots).reshape(B, W2)[3, 1] == -1
+    assert np.asarray(ctx_slots).reshape(B, W2)[3, 1] == -1
+
+
 def test_w2v_bfloat16_table_trains_and_roundtrips(tmp_path, devices8):
     """[server] dtype: bfloat16 — embedding fields stored at half width
     (the TPU gather/scatter bytes), math in fp32, accumulators fp32."""
