@@ -25,6 +25,25 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 import numpy as np  # noqa: E402
 
 
+def _build_1m(dev):
+    """The 1M-vocab cell's exact device-side shape as a single batch —
+    the ablation target for round-3 verdict Next #4: what fraction of
+    the 90.4ms step is the capacity-range gather vs scatter vs
+    sampling.  Model construction is bench.build_w2v_1m_model, the SAME
+    builder the timed cell uses, so a cell retune can't silently
+    desynchronize the profiled shape (review finding)."""
+    import jax.numpy as jnp
+    import bench
+
+    model, rng = bench.build_w2v_1m_model(dev)
+    V = bench.W2V_1M_VOCAB
+    B, W2 = bench.BATCH, 2 * model.window
+    centers = jnp.asarray(rng.integers(0, V, size=(B,)), jnp.int32)
+    contexts = jnp.asarray(rng.integers(0, V, size=(B, W2)), jnp.int32)
+    mask = jnp.asarray(rng.random((B, W2)) < 0.8)
+    return model, centers, contexts, mask
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -32,7 +51,19 @@ def main():
 
     dev = jax.devices()[0]
     print(f"device: {dev}", flush=True)
-    model, step, batches = bench._build_w2v(dev)
+    if os.environ.get("PROFILE_SCALE") == "1m":
+        model, centers, contexts, mask = _build_1m(dev)
+        centers = jax.device_put(centers, dev)
+        contexts = jax.device_put(contexts, dev)
+        mask = jax.device_put(mask, dev)
+        print(f"shape: 1M vocab, capacity {model.table.capacity}",
+              flush=True)
+    else:
+        model, step, batches = bench._build_w2v(dev)
+        b0 = batches[0]
+        centers = jax.device_put(jnp.asarray(b0.centers), dev)
+        contexts = jax.device_put(jnp.asarray(b0.contexts), dev)
+        mask = jax.device_put(jnp.asarray(b0.ctx_mask), dev)
     d = model.len_vec
     K = model.negative
     B = bench.BATCH
@@ -43,10 +74,6 @@ def main():
     sov = jax.device_put(model._slot_of_vocab, dev)
     ap = jax.device_put(model._alias_prob, dev)
     ai = jax.device_put(model._alias_idx, dev)
-    b0 = batches[0]
-    centers = jax.device_put(jnp.asarray(b0.centers), dev)
-    contexts = jax.device_put(jnp.asarray(b0.contexts), dev)
-    mask = jax.device_put(jnp.asarray(b0.ctx_mask), dev)
     key = jax.random.key(3)
 
     from swiftmpi_tpu.models.word2vec import _assemble_push, _cbow_targets
