@@ -43,6 +43,30 @@ def main(ab=True):
     rng = np.random.default_rng(0)
 
     print(f"device: {jax.devices()[0]}", flush=True)
+
+    # 1M-vocab locality cell (round-4, VERDICT #4 decision data): at
+    # cap=1.3M the table is ~520MB and random rows may thrash DRAM
+    # pages where the demo-scale table did not.  Random vs sorted vs
+    # sequential indices bound the locality headroom: if sorted ≈
+    # sequential ≪ random, an in-step argsort(+unpermute, itself a
+    # row-local gather) could pay; if random ≈ sorted, the 1M step's
+    # gap vs its transaction floor lives elsewhere (see profile_1m).
+    cap1m, d = 1_300_001, 100
+    table = jnp.asarray(rng.standard_normal((cap1m, d)), jnp.float32)
+    take = jax.jit(lambda t, i: jnp.take(t, i, axis=0).sum())
+    for label, arr in (
+            ("random", rng.integers(0, cap1m, N)),
+            ("sorted", np.sort(rng.integers(0, cap1m, N))),
+            # truly contiguous (rows 0..N-1): a strided or sorted-draw
+            # pattern has nearly the same inter-row gap distribution as
+            # "sorted" and would make the comparison vacuous
+            ("sequential", np.arange(N))):
+        idx = jnp.asarray(arr, jnp.int32)
+        ms = timeit(take, table, idx) * 1e3
+        print(f"gather1m cap={cap1m} d={d} {label:10s} {ms:7.2f} ms  "
+              f"{N * d * 4 / 1e9 / ms * 1e3:6.1f} GB/s", flush=True)
+    del table
+
     for cap in (17_314, 262_144):
         idx = jnp.asarray(rng.integers(0, cap, N), jnp.int32)
         for d in (100, 128):
