@@ -1,0 +1,64 @@
+"""Tiny-shape drives of bench.py's measurement cells whose first real
+execution would otherwise happen on the scarce live tunnel — a cell
+that crashes mid-window burns a stage and its evidence.  Shapes are
+monkeypatched down; semantics (modes, labels, finiteness) are pinned,
+not performance."""
+
+import os
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/tests/", 1)[0])
+
+jax = pytest.importorskip("jax")
+
+import bench  # noqa: E402
+from swiftmpi_tpu.data import native  # noqa: E402
+
+needs_native = pytest.mark.skipif(
+    not native.available(), reason="native loader not built")
+
+
+@pytest.fixture
+def tiny_shapes(monkeypatch):
+    # demo-parity subsampling (sample=1e-5) keeps only a few % of toy
+    # tokens as centers — corpus sized so a couple of full 256-center
+    # batches survive
+    monkeypatch.setattr(bench, "BATCH", 256)
+    monkeypatch.setattr(bench, "INNER_STEPS", 2)
+    monkeypatch.setattr(bench, "SENTENCES", 300)
+    monkeypatch.setattr(bench, "SENT_LEN", 80)
+    monkeypatch.setattr(bench, "VOCAB", 400)
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+
+
+@needs_native
+def test_fused_epoch_cell_tiny(tiny_shapes, monkeypatch):
+    """BENCH_EPOCH_FUSED=1: whole epoch in one donated dispatch —
+    label, batch accounting, and a sane loss at toy shape."""
+    monkeypatch.setenv("BENCH_EPOCH_FUSED", "1")
+    dev = jax.devices()[0]
+    model, _, _ = bench._build_w2v(dev)
+    out = bench._bench_w2v_epoch(dev, model)
+    assert out["mode"] == "fused_epoch"
+    assert out["n_batches"] >= 1
+    assert out["corpus_tokens"] == 300 * 80
+    assert out["epoch_wall_s"] > 0
+    assert np.isfinite(out["loss"]) and out["loss"] > 0
+
+
+@needs_native
+def test_public_epoch_cell_tiny(tiny_shapes):
+    """The public-path epoch cell (the A/B's other arm) at the same
+    toy shape: no mode label, same token accounting, and the model's
+    tail-fuse freeze is released afterwards."""
+    dev = jax.devices()[0]
+    model, _, _ = bench._build_w2v(dev)
+    out = bench._bench_w2v_epoch(dev, model)
+    assert "mode" not in out
+    assert out["corpus_tokens"] == 300 * 80
+    assert out["epoch_wall_s"] > 0
+    assert model._tail_fuse_frozen is False
