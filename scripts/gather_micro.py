@@ -35,22 +35,50 @@ def timeit(fn, *args, reps=16):
     return (time.perf_counter() - t0) / reps
 
 
-def main(ab=True):
+def locality_cells():
+    """Round-4 decision diagnostics, cheap enough for the window's
+    priority block (~1 min on chip; also folded into the full grid).
+
+    H2D: the text8 epoch wall (2.96s) exceeds steady-state steps
+    (163 x 11.68ms = 1.90s) by ~1s, and the per-batch H2D stream
+    (~140MB/epoch of stacked centers/contexts/masks) at tunnel
+    bandwidth is the prime suspect.  If measured GB/s puts 140MB near
+    1s, a ship-tokens-once device-side batcher is the next text8
+    attack; if H2D is fast, the gap is dispatch/queue latency and
+    fatter scan groups are.
+
+    gather1m (VERDICT #4 decision data): at cap=1.3M the table is
+    ~520MB and random rows may thrash DRAM pages where the demo-scale
+    table did not.  Random vs sorted vs contiguous bounds the locality
+    headroom: if sorted ≈ contiguous ≪ random, an in-step
+    argsort(+unpermute, itself a row-local gather) could pay; if
+    random ≈ sorted, the 1M step's gap vs its transaction floor lives
+    elsewhere (see profile_1m)."""
     import jax
     import jax.numpy as jnp
 
     N = 344_064          # bench gather count: B*(K+1) at B=16384, K=20
     rng = np.random.default_rng(0)
-
     print(f"device: {jax.devices()[0]}", flush=True)
 
-    # 1M-vocab locality cell (round-4, VERDICT #4 decision data): at
-    # cap=1.3M the table is ~520MB and random rows may thrash DRAM
-    # pages where the demo-scale table did not.  Random vs sorted vs
-    # sequential indices bound the locality headroom: if sorted ≈
-    # sequential ≪ random, an in-step argsort(+unpermute, itself a
-    # row-local gather) could pay; if random ≈ sorted, the 1M step's
-    # gap vs its transaction floor lives elsewhere (see profile_1m).
+    def _bracket(fn):
+        t0 = time.perf_counter()
+        fn()
+        return time.perf_counter() - t0
+
+    for mb in (8, 64):
+        nbytes = mb * 1024 * 1024
+        host = np.random.default_rng(1).integers(
+            0, 1 << 30, size=(nbytes // 4,)).astype(np.int32)
+        put = lambda a: jax.device_put(a).block_until_ready()
+        put(host)                 # warm the large-transfer path too
+        # min of several reps, like the gather cells — one tunnel
+        # transfer is a noisy sample and this number decides between
+        # two different text8 attacks
+        dt = min(_bracket(lambda: put(host)) for _ in range(4))
+        print(f"h2d     {mb:3d} MB  {dt * 1e3:7.2f} ms  "
+              f"{nbytes / 1e9 / dt:6.2f} GB/s", flush=True)
+
     cap1m, d = 1_300_001, 100
     table = jnp.asarray(rng.standard_normal((cap1m, d)), jnp.float32)
     take = jax.jit(lambda t, i: jnp.take(t, i, axis=0).sum())
@@ -65,7 +93,16 @@ def main(ab=True):
         ms = timeit(take, table, idx) * 1e3
         print(f"gather1m cap={cap1m} d={d} {label:10s} {ms:7.2f} ms  "
               f"{N * d * 4 / 1e9 / ms * 1e3:6.1f} GB/s", flush=True)
-    del table
+
+
+def main(ab=True):
+    import jax
+    import jax.numpy as jnp
+
+    N = 344_064          # bench gather count: B*(K+1) at B=16384, K=20
+    rng = np.random.default_rng(0)
+
+    locality_cells()              # prints the device line
 
     for cap in (17_314, 262_144):
         idx = jnp.asarray(rng.integers(0, cap, N), jnp.int32)
@@ -272,5 +309,7 @@ if __name__ == "__main__":
         pallas_ab()
     elif "--dense-only" in sys.argv:
         dense_cells()
+    elif "--locality-only" in sys.argv:
+        locality_cells()
     else:
         main(ab="--no-ab" not in sys.argv)
