@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Render the current chip evidence as a markdown table.
+
+Reads ``.bench_cache/tpu_latest.json`` (canonical chip cells, per-field
+provenance) and ``BENCH_REPORT.json`` (the last full bench run — the
+CPU baselines), and prints the measured table in the layout
+README/ARCHITECTURE use, with per-cell roofline fields when the cells
+carry them.  Run after a live window (or anytime) to refresh the docs
+without hand-transcription errors:
+
+    python scripts/render_results.py
+"""
+
+import json
+import os
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# cell key -> (label, value field, unit, cpu comparator key)
+CELLS = [
+    ("w2v", "w2v CBOW+NS (parity mode)", "words_per_sec", "words/s",
+     "w2v"),
+    ("w2v_epoch", "w2v epoch wall (train(), 300K tokens)",
+     "epoch_wall_s", "s", "w2v_epoch"),
+    ("w2v_epoch_fused", "w2v epoch wall (fused one-dispatch A/B)",
+     "epoch_wall_s", "s", "w2v_epoch"),
+    ("w2v_text8", "w2v text8-scale epoch (17M tokens)", "epoch_wall_s",
+     "s", "w2v_text8"),
+    ("w2v_shared", "w2v shared-negatives (MXU mode)", "words_per_sec",
+     "words/s", None),
+    ("w2v_sg", "w2v skip-gram (per-pair parity)", "words_per_sec",
+     "words/s", "w2v_sg"),
+    ("w2v_sg_shared", "w2v skip-gram shared-pool (MXU mode)",
+     "words_per_sec", "words/s", "w2v_sg"),
+    ("w2v_1m", "w2v 1M-vocab (fp32)", "words_per_sec", "words/s", None),
+    ("w2v_1m_bf16", "w2v 1M-vocab (bf16 storage)", "words_per_sec",
+     "words/s", None),
+    ("lr", "LR a9a-shape", "rows_per_sec", "rows/s", "lr"),
+    ("lr_u4", "LR a9a (scan unroll 4)", "rows_per_sec", "rows/s", "lr"),
+    ("lr_u4e4", "LR a9a (scan+epoch unroll 4)", "rows_per_sec",
+     "rows/s", "lr"),
+    ("s2v", "sent2vec", "sents_per_sec", "sents/s", "s2v"),
+    ("glove", "GloVe co-occurrence cells", "cells_per_sec", "cells/s",
+     None),
+    ("tfm", "transformer LM", "tokens_per_sec", "tokens/s", None),
+    ("tfm_remat", "transformer LM (remat A/B)", "tokens_per_sec",
+     "tokens/s", None),
+]
+
+
+def _fmt(v, unit):
+    if v is None:
+        return "—"
+    if unit == "s":
+        return f"{v:.3f}s"
+    if v >= 1e6:
+        return f"{v / 1e6:.2f}M {unit}"
+    if v >= 1e3:
+        return f"{v / 1e3:.0f}K {unit}"
+    return f"{v:.1f} {unit}"
+
+
+def main():
+    try:
+        with open(os.path.join(REPO, ".bench_cache",
+                               "tpu_latest.json")) as f:
+            lk = json.load(f)
+    except OSError:
+        print("no canonical chip evidence (.bench_cache/tpu_latest.json)")
+        sys.exit(1)
+    res = lk.get("result") or {}
+    merged = lk.get("merged") or {}
+    cpu = {}
+    try:
+        with open(os.path.join(REPO, "BENCH_REPORT.json")) as f:
+            rep = json.load(f)
+        det = rep.get("detail") or {}
+        if det.get("cpu_baseline_words_per_sec"):
+            cpu["w2v"] = {"words_per_sec":
+                          det["cpu_baseline_words_per_sec"]}
+        for name, entry in (rep.get("secondary") or {}).items():
+            key = {"w2v_epoch_wall": "w2v_epoch", "lr_a9a": "lr",
+                   "sent2vec": "s2v", "w2v_skipgram": "w2v_sg",
+                   "w2v_text8_epoch_wall": "w2v_text8"}.get(name)
+            if key and "cpu" in entry:
+                field = ("epoch_wall_s" if entry.get("unit") == "s"
+                         else {"lr": "rows_per_sec",
+                               "s2v": "sents_per_sec"}.get(
+                             key, "words_per_sec"))
+                cpu[key] = {field: entry["cpu"]}
+    except OSError:
+        pass
+
+    print(f"Chip evidence as of {lk.get('iso')} "
+          f"(device: {res.get('device_kind', '?')})\n")
+    print("| benchmark | TPU | CPU baseline | ratio | roofline |")
+    print("|---|---|---|---|---|")
+    for key, label, field, unit, cpu_key in CELLS:
+        cell = res.get(key)
+        if not isinstance(cell, dict) or field not in cell:
+            continue
+        if key.startswith("tfm") and cell.get("batch"):
+            label += f" (B={cell['batch']}" + \
+                (", remat)" if cell.get("remat") else ")")
+        t = cell[field]
+        c = (cpu.get(cpu_key) or {}).get(field) if cpu_key else None
+        if c:
+            ratio = c / t if unit == "s" else t / c
+            ratio_s = f"{ratio:.1f}x"
+        else:
+            ratio_s = "—"
+        roof = ""
+        if "hbm_pct" in cell:
+            roof = f"{cell['hbm_pct']}% HBM ({cell.get('hbm_gbps')} GB/s)"
+        elif "mfu_pct" in cell:
+            roof = f"{cell['mfu_pct']}% MFU ({cell.get('tflops')} TF/s)"
+        prov = f" *(merged {merged[key][:10]})*" if key in merged else ""
+        print(f"| {label} | **{_fmt(t, unit)}** | {_fmt(c, unit)} | "
+              f"{ratio_s} | {roof}{prov} |")
+
+
+if __name__ == "__main__":
+    main()
