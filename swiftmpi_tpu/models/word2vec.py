@@ -727,9 +727,15 @@ class Word2Vec:
                       PushSpec(neg_slots, {"h": gh_neg}),
                       PushSpec(cslots_flat, {"v": v_flat}, mean=True))
 
+            # loss terms carry the same negative/K weighting as the
+            # gradients (advisor r04, both shared-pool variants): a
+            # center contributes ~1 positive + ~`negative` weighted pool
+            # terms, keeping the reported loss scale-comparable with the
+            # per-center parity CBOW rendering
+            ratio = self.negative / K
             err_sum = jnp.sum(1e4 * g_pos * g_pos) \
-                + jnp.sum(1e4 * g_neg * g_neg)
-            err_cnt = row_valid.sum() + n_valid.sum()
+                + ratio * jnp.sum(1e4 * g_neg * g_neg)
+            err_cnt = row_valid.sum() + ratio * n_valid.sum()
             return pushes, err_sum, err_cnt
 
         return grads_fn
@@ -872,9 +878,15 @@ class Word2Vec:
                       PushSpec(ctx_slots.reshape(-1),
                                {"v": v_contrib.reshape(-1, d)}, mean=True))
 
+            # loss terms carry the SAME negative/K weighting as the
+            # gradients (advisor r04): a pair contributes ~1 positive +
+            # ~`negative` weighted pool terms, so the reported loss is
+            # scale-comparable with the per-pair parity sg rendering
+            # instead of ~K/negative times off
+            ratio = self.negative / K
             err_sum = jnp.sum(1e4 * g_pos * g_pos) \
-                + jnp.sum(1e4 * g_neg * g_neg)
-            err_cnt = ctx_mask.sum() + n_valid.sum()
+                + ratio * jnp.sum(1e4 * g_neg * g_neg)
+            err_cnt = ctx_mask.sum() + ratio * n_valid.sum()
             return pushes, err_sum, err_cnt
 
         return grads_fn

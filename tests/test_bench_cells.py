@@ -51,6 +51,25 @@ def test_fused_epoch_cell_tiny(tiny_shapes, monkeypatch):
 
 
 @needs_native
+def test_100m_cell_tiny(tiny_shapes, monkeypatch):
+    """BASELINE config #3 cell at smoke shape: streaming epoch through
+    the native loader with the async (local_steps=4) path — labels,
+    loader accounting, finite loss.  The real 100M-token shape runs via
+    scripts/config3_scale.py (CPU) / chip_session bench_100m (TPU)."""
+    monkeypatch.setenv("BENCH_100M_SENTS", "300")
+    monkeypatch.setenv("BENCH_100M_VOCAB", "500")
+    monkeypatch.setenv("BENCH_100M_LEN", "80")
+    dev = jax.devices()[0]
+    out = bench._bench_w2v_100m(dev)
+    assert out["corpus_tokens"] == 300 * 80
+    assert out["local_steps"] == 4
+    assert out["loader_tokens_per_sec"] > 0
+    assert out["vocab"] > 100
+    assert out["epoch_wall_s"] > 0
+    assert np.isfinite(out["loss"]) and out["loss"] > 0
+
+
+@needs_native
 def test_public_epoch_cell_tiny(tiny_shapes):
     """The public-path epoch cell (the A/B's other arm) at the same
     toy shape: no mode label, same token accounting, and the model's

@@ -154,3 +154,21 @@ def test_stage_merge_rename_spec(iso_cache):
     lk = bench._last_known_tpu()
     assert lk["result"]["w2v_1m"]["dtype"] == "float32"       # intact
     assert lk["result"]["w2v_1m_bf16"]["words_per_sec"] == 3.0e5
+
+
+def test_stage_merge_label_derived_from_env():
+    """Advisor r04: the tuned-text8 cell's cache label must be derived
+    from the stage's OWN env, so retuning BENCH_TEXT8_MB in the agenda
+    can never archive the cell under a stale shape key."""
+    rec = {"platform": "tpu",
+           "w2v_text8": {"epoch_wall_s": 2.5, "batch_size": 32768}}
+    fields = chip_session._resolve_merge_fields(
+        "bench_text8_mb", rec,
+        env={"BENCH_TEXT8": "1", "BENCH_TEXT8_MB": "32768",
+             "BENCH_SCAN": "16"})
+    assert set(fields) == {"w2v_text8_mb32768"}
+    # a retuned agenda value flows straight into the label
+    fields = chip_session._resolve_merge_fields(
+        "bench_text8_mb", rec,
+        env={"BENCH_TEXT8": "1", "BENCH_TEXT8_MB": "65536"})
+    assert set(fields) == {"w2v_text8_mb65536"}

@@ -460,15 +460,22 @@ def test_w2v_hogwild_guards(devices8):
 
 def test_w2v_shared_negatives_trains(devices8):
     """TPU-first opt-in (shared_negatives: 1): one weighted pool of
-    negatives shared by the batch, MXU-matmul NS math.  A different
-    sampling of the same objective, so its per-pair error is not
-    numerically comparable to parity mode — assert convergence here and
-    embedding quality in the co-occurrence test below."""
+    negatives shared by the batch, MXU-matmul NS math.  The error terms
+    carry the gradients' negative/K weighting (advisor r04), so the
+    reported loss is SCALE-comparable with parity mode — pinned here —
+    while the pool sampling still converges differently at toy scale
+    (embedding quality is the co-occurrence test below)."""
     corpus = synthetic_corpus(150, vocab_size=50, length=12, seed=9)
+    parity = make_model()
+    parity_losses = parity.train(corpus, niters=1, batch_size=128)
     fast = make_model(word2vec={"shared_negatives": 1, "shared_pool": 256})
-    fast_losses = fast.train(corpus, niters=4, batch_size=128)
+    fast_losses = fast.train(corpus, niters=8, batch_size=128)
+    # same loss scale as parity mode (the weighting's whole point): the
+    # old unweighted metric sat ~K/negative = 85x below it
+    assert abs(fast_losses[0] - parity_losses[0]) < 0.15 * parity_losses[0], \
+        (fast_losses[0], parity_losses[0])
     assert fast_losses[-1] < fast_losses[0], fast_losses
-    assert fast_losses[-1] < 0.8 * fast_losses[0], fast_losses
+    assert min(fast_losses) < 0.9 * fast_losses[0], fast_losses
 
 
 def test_w2v_shared_negatives_cooccurrence(devices8):
