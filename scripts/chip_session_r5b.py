@@ -1,0 +1,108 @@
+#!/usr/bin/env python
+"""Round-5 continuation of the live-window agenda, value-ordered.
+
+The r5 window opened with scripts/chip_session.py and banked the whole
+verdict-priority block plus bench_full (live vs_baseline 14.2) in ~35
+minutes.  The stock agenda then ordered ~70 minutes of tuning sweeps
+(step_sweep, crossover — already measured in round 3) BEFORE the cells
+that have never been measured at all (text8 fused-epoch, the B=64
+transformer MFU cell, BASELINE config #3 at 100M tokens).  Windows
+historically last ~2h; this continuation runs the never-measured cells
+first so a tunnel loss costs re-runs, not firsts.
+
+Adds two new cells over the stock agenda:
+  - bench_scale_shared: the batch-shared negative-pool rendering at 1M
+    vocab (BENCH_SCALE_SHARED=1) — the r5 phase profile pins the
+    per-pair 1M cell on its B*(K+1)-row push; merged as w2v_1m_shared
+    (a labeled rendering variant, never clobbering the per-pair cell)
+  - bench_lr_e128: BENCH_LR_EPOCHS=128 + unroll 4 — decomposes the LR
+    cell's remaining 0.78x into dispatch amortization vs per-iteration
+    floor; merged as lr_e128
+"""
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+import bench  # noqa: E402
+import chip_session as cs  # noqa: E402
+
+cs.STAGE_MERGE_FIELDS["bench_scale_shared"] = (("w2v_1m",
+                                                "w2v_1m_shared"),)
+cs.STAGE_MERGE_FIELDS["bench_lr_e128"] = (("lr", "lr_e128"),)
+
+PY = sys.executable
+
+AGENDA = [
+    # never-measured firsts, in verdict order
+    ("bench_text8", [PY, "bench.py", "--child", "tpu"], 900,
+     {"BENCH_TEXT8": "1"}),
+    ("bench_text8_fused", [PY, "bench.py", "--child", "tpu"], 900,
+     {"BENCH_TEXT8": "1", "BENCH_EPOCH_FUSED": "1"}),
+    ("bench_tfm", [PY, "bench.py", "--child", "tpu"], 600,
+     {"BENCH_TFM": "1"}),
+    ("bench_tfm_remat", [PY, "bench.py", "--child", "tpu"], 600,
+     {"BENCH_TFM": "1", "BENCH_TFM_REMAT": "1"}),
+    ("bench_scale_shared", [PY, "bench.py", "--child", "tpu"], 600,
+     {"BENCH_ONLY": "scale", "BENCH_SCALE_SHARED": "1"}),
+    ("bench_lr_e128", [PY, "bench.py", "--child", "tpu"], 420,
+     {"BENCH_ONLY": "lr", "BENCH_LR_EPOCHS": "128",
+      "BENCH_LR_UNROLL": "4"}),
+    ("bench_100m", [PY, "bench.py", "--child", "tpu"], 2400,
+     {"BENCH_100M": "1"}),
+    ("bench_text8_mb", [PY, "bench.py", "--child", "tpu"], 900,
+     {"BENCH_TEXT8": "1", "BENCH_TEXT8_MB": "32768",
+      "BENCH_SCAN": "16"}),
+    # decision-data micros and tuning grids (round-3 re-runs)
+    ("dense_micro", [PY, "scripts/gather_micro.py", "--dense-only"],
+     420, None),
+    ("gather_micro", [PY, "scripts/gather_micro.py", "--no-ab"],
+     600, None),
+    ("scatter_micro", [PY, "scripts/scatter_micro.py", "--no-ab"],
+     600, None),
+    ("step_sweep", [PY, "scripts/step_sweep.py"], 2400, None),
+    ("crossover_chip", [PY, "scripts/crossover.py",
+                        "--single-device", "--reps", "3"], 1800, None),
+    # CPU side of the epoch-wall ratio (no tunnel needed; last)
+    ("bench_text8_cpu", [PY, "bench.py", "--child", "cpu"], 1800,
+     {"BENCH_TEXT8": "1", "JAX_PLATFORMS": "cpu",
+      "PALLAS_AXON_POOL_IPS": ""}),
+]
+
+
+def main():
+    if not bench._tpu_alive():
+        print("tunnel down — aborting continuation", flush=True)
+        sys.exit(1)
+    cs.log({"stage": "session_start",
+            "note": "r5b continuation, value-ordered remainder"})
+    try:
+        for name, cmd, timeout_s, env_extra in AGENDA:
+            ok, tail = cs.run(name, cmd, timeout_s, env_extra)
+            if ok and name in cs.STAGE_MERGE_FIELDS:
+                try:
+                    fields = cs._resolve_merge_fields(
+                        name, bench._parse_child_stdout(tail),
+                        env=env_extra)
+                    if fields:
+                        err = bench._merge_cached_tpu_fields(fields)
+                        cs.log({"stage": f"{name}_cache_merge",
+                                "rc": 0 if err is None else
+                                f"error: {err}"})
+                except Exception as e:
+                    cs.log({"stage": f"{name}_cache_merge",
+                            "rc": f"error: {type(e).__name__}: {e}"})
+            if (not ok and name != "bench_text8_cpu"
+                    and not bench._tpu_alive(timeout_s=60)):
+                cs.log({"stage": "session_end", "note": "tunnel lost"})
+                return
+        cs.log({"stage": "session_end", "note": "r5b agenda complete"})
+    finally:
+        cs.write_window_report()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,93 @@
+#!/usr/bin/env python
+"""Round-5 window, third block: cheap follow-ups to the r5b firsts,
+then the round-3-vintage re-measure tail.
+
+The r5b block measured: remat WINS the B=64 transformer A/B (283K ->
+339K tokens/s, 23.9% MFU — activations are HBM-pressure-limited, so
+larger batches + remat may clear the 30% bar), the shared-pool 1M cell
+at 500K words/s, and LR at 42.5M rows/s with 128 epochs/dispatch (the
+E-sweep decomposes the cell: ~62ms fixed per-dispatch cost, ~0.09ms
+per-epoch compute).  Each follow-up cell here costs ~25-60s.
+"""
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+import bench  # noqa: E402
+import chip_session as cs  # noqa: E402
+
+cs.STAGE_MERGE_FIELDS.update({
+    "bench_tfm_b128": (("tfm", "tfm_b128_remat"),),
+    "bench_tfm_b256": (("tfm", "tfm_b256_remat"),),
+    "bench_scale_shared_bf16": (("w2v_1m", "w2v_1m_shared_bf16"),),
+    "bench_lr_e256": (("lr", "lr_e256"),),
+})
+
+PY = sys.executable
+
+AGENDA = [
+    ("bench_tfm_b128", [PY, "bench.py", "--child", "tpu"], 600,
+     {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "128",
+      "BENCH_TFM_REMAT": "1"}),
+    ("bench_tfm_b256", [PY, "bench.py", "--child", "tpu"], 600,
+     {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "256",
+      "BENCH_TFM_REMAT": "1"}),
+    ("bench_scale_shared_bf16", [PY, "bench.py", "--child", "tpu"], 600,
+     {"BENCH_ONLY": "scale", "BENCH_SCALE_SHARED": "1",
+      "BENCH_DTYPE": "bfloat16"}),
+    ("bench_lr_e256", [PY, "bench.py", "--child", "tpu"], 420,
+     {"BENCH_ONLY": "lr", "BENCH_LR_EPOCHS": "256",
+      "BENCH_LR_UNROLL": "4"}),
+    # round-3-vintage re-measures and decision-data micros
+    ("dense_micro", [PY, "scripts/gather_micro.py", "--dense-only"],
+     420, None),
+    ("gather_micro", [PY, "scripts/gather_micro.py", "--no-ab"],
+     600, None),
+    ("scatter_micro", [PY, "scripts/scatter_micro.py", "--no-ab"],
+     600, None),
+    ("step_sweep", [PY, "scripts/step_sweep.py"], 2400, None),
+    ("crossover_chip", [PY, "scripts/crossover.py",
+                        "--single-device", "--reps", "3"], 1800, None),
+    ("bench_text8_cpu", [PY, "bench.py", "--child", "cpu"], 1800,
+     {"BENCH_TEXT8": "1", "JAX_PLATFORMS": "cpu",
+      "PALLAS_AXON_POOL_IPS": ""}),
+]
+
+
+def main():
+    if not bench._tpu_alive():
+        print("tunnel down — aborting r5c block", flush=True)
+        sys.exit(1)
+    cs.log({"stage": "session_start",
+            "note": "r5c follow-ups + re-measure tail"})
+    try:
+        for name, cmd, timeout_s, env_extra in AGENDA:
+            ok, tail = cs.run(name, cmd, timeout_s, env_extra)
+            if ok and name in cs.STAGE_MERGE_FIELDS:
+                try:
+                    fields = cs._resolve_merge_fields(
+                        name, bench._parse_child_stdout(tail),
+                        env=env_extra)
+                    if fields:
+                        err = bench._merge_cached_tpu_fields(fields)
+                        cs.log({"stage": f"{name}_cache_merge",
+                                "rc": 0 if err is None else
+                                f"error: {err}"})
+                except Exception as e:
+                    cs.log({"stage": f"{name}_cache_merge",
+                            "rc": f"error: {type(e).__name__}: {e}"})
+            if (not ok and name != "bench_text8_cpu"
+                    and not bench._tpu_alive(timeout_s=60)):
+                cs.log({"stage": "session_end", "note": "tunnel lost"})
+                return
+        cs.log({"stage": "session_end", "note": "r5c agenda complete"})
+    finally:
+        cs.write_window_report()
+
+
+if __name__ == "__main__":
+    main()

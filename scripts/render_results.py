@@ -36,16 +36,31 @@ CELLS = [
     ("w2v_1m", "w2v 1M-vocab (fp32)", "words_per_sec", "words/s", None),
     ("w2v_1m_bf16", "w2v 1M-vocab (bf16 storage)", "words_per_sec",
      "words/s", None),
+    ("w2v_1m_shared", "w2v 1M-vocab (shared-pool rendering)",
+     "words_per_sec", "words/s", None),
+    ("w2v_1m_shared_bf16", "w2v 1M-vocab (shared-pool + bf16)",
+     "words_per_sec", "words/s", None),
+    ("w2v_100m", "w2v 100M-token streaming epoch (config #3)",
+     "epoch_wall_s", "s", None),
+    ("w2v_text8_fused", "w2v text8 epoch (fused one-dispatch A/B)",
+     "epoch_wall_s", "s", "w2v_text8"),
     ("lr", "LR a9a-shape", "rows_per_sec", "rows/s", "lr"),
-    ("lr_u4", "LR a9a (scan unroll 4)", "rows_per_sec", "rows/s", "lr"),
-    ("lr_u4e4", "LR a9a (scan+epoch unroll 4)", "rows_per_sec",
+    ("lr_u4", "LR a9a scan-unroll A/B", "rows_per_sec", "rows/s",
+     "lr"),
+    ("lr_u4e4", "LR a9a scan+epoch-unroll A/B", "rows_per_sec",
      "rows/s", "lr"),
+    ("lr_e128", "LR a9a E-sweep", "rows_per_sec", "rows/s", "lr"),
+    ("lr_e256", "LR a9a E-sweep", "rows_per_sec", "rows/s", "lr"),
     ("s2v", "sent2vec", "sents_per_sec", "sents/s", "s2v"),
     ("glove", "GloVe co-occurrence cells", "cells_per_sec", "cells/s",
      None),
     ("tfm", "transformer LM", "tokens_per_sec", "tokens/s", None),
-    ("tfm_remat", "transformer LM (remat A/B)", "tokens_per_sec",
-     "tokens/s", None),
+    ("tfm_remat", "transformer LM", "tokens_per_sec", "tokens/s",
+     None),
+    ("tfm_b128_remat", "transformer LM", "tokens_per_sec", "tokens/s",
+     None),
+    ("tfm_b256_remat", "transformer LM", "tokens_per_sec", "tokens/s",
+     None),
 ]
 
 
@@ -103,6 +118,15 @@ def main():
         if key.startswith("tfm") and cell.get("batch"):
             label += f" (B={cell['batch']}" + \
                 (", remat)" if cell.get("remat") else ")")
+        if key.startswith("lr") and cell.get("epochs_per_dispatch"):
+            # self-describing labels (review): an lr cell measured
+            # under old defaults must not masquerade as the current
+            # configuration — label from cell content, never from the
+            # CELLS name
+            label += f" (E={cell['epochs_per_dispatch']}"
+            if cell.get("scan_unroll"):
+                label += f", unroll {cell['scan_unroll']}"
+            label += ")"
         t = cell[field]
         c = (cpu.get(cpu_key) or {}).get(field) if cpu_key else None
         if c:

@@ -81,3 +81,22 @@ def test_public_epoch_cell_tiny(tiny_shapes):
     assert out["corpus_tokens"] == 300 * 80
     assert out["epoch_wall_s"] > 0
     assert model._tail_fuse_frozen is False
+
+
+def test_scale_shared_cell_tiny(tiny_shapes, monkeypatch):
+    """BENCH_SCALE_SHARED=1: the 1M cell switches to the batch-shared
+    negative-pool rendering (the r5 phase profile pins the per-pair
+    cell on its B*(K+1)-row push) and the output labels itself — the
+    merged w2v_1m_shared cell must be distinguishable by content from
+    the per-pair w2v_1m cell."""
+    monkeypatch.setattr(bench, "W2V_1M_VOCAB", 5000)
+    monkeypatch.setenv("BENCH_SCALE_SHARED", "1")
+    dev = jax.devices()[0]
+    out = bench._bench_w2v_1m(dev, timed_calls=1)
+    assert out["rendering"] == "shared"
+    assert out["vocab"] == 5000
+    assert out["words_per_sec"] > 0
+    # and without the env the per-pair rendering stays the default
+    monkeypatch.delenv("BENCH_SCALE_SHARED")
+    out2 = bench._bench_w2v_1m(dev, timed_calls=1)
+    assert out2["rendering"] in ("gather", None)
