@@ -881,9 +881,21 @@ def _bench_tfm(device, timed_calls):
     # the memory, and the chip session records the on/off A/B
     # (BENCH_TFM_BATCH/BENCH_TFM_REMAT are _SHAPE_ENV-labeled).
     B = int(os.environ.get("BENCH_TFM_BATCH", 64))
-    S = 512
-    cfg = TransformerConfig(vocab_size=8192, d_model=512, n_heads=8,
-                            n_layers=4, d_ff=2048, max_seq=S,
+    S = int(os.environ.get("BENCH_TFM_SEQ", 512))
+    # model-size knobs (round-5): MFU rises with d_model because the
+    # attention/softmax/LN overhead amortizes against 6*P matmul FLOPs
+    # — the 21M-param default topped out at 28.5% (B=256+remat), so
+    # the chip session sweeps d_model/n_layers too
+    D = int(os.environ.get("BENCH_TFM_DMODEL", 512))
+    L = int(os.environ.get("BENCH_TFM_LAYERS", 4))
+    # largest head count with head_dim >= 64 that divides d_model —
+    # a non-divisor would trip TransformerConfig's assert after the
+    # stage already spent its tunnel-window time
+    H = max(D // 64, 1)
+    while D % H:
+        H -= 1
+    cfg = TransformerConfig(vocab_size=8192, d_model=D, n_heads=H,
+                            n_layers=L, d_ff=4 * D, max_seq=S,
                             dtype=jnp.bfloat16,
                             remat=os.environ.get("BENCH_TFM_REMAT",
                                                  "0") != "0")
@@ -912,6 +924,7 @@ def _bench_tfm(device, timed_calls):
     out = {"tokens_per_sec": B * S * timed_calls / dt,
            "step_ms": dt / timed_calls * 1e3, "loss": last,
            "batch": B, "seq": S, "remat": cfg.remat,
+           "d_model": D, "n_layers": L, "d_ff": cfg.d_ff, "n_heads": H,
            "params_m": round(n_params / 1e6, 1)}
     # training FLOP model: 6*P per token (fwd 2P + bwd 4P) plus the
     # attention score/value matmuls 12*L*S*d per token (fwd+bwd); remat
@@ -1028,6 +1041,13 @@ def child_main(which: str) -> None:
         # one compile, so a short window can bank the skip-gram
         # shared-pool number without the full-bench child surviving
         out["w2v_sg_shared"] = _bench_sg_shared(device, timed)
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
+    if os.environ.get("BENCH_ONLY") == "tfm":
+        # dedicated transformer cell (r5d MFU sweep): one compile per
+        # (batch, d_model, n_layers) point, skipping the w2v build
+        out["tfm"] = _bench_tfm(device, max(timed // 2, 1))
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
@@ -1207,7 +1227,8 @@ _SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
               "BENCH_TEXT8_MB", "BENCH_TEXT8_VOCAB", "BENCH_TEXT8_SENTS",
               "BENCH_TEXT8_LEN", "BENCH_100M_SENTS", "BENCH_100M_VOCAB",
               "BENCH_100M_LEN", "BENCH_S2V_SENTS",
-              "BENCH_TFM_BATCH", "BENCH_TFM_REMAT", "BENCH_EPOCH_FUSED",
+              "BENCH_TFM_BATCH", "BENCH_TFM_REMAT", "BENCH_TFM_SEQ",
+              "BENCH_TFM_DMODEL", "BENCH_TFM_LAYERS", "BENCH_EPOCH_FUSED",
               "BENCH_SCALE_SHARED", "BENCH_LR_EPOCHS",
               # kernel-gate forces (chip_session's nopallas stage) and
               # the verdict-file relocation: a gates-off or

@@ -1,0 +1,72 @@
+#!/usr/bin/env python
+"""Round-5 window, fourth block: transformer MFU push + the r5c tail
+that never ran (the window closed after scatter_micro).
+
+The r5c batch curve at 21M params topped out at 28.5% MFU (B=256 +
+remat).  Two levers remain, both standard: keep growing the batch
+(B=512) and grow the model — MFU rises with d_model because the
+attention/softmax/LN/gather overhead amortizes against the 6*P matmul
+FLOPs.  bench.py grew BENCH_TFM_{SEQ,DMODEL,LAYERS} knobs for this
+block; each cell is its own pinned subprocess so a tunnel wedge costs
+one cell.
+
+Then the never-run r5c tail: step_sweep (w2v headline tuning grid),
+crossover_chip (backend selection data), and a fresh bench_full so
+tpu_latest.json's primary cells carry this window's provenance.
+"""
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+sys.path.insert(0, REPO)
+sys.path.insert(0, HERE)
+
+import bench  # noqa: E402
+import chip_session as cs  # noqa: E402
+
+cs.STAGE_MERGE_FIELDS.update({
+    # {VAR} templates fill from the stage env at merge time, so the
+    # archived label can never diverge from the shape actually run
+    # batch is IN the d-sweep labels: the d768/d1024 cells run at
+    # B=128/64 (HBM headroom), so a d-only key would invite reading a
+    # two-variable change as a d_model effect
+    "bench_tfm_b512": (("tfm", "tfm_b{BENCH_TFM_BATCH}_remat"),),
+    "bench_tfm_d768": (("tfm", "tfm_b{BENCH_TFM_BATCH}"
+                        "_d{BENCH_TFM_DMODEL}_l{BENCH_TFM_LAYERS}"
+                        "_remat"),),
+    "bench_tfm_d1024": (("tfm", "tfm_b{BENCH_TFM_BATCH}"
+                         "_d{BENCH_TFM_DMODEL}_l{BENCH_TFM_LAYERS}"
+                         "_remat"),),
+})
+
+PY = sys.executable
+
+AGENDA = [
+    ("bench_tfm_b512", [PY, "bench.py", "--child", "tpu"], 900,
+     {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "512",
+      "BENCH_TFM_REMAT": "1", "BENCH_ONLY": "tfm"}),
+    ("bench_tfm_d768", [PY, "bench.py", "--child", "tpu"], 900,
+     {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "128",
+      "BENCH_TFM_DMODEL": "768", "BENCH_TFM_LAYERS": "8",
+      "BENCH_TFM_REMAT": "1", "BENCH_ONLY": "tfm"}),
+    ("bench_tfm_d1024", [PY, "bench.py", "--child", "tpu"], 900,
+     {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "64",
+      "BENCH_TFM_DMODEL": "1024", "BENCH_TFM_LAYERS": "8",
+      "BENCH_TFM_REMAT": "1", "BENCH_ONLY": "tfm"}),
+    ("step_sweep", [PY, "scripts/step_sweep.py"], 2400, None),
+    ("crossover_chip", [PY, "scripts/crossover.py",
+                        "--single-device", "--reps", "3"], 1800, None),
+    ("bench_full", [PY, "bench.py"], 2600, None),
+]
+
+
+def main():
+    if not bench._tpu_alive():
+        print("tunnel down — aborting r5d block", flush=True)
+        sys.exit(1)
+    cs.run_agenda(AGENDA, "r5d tfm MFU + r5c tail")
+
+
+if __name__ == "__main__":
+    main()

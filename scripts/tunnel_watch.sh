@@ -1,8 +1,9 @@
 #!/bin/bash
-# Poll the axon tunnel; whenever a probe succeeds, run the full
-# chip_session agenda (results land in chip_session.jsonl), then KEEP
-# watching — later windows re-run the agenda so newly-landed code gets
-# measured too.
+# Poll the axon tunnel; whenever a probe succeeds, run the agenda
+# script (arg 1, default the full chip_session) — results land in
+# chip_session.jsonl — then KEEP watching: later windows re-run the
+# agenda so newly-landed code gets measured too.
+AGENDA="${1:-scripts/chip_session.py}"
 cd /root/repo
 # The sitecustomize hook only registers the axon PJRT plugin when this
 # var is set; without it every probe fails even with the tunnel live
@@ -22,9 +23,9 @@ i=0
 while :; do
   i=$((i+1))
   if probe; then
-    echo "$(date -u +%H:%M) tunnel UP - starting chip_session" >> tunnel_watch.log
-    python scripts/chip_session.py >> tunnel_watch.log 2>&1
-    echo "$(date -u +%H:%M) chip_session done - resuming watch" >> tunnel_watch.log
+    echo "$(date -u +%H:%M) tunnel UP - starting $AGENDA" >> tunnel_watch.log
+    python "$AGENDA" >> tunnel_watch.log 2>&1
+    echo "$(date -u +%H:%M) $AGENDA done - resuming watch" >> tunnel_watch.log
     sleep 600   # cooldown: don't re-burn the same window back-to-back
   else
     echo "$(date -u +%H:%M) probe $i: down" >> tunnel_watch.log
