@@ -100,3 +100,21 @@ def test_scale_shared_cell_tiny(tiny_shapes, monkeypatch):
     monkeypatch.delenv("BENCH_SCALE_SHARED")
     out2 = bench._bench_w2v_1m(dev, timed_calls=1)
     assert out2["rendering"] in ("gather", None)
+
+
+def test_tfm_cell_knobs_tiny(tiny_shapes, monkeypatch):
+    """BENCH_TFM_{SEQ,DMODEL,LAYERS} (r5d MFU sweep): the cell honors
+    the model-size knobs, derives a head count that divides d_model
+    even for non-64-multiples, and the record self-describes its shape
+    (a sweep cell whose config is unrecoverable cannot be compared)."""
+    monkeypatch.setenv("BENCH_TFM_BATCH", "2")
+    monkeypatch.setenv("BENCH_TFM_SEQ", "16")
+    monkeypatch.setenv("BENCH_TFM_DMODEL", "40")  # 40//64 -> 1 head
+    monkeypatch.setenv("BENCH_TFM_LAYERS", "1")
+    monkeypatch.setenv("BENCH_TFM_REMAT", "1")
+    out = bench._bench_tfm(jax.devices()[0], timed_calls=1)
+    assert (out["batch"], out["seq"]) == (2, 16)
+    assert (out["d_model"], out["n_layers"], out["d_ff"]) == (40, 1, 160)
+    assert out["d_model"] % out["n_heads"] == 0
+    assert out["remat"] is True
+    assert out["tokens_per_sec"] > 0 and np.isfinite(out["loss"])

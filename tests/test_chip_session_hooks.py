@@ -172,3 +172,87 @@ def test_stage_merge_label_derived_from_env():
         "bench_text8_mb", rec,
         env={"BENCH_TEXT8": "1", "BENCH_TEXT8_MB": "65536"})
     assert set(fields) == {"w2v_text8_mb65536"}
+
+
+# ---- run_agenda (shared window-block stage loop, r5d+) -----------------
+
+@pytest.fixture
+def agenda_env(iso_cache, monkeypatch):
+    monkeypatch.setattr(chip_session, "REPORT",
+                        str(iso_cache / "window.md"))
+    monkeypatch.setattr(chip_session, "_SESSION_RECORDS", [])
+    yield iso_cache
+
+
+def _fake_run(results):
+    """Map stage name -> (ok, tail); unknown stages fail loudly."""
+    calls = []
+
+    def run(name, cmd, timeout_s, env_extra=None, tpu_env=True):
+        calls.append(name)
+        ok, tail = results[name] if not callable(results[name]) \
+            else results[name]()
+        chip_session.log({"stage": name, "rc": 0 if ok else 1,
+                          "tail": tail})
+        return ok, tail
+    run.calls = calls
+    return run
+
+
+def test_run_agenda_merges_template_labels(agenda_env, monkeypatch):
+    tail = "BENCH_CHILD " + json.dumps(
+        {"platform": "tpu", "device_kind": KIND,
+         "tfm": {"tokens_per_sec": 7.0}})
+    monkeypatch.setattr(chip_session, "run",
+                        _fake_run({"stage_a": (True, tail)}))
+    monkeypatch.setitem(
+        chip_session.STAGE_MERGE_FIELDS, "stage_a",
+        (("tfm", "tfm_b{BENCH_TFM_BATCH}_d{BENCH_TFM_DMODEL}"),))
+    _seed_baseline(1.0, 1.0, "gather")   # merge needs a canonical base
+    chip_session.run_agenda(
+        [("stage_a", ["true"], 5,
+          {"BENCH_TFM_BATCH": "128", "BENCH_TFM_DMODEL": "768"})],
+        "test")
+    rec = json.load(open(os.path.join(bench.CACHE_DIR,
+                                      "tpu_latest.json")))
+    assert rec["result"]["tfm_b128_d768"] == {"tokens_per_sec": 7.0}
+    assert os.path.exists(chip_session.REPORT)   # report always lands
+
+
+def test_run_agenda_tunnel_lost_stops_early(agenda_env, monkeypatch):
+    monkeypatch.setattr(chip_session, "run", _fake_run(
+        {"a": (False, ""), "b": (True, "")}))
+    monkeypatch.setattr(bench, "_tpu_alive", lambda timeout_s=60: False)
+    chip_session.run_agenda([("a", ["x"], 5, None),
+                             ("b", ["x"], 5, None)], "test")
+    assert chip_session.run.calls == ["a"]       # b never burned
+    log_text = open(chip_session.OUT).read()
+    assert "tunnel lost" in log_text
+
+
+def test_run_agenda_cpu_stage_failure_continues(agenda_env, monkeypatch):
+    monkeypatch.setattr(chip_session, "run", _fake_run(
+        {"cpu_cell": (False, ""), "b": (True, "")}))
+    monkeypatch.setattr(bench, "_tpu_alive", lambda timeout_s=60: False)
+    chip_session.run_agenda([("cpu_cell", ["x"], 5, None),
+                             ("b", ["x"], 5, None)], "test",
+                            cpu_stages=("cpu_cell",))
+    assert chip_session.run.calls == ["cpu_cell", "b"]
+
+
+def test_run_agenda_degraded_full_rolls_back_and_retries(
+        agenda_env, monkeypatch):
+    degraded_tail = json.dumps(
+        {"degraded": ["tpu_unavailable: child rc=1"], "value": 1.0})
+    seen = iter([(True, degraded_tail), (True, "{}")])
+    monkeypatch.setattr(chip_session, "run",
+                        _fake_run({"bench_full": lambda: next(seen)}))
+    monkeypatch.setattr(bench, "_tpu_alive", lambda timeout_s=75: True)
+    cleared = []
+    monkeypatch.setattr(calibration, "clear", cleared.append)
+    chip_session.run_agenda([("bench_full", ["x"], 5, None)], "test")
+    assert chip_session.run.calls == ["bench_full", "bench_full"]
+    assert set(cleared) == {"vmem_gather", "vmem_scatter",
+                            "dense_logits"}
+    log_text = open(chip_session.OUT).read()
+    assert "verdict_rollback" in log_text
