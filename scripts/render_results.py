@@ -111,13 +111,30 @@ def main():
           f"(device: {res.get('device_kind', '?')})\n")
     print("| benchmark | TPU | CPU baseline | ratio | roofline |")
     print("|---|---|---|---|---|")
-    for key, label, field, unit, cpu_key in CELLS:
+    # unlisted tfm_* sweep cells (the r5d MFU grid can grow labels like
+    # tfm_b128_d768_l8_remat) render from their self-describing content
+    # rather than needing a CELLS entry per point
+    listed = {k for k, *_ in CELLS}
+    cells = list(CELLS) + [
+        (k, "transformer LM", "tokens_per_sec", "tokens/s", None)
+        for k in sorted(res)
+        if k.startswith("tfm") and k not in listed
+        and isinstance(res[k], dict)]
+    for key, label, field, unit, cpu_key in cells:
         cell = res.get(key)
         if not isinstance(cell, dict) or field not in cell:
             continue
         if key.startswith("tfm") and cell.get("batch"):
-            label += f" (B={cell['batch']}" + \
-                (", remat)" if cell.get("remat") else ")")
+            bits = [f"B={cell['batch']}"]
+            if cell.get("d_model"):
+                bits.append(f"d={cell['d_model']}")
+            if cell.get("n_layers"):
+                bits.append(f"L={cell['n_layers']}")
+            if cell.get("params_m"):
+                bits.append(f"{cell['params_m']}M params")
+            if cell.get("remat"):
+                bits.append("remat")
+            label += " (" + ", ".join(bits) + ")"
         if key.startswith("lr") and cell.get("epochs_per_dispatch"):
             # self-describing labels (review): an lr cell measured
             # under old defaults must not masquerade as the current

@@ -398,3 +398,87 @@ def test_seed_skips_shape_override_archives(tmp_path, monkeypatch):
     # seeded from the fp32 (selection-only) archive, not the bf16 one —
     # even though bf16's file sorts first and fp32's is newest-seedable
     assert lk["result"]["w2v_1m"]["dtype"] == "float32"
+
+
+def test_degraded_lr_ratio_pairs_config_matched_cached_cell(
+        monkeypatch, tmp_path, capsys):
+    """A stale lr ratio must compare the SAME program: when the cached
+    headline lr cell predates a default change (E=32 -> 128), the
+    pairing walks the lr-family cells for one whose self-described
+    epochs_per_dispatch matches this run's CPU cell (round-5 rehearsal:
+    the mismatched pairing printed 0.77x while the matching E=128 cell
+    at 2.8x sat unused in the same cache record)."""
+    import json
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "FULL_REPORT_PATH",
+                        str(tmp_path / "BENCH_REPORT.json"))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "device_kind": "TPU v5 lite",
+         "w2v": {"words_per_sec": 1.4e6, "step_ms": 11.6,
+                 "loss": 1.0, "rendering": "gather"},
+         "lr": {"rows_per_sec": 11.75e6, "epochs_per_dispatch": 32},
+         "lr_e128": {"rows_per_sec": 42.5e6,
+                     "epochs_per_dispatch": 128}})
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: False)
+
+    def fake_run_child(which, timeout_s, extra_env=None):
+        return ({"platform": "cpu", "device": "TFRT_CPU_0",
+                 "w2v": {"words_per_sec": 1e5, "step_ms": 2.0,
+                         "loss": 5.0, "rendering": "gather"},
+                 "lr": {"rows_per_sec": 15.2e6,
+                        "epochs_per_dispatch": 128,
+                        "scan_unroll": 1}},
+                None, 1.0)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench.parent_main()
+    line = capsys.readouterr().out.strip().splitlines()[-1]
+    d = json.loads(line)
+    full = json.load(open(str(tmp_path / "BENCH_REPORT.json")))
+    lr = full["secondary"]["lr_a9a"]
+    assert lr["tpu_cached"] == 42.5e6            # the E=128 twin
+    assert lr["tpu_cached_from"] == "lr_e128"
+    assert lr["vs_baseline_stale"] == round(42.5e6 / 15.2e6, 2)
+    assert d["stale"]["vs_baseline"] is True
+
+
+def test_degraded_lr_ratio_marks_unmatchable_config(
+        monkeypatch, tmp_path, capsys):
+    """No cached config twin: the cross-program ratio must carry an
+    explicit config_mismatch marker (review: otherwise the known-bogus
+    pairing recurs looking clean), and a variant cell missing its
+    epochs_per_dispatch field must NOT be promoted as the twin."""
+    import json
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "FULL_REPORT_PATH",
+                        str(tmp_path / "BENCH_REPORT.json"))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "device_kind": "TPU v5 lite",
+         "w2v": {"words_per_sec": 1.4e6, "step_ms": 11.6,
+                 "loss": 1.0, "rendering": "gather"},
+         "lr": {"rows_per_sec": 11.75e6, "epochs_per_dispatch": 32},
+         "lr_u4": {"rows_per_sec": 11.97e6}})   # pre-self-describe A/B
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: False)
+
+    def fake_run_child(which, timeout_s, extra_env=None):
+        return ({"platform": "cpu", "device": "TFRT_CPU_0",
+                 "w2v": {"words_per_sec": 1e5, "step_ms": 2.0,
+                         "loss": 5.0, "rendering": "gather"},
+                 "lr": {"rows_per_sec": 15.2e6,
+                        "epochs_per_dispatch": 128}},
+                None, 1.0)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench.parent_main()
+    capsys.readouterr()
+    full = json.load(open(str(tmp_path / "BENCH_REPORT.json")))
+    lr = full["secondary"]["lr_a9a"]
+    assert lr["tpu_cached"] == 11.75e6          # headline kept, not lr_u4
+    assert "tpu_cached_from" not in lr
+    assert lr["config_mismatch"] is True
