@@ -898,7 +898,17 @@ def _bench_tfm(device, timed_calls):
                             n_layers=L, d_ff=4 * D, max_seq=S,
                             dtype=jnp.bfloat16,
                             remat=os.environ.get("BENCH_TFM_REMAT",
-                                                 "0") != "0")
+                                                 "0") != "0",
+                            remat_policy=os.environ.get(
+                                # default "full": the policy-less cache
+                                # keys (tfm_remat, tfm_b256_remat...)
+                                # hold full-policy measurements, and
+                                # older session scripts re-merge into
+                                # them — dots is opt-in per stage so a
+                                # re-run can never clobber a cached
+                                # cell with a different program under
+                                # the same label
+                                "BENCH_TFM_REMAT_POLICY", "full"))
     with jax.default_device(device):
         tr = Trainer(cfg, learning_rate=1e-3)
         state = tr.init_state(jax.random.key(0))
@@ -926,6 +936,8 @@ def _bench_tfm(device, timed_calls):
            "batch": B, "seq": S, "remat": cfg.remat,
            "d_model": D, "n_layers": L, "d_ff": cfg.d_ff, "n_heads": H,
            "params_m": round(n_params / 1e6, 1)}
+    if cfg.remat:
+        out["remat_policy"] = cfg.remat_policy
     # training FLOP model: 6*P per token (fwd 2P + bwd 4P) plus the
     # attention score/value matmuls 12*L*S*d per token (fwd+bwd); remat
     # recompute is NOT counted as useful work (standard MFU convention)
@@ -1228,7 +1240,8 @@ _SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
               "BENCH_TEXT8_LEN", "BENCH_100M_SENTS", "BENCH_100M_VOCAB",
               "BENCH_100M_LEN", "BENCH_S2V_SENTS",
               "BENCH_TFM_BATCH", "BENCH_TFM_REMAT", "BENCH_TFM_SEQ",
-              "BENCH_TFM_DMODEL", "BENCH_TFM_LAYERS", "BENCH_EPOCH_FUSED",
+              "BENCH_TFM_DMODEL", "BENCH_TFM_LAYERS",
+              "BENCH_TFM_REMAT_POLICY", "BENCH_EPOCH_FUSED",
               "BENCH_SCALE_SHARED", "BENCH_LR_EPOCHS",
               # kernel-gate forces (chip_session's nopallas stage) and
               # the verdict-file relocation: a gates-off or
@@ -1468,6 +1481,22 @@ _SECONDARY_CELLS = (
     ("transformer_lm", "tfm", "tokens_per_sec", "tokens/s"),
     ("glove_cooc", "glove", "cells_per_sec", "cells/s"),
 )
+
+# Self-describing shape fields per cached cell key, used by the
+# degraded-run stale pairing: a stale ratio may only compare cells
+# whose declared shape fields agree (round-5: the cached E=32 lr cell
+# paired against a fresh E=128 CPU cell printed a clean-looking 0.77x
+# across two different programs).  Fields in _LENIENT_SHAPE_FIELDS may
+# be absent from older cached cells (written before self-describe
+# landed, or before the knob existed — absence means the then-default).
+_CELL_SHAPE_FIELDS = {
+    "lr": ("epochs_per_dispatch", "scan_unroll"),
+    "tfm": ("batch", "seq", "d_model", "n_layers", "remat",
+            "remat_policy"),
+    "w2v_epoch": ("mode",),
+}
+_LENIENT_SHAPE_FIELDS = {"scan_unroll", "remat_policy", "mode",
+                         "d_model", "n_layers", "seq"}
 
 
 def parent_main() -> None:
@@ -1725,38 +1754,37 @@ def parent_main() -> None:
                         continue
                     cpu_cell = (cpu_res or {}).get(key)
                     cached_from = None
-                    if key == "lr" and isinstance(cpu_cell, dict):
-                        # config-matched pairing: the cached headline lr
-                        # cell may predate a default change (E=32->128
-                        # in round 5); a stale ratio across different
-                        # epochs_per_dispatch compares two different
-                        # programs (this run's rehearsal printed 0.77x
-                        # from exactly that, with the matching E=128
-                        # cached cell at 2.8x sitting unused).  None
-                        # matches anything: older cached cells predate
-                        # some self-describe fields.
+                    shape = _CELL_SHAPE_FIELDS.get(key)
+                    if shape and isinstance(cpu_cell, dict):
+                        # config-matched pairing (generalized from the
+                        # lr case by round-5 review): the cached
+                        # headline cell may predate a default change;
+                        # walk the key's family (key_*) for a cell
+                        # whose self-described shape matches this
+                        # run's CPU cell.  Headline check is lenient
+                        # both ways (older cells miss fields); an alt
+                        # candidate must match STRICTLY except on
+                        # fields whose absence means the then-default
+                        # — the wildcard must not promote a deliberate
+                        # A/B variant as the twin.
                         def _m(a, b, f):
                             return (a.get(f) is None or b.get(f) is None
                                     or a.get(f) == b.get(f))
-                        shape = ("epochs_per_dispatch", "scan_unroll")
+
+                        def _twin(alt, f):
+                            if cpu_cell.get(f) is None:
+                                return True
+                            if alt.get(f) is None:
+                                return f in _LENIENT_SHAPE_FIELDS
+                            return alt.get(f) == cpu_cell.get(f)
                         if not all(_m(cell, cpu_cell, f) for f in shape):
                             for alt_key in sorted(lk_res):
                                 alt = lk_res[alt_key]
-                                # alt candidates must match E exactly
-                                # (non-None): the None wildcard is for
-                                # the headline cell's missing fields,
-                                # not for promoting an A/B variant that
-                                # merely predates self-describe
-                                if (alt_key.startswith("lr")
+                                if (alt_key.startswith(key + "_")
                                         and isinstance(alt, dict)
                                         and field in alt
-                                        and alt.get("epochs_per_dispatch")
-                                        == cpu_cell.get(
-                                            "epochs_per_dispatch")
-                                        and alt.get("epochs_per_dispatch")
-                                        is not None
-                                        and _m(alt, cpu_cell,
-                                               "scan_unroll")):
+                                        and all(_twin(alt, f)
+                                                for f in shape)):
                                     cell, cached_from = alt, alt_key
                                     break
                             else:

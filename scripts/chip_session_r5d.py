@@ -30,30 +30,44 @@ cs.STAGE_MERGE_FIELDS.update({
     # archived label can never diverge from the shape actually run
     # batch is IN the d-sweep labels: the d768/d1024 cells run at
     # B=128/64 (HBM headroom), so a d-only key would invite reading a
-    # two-variable change as a d_model effect
-    "bench_tfm_b512": (("tfm", "tfm_b{BENCH_TFM_BATCH}_remat"),),
+    # two-variable change as a d_model effect; the remat policy is in
+    # every label — dots-vs-full recompute is a different program than
+    # the cached _remat cells
+    "bench_tfm_b256_dots":
+        (("tfm", "tfm_b{BENCH_TFM_BATCH}_remat"
+          "_{BENCH_TFM_REMAT_POLICY}"),),
+    "bench_tfm_b512": (("tfm", "tfm_b{BENCH_TFM_BATCH}_remat"
+                        "_{BENCH_TFM_REMAT_POLICY}"),),
     "bench_tfm_d768": (("tfm", "tfm_b{BENCH_TFM_BATCH}"
                         "_d{BENCH_TFM_DMODEL}_l{BENCH_TFM_LAYERS}"
-                        "_remat"),),
+                        "_remat_{BENCH_TFM_REMAT_POLICY}"),),
     "bench_tfm_d1024": (("tfm", "tfm_b{BENCH_TFM_BATCH}"
                          "_d{BENCH_TFM_DMODEL}_l{BENCH_TFM_LAYERS}"
-                         "_remat"),),
+                         "_remat_{BENCH_TFM_REMAT_POLICY}"),),
 })
 
 PY = sys.executable
 
 AGENDA = [
+    # direct policy A/B against the cached 28.5% full-policy B=256 cell
+    ("bench_tfm_b256_dots", [PY, "bench.py", "--child", "tpu"], 900,
+     {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "256",
+      "BENCH_TFM_REMAT": "1", "BENCH_TFM_REMAT_POLICY": "dots",
+      "BENCH_ONLY": "tfm"}),
     ("bench_tfm_b512", [PY, "bench.py", "--child", "tpu"], 900,
      {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "512",
-      "BENCH_TFM_REMAT": "1", "BENCH_ONLY": "tfm"}),
+      "BENCH_TFM_REMAT": "1", "BENCH_TFM_REMAT_POLICY": "dots",
+      "BENCH_ONLY": "tfm"}),
     ("bench_tfm_d768", [PY, "bench.py", "--child", "tpu"], 900,
      {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "128",
       "BENCH_TFM_DMODEL": "768", "BENCH_TFM_LAYERS": "8",
-      "BENCH_TFM_REMAT": "1", "BENCH_ONLY": "tfm"}),
+      "BENCH_TFM_REMAT": "1", "BENCH_TFM_REMAT_POLICY": "dots",
+      "BENCH_ONLY": "tfm"}),
     ("bench_tfm_d1024", [PY, "bench.py", "--child", "tpu"], 900,
      {"BENCH_TFM": "1", "BENCH_TFM_BATCH": "64",
       "BENCH_TFM_DMODEL": "1024", "BENCH_TFM_LAYERS": "8",
-      "BENCH_TFM_REMAT": "1", "BENCH_ONLY": "tfm"}),
+      "BENCH_TFM_REMAT": "1", "BENCH_TFM_REMAT_POLICY": "dots",
+      "BENCH_ONLY": "tfm"}),
     ("step_sweep", [PY, "scripts/step_sweep.py"], 2400, None),
     ("crossover_chip", [PY, "scripts/crossover.py",
                         "--single-device", "--reps", "3"], 1800, None),

@@ -133,7 +133,9 @@ def main():
             if cell.get("params_m"):
                 bits.append(f"{cell['params_m']}M params")
             if cell.get("remat"):
-                bits.append("remat")
+                bits.append("remat" + (f":{cell['remat_policy']}"
+                                       if cell.get("remat_policy")
+                                       else ""))
             label += " (" + ", ".join(bits) + ")"
         if key.startswith("lr") and cell.get("epochs_per_dispatch"):
             # self-describing labels (review): an lr cell measured

@@ -60,6 +60,13 @@ class TransformerConfig:
     remat: bool = False              # jax.checkpoint each block: trade
                                      # recompute FLOPs for HBM (activation
                                      # memory goes O(L) -> O(1) blocks)
+    remat_policy: str = "dots"       # dots: keep projection/FFN matmul
+                                     # outputs, recompute only the cheap
+                                     # elementwise ops and the S x S
+                                     # attention scores (flash-style) —
+                                     # the recompute bill drops from
+                                     # every-matmul to ~score-matmuls.
+                                     # "full": recompute everything.
     dtype: Any = jnp.float32
 
     @property
@@ -197,6 +204,20 @@ def _ffn(blk, x, cfg: TransformerConfig, mesh: Optional[Mesh],
     return x + y, jnp.float32(0.0)
 
 
+def _remat_policy(cfg: TransformerConfig):
+    """checkpoint policy for the block body.  "dots": save dot outputs
+    that have no batch dims — i.e. the wq/wk/wv/wo and FFN weight
+    matmuls — while the (b, h)-batched score/PV einsums (the S x S
+    intermediates, the memory remat exists to shed) are recomputed.
+    "full": save nothing (the round-5 pre-policy behavior; its measured
+    B=256 cell recomputed every matmul)."""
+    if cfg.remat_policy == "full":
+        return None
+    if cfg.remat_policy == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    raise ValueError(f"unknown remat_policy: {cfg.remat_policy!r}")
+
+
 def block_apply(blk, x, cfg: TransformerConfig, mesh: Optional[Mesh] = None,
                 *, seq_axis: str = "seq", expert_axis: str = "expert"):
     x = _attention(blk, x, cfg, mesh, seq_axis)
@@ -218,7 +239,7 @@ def forward(params, tokens, cfg: TransformerConfig,
         return (x, aux + a), None
 
     if cfg.remat:
-        body = jax.checkpoint(body)
+        body = jax.checkpoint(body, policy=_remat_policy(cfg))
     (x, aux), _ = jax.lax.scan(body, (x, jnp.float32(0.0)),
                                params["blocks"])
     x = _rms_norm(x, params["ln_f"])
@@ -243,7 +264,7 @@ def forward_pipelined(params, tokens, cfg: TransformerConfig, mesh: Mesh,
         return out
 
     if cfg.remat:
-        stage_fn = jax.checkpoint(stage_fn)
+        stage_fn = jax.checkpoint(stage_fn, policy=_remat_policy(cfg))
 
     x = pipeline_apply(stage_fn, params["blocks"], x, mesh,
                        axis=stage_axis, num_microbatches=num_microbatches)

@@ -36,31 +36,47 @@ _NEG = -1e30
 
 
 def full_attention(q, k, v, causal: bool = False):
-    """Single-device softmax attention golden (B, S, H, D)."""
+    """Single-device softmax attention golden (B, S, H, D).
+
+    Scores and softmax are f32 regardless of input dtype — the MXU
+    accumulates in f32 anyway, so asking for f32 out of the score
+    einsum is free, and a bf16 softmax over S terms loses real bits.
+    The probs are cast back to the value dtype so the PV einsum stays
+    on the bf16 MXU path."""
     scale = 1.0 / math.sqrt(q.shape[-1])
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if causal:
         Sq, Sk = s.shape[-2], s.shape[-1]
         mask = jnp.tril(jnp.ones((Sq, Sk), bool))
         s = jnp.where(mask, s, _NEG)
     p = jax.nn.softmax(s, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                      preferred_element_type=jnp.float32
+                      ).astype(v.dtype)
 
 
 def _fold_block(q, k, v, m, l, o, scale, mask):
     """One online-softmax accumulation step (flash-attention recurrence).
 
-    q: (B, Sq, H, D); k, v: (B, Sk, H, D); m, l: (B, H, Sq);
-    o: (B, Sq, H, D); mask: (Sq, Sk) bool or None.
+    q: (B, Sq, H, D); k, v: (B, Sk, H, D); m, l: (B, H, Sq) f32;
+    o: (B, Sq, H, D) f32; mask: (Sq, Sk) bool or None.
+
+    The running max/sum/output stats stay f32 across ring steps (bf16
+    online-softmax statistics drift as blocks fold in); the two einsums
+    keep their bf16 MXU inputs, with f32 requested out of the MXU's
+    native f32 accumulation.
     """
-    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k,
+                   preferred_element_type=jnp.float32) * scale
     if mask is not None:
         s = jnp.where(mask[None, None], s, _NEG)
     m_new = jnp.maximum(m, s.max(axis=-1))
-    p = jnp.exp(s - m_new[..., None])            # (B, H, Sq, Sk)
-    corr = jnp.exp(m - m_new)                    # (B, H, Sq)
+    p = jnp.exp(s - m_new[..., None])            # (B, H, Sq, Sk) f32
+    corr = jnp.exp(m - m_new)                    # (B, H, Sq) f32
     l_new = l * corr + p.sum(axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+                    preferred_element_type=jnp.float32)
     o_new = o * corr.transpose(0, 2, 1)[..., None] + pv
     return m_new, l_new, o_new
 
@@ -84,9 +100,9 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = SEQ_AXIS,
         my = lax.axis_index(axis)
 
         # step 0: my own (diagonal) block — within-block causal mask
-        m0 = jnp.full((B, H, Sq), _NEG, q_l.dtype)
-        l0 = jnp.zeros((B, H, Sq), q_l.dtype)
-        o0 = jnp.zeros_like(q_l)
+        m0 = jnp.full((B, H, Sq), _NEG, jnp.float32)
+        l0 = jnp.zeros((B, H, Sq), jnp.float32)
+        o0 = jnp.zeros(q_l.shape, jnp.float32)
         diag_mask = (jnp.tril(jnp.ones((Sq, Sq), bool)) if causal
                      else None)
         m1, l1, o1 = _fold_block(q_l, k_l, v_l, m0, l0, o0, scale,
@@ -116,7 +132,7 @@ def ring_attention(q, k, v, mesh: Mesh, axis: str = SEQ_AXIS,
         _, _, m, l, o = lax.fori_loop(
             1, n, body, (k_l, v_l, m1, l1, o1))
         l = jnp.maximum(l, 1e-20)
-        return o / l.transpose(0, 2, 1)[..., None]
+        return (o / l.transpose(0, 2, 1)[..., None]).astype(q_l.dtype)
 
     return _ring(q, k, v)
 

@@ -133,3 +133,26 @@ class TestTraining:
             params, loss = tfm.sgd_step(params, tokens, cfg, lr=0.5)
             first = first if first is not None else float(loss)
         assert float(loss) < first * 0.5, (first, float(loss))
+
+
+class TestRematPolicy:
+    def test_remat_policies_match_no_remat(self):
+        """dots and full checkpoint policies re-execute the same ops, so
+        losses (and grads through sgd_step) must match the un-remat'd
+        forward bit-for-bit at f32 toy shape."""
+        results = {}
+        for remat, policy in ((False, "dots"), (True, "dots"),
+                              (True, "full")):
+            cfg = dataclasses.replace(CFG, remat=remat,
+                                      remat_policy=policy)
+            # fresh identical params per config: sgd_step donates them
+            params, tokens = _toy()
+            _, loss = tfm.sgd_step(params, tokens, cfg, lr=0.1)
+            results[(remat, policy)] = float(loss)
+        assert len(set(results.values())) == 1, results
+
+    def test_unknown_remat_policy_rejected(self):
+        cfg = dataclasses.replace(CFG, remat=True, remat_policy="bogus")
+        params, tokens = _toy()
+        with pytest.raises(ValueError, match="remat_policy"):
+            tfm.forward(params, tokens, cfg)
