@@ -10,7 +10,10 @@ the idiomatic TPU way:
 * one jitted, donated ``train_step``: loss, grads, update — GSPMD inserts
   every collective from the shardings alone;
 * ``remat`` in TransformerConfig turns on per-block ``jax.checkpoint``
-  (activation memory O(layers) -> O(1) at ~1/3 extra FLOPs);
+  (activation memory O(layers) -> O(1); recompute cost depends on
+  ``remat_policy`` — "dots" saves matmul outputs and re-executes only
+  elementwise ops and attention scores, "full" re-executes everything
+  at ~1/3 extra FLOPs);
 * checkpoints are flat npz (multihost-safe: collective gather, process-0
   writes — same policy as io/checkpoint.py), resume-exact including
   optimizer state and step counter.
