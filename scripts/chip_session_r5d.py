@@ -68,10 +68,13 @@ AGENDA = [
       "BENCH_TFM_DMODEL": "1024", "BENCH_TFM_LAYERS": "8",
       "BENCH_TFM_REMAT": "1", "BENCH_TFM_REMAT_POLICY": "dots",
       "BENCH_ONLY": "tfm"}),
+    # bench_full BEFORE the long sweeps: it refreshes every primary
+    # cell + the live ratio in ~5-10 min, so a short window must not
+    # spend 70 min of sweeps first and lose it
+    ("bench_full", [PY, "bench.py"], 2600, None),
     ("step_sweep", [PY, "scripts/step_sweep.py"], 2400, None),
     ("crossover_chip", [PY, "scripts/crossover.py",
                         "--single-device", "--reps", "3"], 1800, None),
-    ("bench_full", [PY, "bench.py"], 2600, None),
 ]
 
 
