@@ -63,7 +63,10 @@ def sweep(cluster, nprocs):
                 os.environ["SMTPU_ASYNC_SWEEP"].split(",")]
     epochs = int(os.environ.get("SMTPU_ASYNC_SWEEP_EPOCHS", "4"))
     sents = int(os.environ.get("SMTPU_ASYNC_SWEEP_SENTS", "400"))
-    corpus = synthetic_corpus(sents, vocab_size=80, length=12, seed=9)
+    vocab = int(os.environ.get("SMTPU_ASYNC_SWEEP_VOCAB", "80"))
+    length = int(os.environ.get("SMTPU_ASYNC_SWEEP_LEN", "12"))
+    corpus = synthetic_corpus(sents, vocab_size=vocab, length=length,
+                              seed=9)
     tokens = sum(len(s) for s in corpus)
     out = {}
     for ls in settings:
