@@ -1,0 +1,5 @@
+#!/usr/bin/env bash
+# Tier-1 gate — the ROADMAP.md "Tier-1 verify" command, verbatim.
+# Prints DOTS_PASSED=<n> (count of passing-test dots in the progress
+# lines) and exits with pytest's return code.
+set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c); exit $rc

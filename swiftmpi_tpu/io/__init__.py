@@ -1,11 +1,17 @@
-"""Checkpoint I/O + resilience: text dumps, binary resume, elastic reshard."""
+"""Checkpoint I/O + resilience: text dumps, binary resume, elastic reshard,
+CRC-validated crash-safe checkpoints with a retained last-k window."""
 
-from swiftmpi_tpu.io.checkpoint import (default_formatter, default_parser,
-                                        dump_table_text, load_checkpoint,
-                                        load_table_text, save_checkpoint)
+from swiftmpi_tpu.io.checkpoint import (CheckpointCorruptError, atomic_savez,
+                                        default_formatter, default_parser,
+                                        dump_table_text,
+                                        find_latest_valid_checkpoint,
+                                        load_checkpoint, load_table_text,
+                                        save_checkpoint, verify_checkpoint)
 from swiftmpi_tpu.io.resilience import (load_checkpoint_elastic,
                                         train_with_resume)
 
-__all__ = ["default_formatter", "default_parser", "dump_table_text",
-           "load_checkpoint", "load_table_text", "save_checkpoint",
+__all__ = ["CheckpointCorruptError", "atomic_savez", "default_formatter",
+           "default_parser", "dump_table_text",
+           "find_latest_valid_checkpoint", "load_checkpoint",
+           "load_table_text", "save_checkpoint", "verify_checkpoint",
            "load_checkpoint_elastic", "train_with_resume"]

@@ -21,7 +21,8 @@ from __future__ import annotations
 import glob
 import os
 import time
-from typing import Callable, Dict, Optional
+import zlib
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -226,12 +227,30 @@ def _writer_alive(tmp_name: str) -> bool:
         return True
 
 
+# every payload array gets a sibling ``__crc__<name>`` uint32 so loaders
+# can detect torn/bit-rotted writes (zip CRCs exist but np.load never
+# checks them on the read path we use)
+_CRC_PREFIX = "__crc__"
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed CRC validation or is structurally unreadable.
+    Recovery: fall back to an older generation
+    (:func:`find_latest_valid_checkpoint`) or restart from scratch."""
+
+
+def _crc32(arr: np.ndarray) -> int:
+    return zlib.crc32(np.ascontiguousarray(arr).tobytes()) & 0xFFFFFFFF
+
+
 def atomic_savez(dst: str, payload: Dict[str, np.ndarray]) -> None:
-    """Crash-safe npz write: savez to a pid-unique tmp then rename, so a
-    crash mid-write never clobbers the last good checkpoint.  Sweeps
-    orphan tmps from killed writers — only when the writing pid is dead
-    AND the file has aged (pid check guards long-running concurrent
-    writers; the age threshold guards pid reuse)."""
+    """Crash-safe npz write: savez to a pid-unique tmp, fsync, then
+    rename (+ directory fsync), so a crash mid-write never clobbers the
+    last good checkpoint and a rename survives power loss.  Every array
+    gains a ``__crc__<name>`` checksum entry for load-time validation.
+    Sweeps orphan tmps from killed writers — only when the writing pid
+    is dead AND the file has aged (pid check guards long-running
+    concurrent writers; the age threshold guards pid reuse)."""
     os.makedirs(os.path.dirname(os.path.abspath(dst)), exist_ok=True)
     tmp = f"{dst}.{os.getpid()}.tmp.npz"   # unique per writer
     now = time.time()
@@ -245,19 +264,137 @@ def atomic_savez(dst: str, payload: Dict[str, np.ndarray]) -> None:
                 os.unlink(stale)
         except OSError:
             pass
+    full = dict(payload)
+    for k in list(payload):
+        full[_CRC_PREFIX + k] = np.uint32(_crc32(np.asarray(payload[k])))
     try:
-        np.savez(tmp, **payload)
+        np.savez(tmp, **full)
+        fd = os.open(tmp, os.O_RDONLY)
+        try:
+            os.fsync(fd)
+        finally:
+            os.close(fd)
         os.replace(tmp, dst)
+        try:
+            dfd = os.open(os.path.dirname(os.path.abspath(dst)),
+                          os.O_RDONLY)
+            try:
+                os.fsync(dfd)      # make the rename itself durable
+            finally:
+                os.close(dfd)
+        except OSError:
+            pass                   # some filesystems refuse dir fsync
     finally:
         if os.path.exists(tmp):
             os.unlink(tmp)
 
 
+def verify_checkpoint(path: str) -> None:
+    """Validate every checksummed array in an npz checkpoint; raises
+    :class:`CheckpointCorruptError` on any mismatch or on a structurally
+    unreadable file.  Pre-CRC checkpoints (no ``__crc__*`` entries) pass
+    — there is nothing to check them against.  A missing file raises
+    ``FileNotFoundError`` (absence is not corruption)."""
+    p = npz_path(path)
+    if not os.path.exists(p):
+        raise FileNotFoundError(p)
+    try:
+        with np.load(p) as z:
+            names = set(z.files)
+            for name in sorted(names):
+                if name.startswith(_CRC_PREFIX):
+                    continue
+                crc_key = _CRC_PREFIX + name
+                if crc_key not in names:
+                    continue
+                want = int(z[crc_key])
+                got = _crc32(z[name])
+                if got != want:
+                    raise CheckpointCorruptError(
+                        f"{p}: array {name!r} CRC mismatch "
+                        f"(stored {want:#010x}, computed {got:#010x})")
+    except CheckpointCorruptError:
+        raise
+    except Exception as e:   # noqa: BLE001 — zip/zlib/pickle damage
+        raise CheckpointCorruptError(f"{p}: unreadable npz: {e!r}") from e
+
+
+def _gen_path(dst: str, n: int) -> str:
+    """Retained-generation name: ``ckpt.npz`` -> ``ckpt.g<n>.npz`` (must
+    keep the .npz suffix so npz_path() round-trips the name)."""
+    return f"{dst[:-len('.npz')]}.g{n}.npz"
+
+
+def _gen_files(dst: str) -> List[int]:
+    """Existing generation numbers for ``dst``, ascending."""
+    stem = glob.escape(dst[:-len(".npz")])
+    gens = []
+    for p in glob.glob(stem + ".g*.npz"):
+        tail = p[len(dst) - len(".npz") + 2:-len(".npz")]
+        try:
+            gens.append(int(tail))
+        except ValueError:
+            continue
+    return sorted(gens)
+
+
+def rotate_before_write(dst: str, retain: int) -> None:
+    """Retention step 1, called right before an atomic overwrite of
+    ``dst``: rename the current live checkpoint to the next generation
+    (``ckpt.g<n>.npz``) so the overwrite cannot destroy the only valid
+    copy.  No-op for ``retain <= 1`` or when ``dst`` does not exist."""
+    if retain <= 1 or not os.path.exists(dst):
+        return
+    gens = _gen_files(dst)
+    os.replace(dst, _gen_path(dst, (gens[-1] + 1) if gens else 1))
+
+
+def prune_generations(dst: str, retain: int) -> None:
+    """Retention step 2, called after a successful write: drop all but
+    the newest ``retain - 1`` generations (live file + k-1 gens = k)."""
+    if retain <= 1:
+        return
+    for n in _gen_files(dst)[:-(retain - 1)]:
+        try:
+            os.unlink(_gen_path(dst, n))
+        except OSError:
+            pass
+
+
+def find_latest_valid_checkpoint(path: str) -> Optional[str]:
+    """Newest checkpoint for ``path`` that passes CRC validation: the
+    live file if valid, else retained generations newest-first (written
+    by ``save_checkpoint(..., retain=k)``).  Returns a loadable path or
+    None.  Corrupt candidates are logged and skipped — this is the
+    fallback scan ``train_with_resume`` rewinds through."""
+    from swiftmpi_tpu.utils.logger import get_logger
+    log = get_logger(__name__)
+    dst = npz_path(path)
+    candidates = [dst] + [_gen_path(dst, n)
+                          for n in reversed(_gen_files(dst))]
+    for cand in candidates:
+        if not os.path.exists(cand):
+            continue
+        try:
+            verify_checkpoint(cand)
+            return cand
+        except CheckpointCorruptError as e:
+            log.warning("skipping corrupt checkpoint %s: %s", cand, e)
+    return None
+
+
 def save_checkpoint(table: SparseTable, path: str,
-                    extra: Optional[Dict[str, np.ndarray]] = None) -> None:
+                    extra: Optional[Dict[str, np.ndarray]] = None,
+                    retain: int = 1) -> None:
     """npz with all fields (incl. optimizer state), the key index, and any
     extra arrays (e.g. step counters) — resume-exact, unlike the reference
-    text dump which drops h2sum/v2sum (word2vec.h:100-110)."""
+    text dump which drops h2sum/v2sum (word2vec.h:100-110).
+
+    ``retain > 1`` keeps a last-k window: before the atomic replace, the
+    previous live checkpoint is renamed to ``<path>.g<n>.npz`` and
+    generations beyond ``retain - 1`` are pruned — so a checkpoint that
+    lands corrupted (torn write, bit rot, injected fault) still leaves
+    ``find_latest_valid_checkpoint`` an older valid file to rewind to."""
     keys = np.fromiter(table.key_index.keys(), dtype=np.uint64,
                        count=len(table.key_index))
     slots = np.fromiter((table.key_index.slot(int(k)) for k in keys),
@@ -280,14 +417,23 @@ def save_checkpoint(table: SparseTable, path: str,
         payload[f"extra__{k}"] = np.asarray(v)
     if not is_writer():        # gather above was the collective part
         return
+    dst = npz_path(path)
+    rotate_before_write(dst, retain)
     # atomic: a crash mid-write must never clobber the last good
     # checkpoint (it is the only thing auto-resume can rewind to)
-    atomic_savez(npz_path(path), payload)
+    atomic_savez(dst, payload)
+    prune_generations(dst, retain)
 
 
-def load_checkpoint(table: SparseTable, path: str) -> Dict[str, np.ndarray]:
+def load_checkpoint(table: SparseTable, path: str,
+                    verify: bool = True) -> Dict[str, np.ndarray]:
     """Restore table state + key index from ``save_checkpoint`` output;
-    returns the ``extra`` arrays."""
+    returns the ``extra`` arrays.  ``verify`` (default on) CRC-validates
+    every array first and raises :class:`CheckpointCorruptError` instead
+    of silently restoring damaged state — callers with a retention window
+    catch it and rewind via ``find_latest_valid_checkpoint``."""
+    if verify:
+        verify_checkpoint(path)
     with np.load(npz_path(path)) as z:
         if int(z["num_shards"]) != table.key_index.num_shards:
             raise ValueError(

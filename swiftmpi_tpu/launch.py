@@ -21,9 +21,20 @@ Flags (reference CMDLine style, ``-key value``):
                     (JAX_PLATFORMS=cpu + xla_force_host_platform_device_count;
                     the standard fake-multi-device trick for development).
 * ``-port P``     — coordinator port (default: an OS-assigned free port).
+* ``-max-restarts R`` — supervised mode: on any non-zero world exit,
+                    restart ALL ranks from scratch up to R times with
+                    exponential backoff (the SPMD recovery model:
+                    restart-the-world, resume from checkpoint — pair
+                    with ``train_with_resume`` in the child).
+* ``-backoff S``  — initial restart backoff seconds (default 1.0,
+                    doubling per restart, capped at 60s).
 
 Children inherit stdout/stderr with a ``[rank k]`` line prefix; first
-non-zero exit terminates the rest (mpirun semantics).
+non-zero exit terminates the rest (mpirun semantics): survivors get
+SIGTERM, then SIGKILL after a grace period, every child is reaped, and
+readers are drained before ``launch`` returns — no leaked processes, no
+orphaned output pumps.  Exit codes propagate to ``main()``'s return;
+signal deaths map to the shell convention ``128 + signum``.
 """
 
 from __future__ import annotations
@@ -65,6 +76,14 @@ def _child_env(base: Dict[str, str], port: int, rank: int, nprocs: int,
     return env
 
 
+def _normalize_rc(code: int) -> int:
+    """Child exit code -> process exit code.  Popen reports signal
+    deaths as negative numbers; ``sys.exit(-9)`` would wrap to an
+    arbitrary byte at the OS boundary, so map them to the shell
+    convention 128 + signum (SIGKILL -> 137)."""
+    return 128 - code if code < 0 else code
+
+
 def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
            port: int = 0, kill_grace_s: float = 5.0) -> int:
     """Spawn ``nprocs`` copies of ``argv`` under one coordinator; returns
@@ -73,16 +92,23 @@ def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
     One reader thread per child (a blocking ``readline`` there cannot
     stall exit detection here); the main thread only polls exit codes.
     SIGTERM on first failure escalates to SIGKILL after ``kill_grace_s``.
+    Teardown order is kill -> reap -> drain -> join: every child is
+    ``wait``-ed (no zombies), and a reader blocked on a pipe a grandchild
+    still holds is unblocked by force-closing the pipe, not abandoned
+    mid-pump.
     """
     port = port or _free_port()
     procs = []
     print_lock = threading.Lock()
 
     def reader(rank: int, stream) -> None:
-        for line in stream:                      # until EOF
-            with print_lock:
-                sys.stdout.write(f"[rank {rank}] {line}")
-                sys.stdout.flush()
+        try:
+            for line in stream:                  # until EOF
+                with print_lock:
+                    sys.stdout.write(f"[rank {rank}] {line}")
+                    sys.stdout.flush()
+        except (ValueError, OSError):
+            pass     # stream force-closed by teardown while blocked
 
     threads = []
     for rank in range(nprocs):
@@ -103,7 +129,7 @@ def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
             for p in procs:
                 code = p.poll()
                 if code not in (None, 0) and rc == 0:
-                    rc = code          # first failure wins, mpirun-style
+                    rc = _normalize_rc(code)   # first failure wins
                     for q in procs:
                         if q.poll() is None:
                             q.terminate()
@@ -116,16 +142,76 @@ def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
         for p in procs:
             code = p.wait()
             if code and rc == 0:
-                rc = code
+                rc = _normalize_rc(code)
     finally:
+        # kill: nothing may survive this function, success or raise
         for p in procs:
             if p.poll() is None:
                 p.kill()
-        # drain remaining output; daemon threads may outlive a child that
-        # leaked its stdout to a grandchild — don't hang on them
+        # reap: every kill needs a wait or the child stays a zombie (the
+        # old teardown skipped this — `ps` after a failed launch showed
+        # defunct ranks until the launcher itself exited)
+        for p in procs:
+            try:
+                p.wait(timeout=kill_grace_s)
+            except subprocess.TimeoutExpired:
+                pass               # unkillable (D-state); nothing to do
+        # drain: child death EOFs the pipe, so readers normally finish
+        # on their own...
+        for t in threads:
+            t.join(timeout=2.0)
+        # ...unless a grandchild inherited the pipe's write end and kept
+        # it open — then force-close the read end to unblock the reader
+        # (it swallows the resulting ValueError/OSError) and join again
+        for p, t in zip(procs, threads):
+            if t.is_alive():
+                try:
+                    p.stdout.close()
+                except (ValueError, OSError):
+                    pass
         for t in threads:
             t.join(timeout=1.0)
     return rc
+
+
+def supervise(argv: List[str], nprocs: int, cpu_devices: int = 0,
+              port: int = 0, kill_grace_s: float = 5.0,
+              max_restarts: int = 0, backoff_s: float = 1.0,
+              backoff_factor: float = 2.0,
+              backoff_max_s: float = 60.0) -> int:
+    """Restart-the-world supervisor around :func:`launch`.
+
+    The SPMD recovery model (io/resilience.py): a failed rank cannot be
+    patched back into a running world — the barrier is already poisoned
+    — so ANY non-zero world exit tears everything down and relaunches
+    all ranks, which resume from the last valid checkpoint when the
+    child uses ``train_with_resume``.  Restarts are bounded
+    (``max_restarts``) with exponential backoff so a deterministic
+    crash-loop exhausts its budget and surfaces the real exit code
+    instead of flapping forever.  With the default ``port=0`` every
+    attempt picks a fresh coordinator port — the previous coordinator's
+    socket may linger in TIME_WAIT."""
+    attempt = 0
+    while True:
+        rc = launch(argv, nprocs, cpu_devices, port, kill_grace_s)
+        if rc == 0:
+            if attempt:
+                print(f"[launch] world recovered after {attempt} "
+                      f"restart(s)", file=sys.stderr)
+            return 0
+        if attempt >= max_restarts:
+            if max_restarts:
+                print(f"[launch] restart budget exhausted "
+                      f"({max_restarts}); giving up with rc={rc}",
+                      file=sys.stderr)
+            return rc
+        delay = min(backoff_s * (backoff_factor ** attempt),
+                    backoff_max_s)
+        attempt += 1
+        print(f"[launch] world failed rc={rc}; restart "
+              f"{attempt}/{max_restarts} in {delay:.1f}s",
+              file=sys.stderr)
+        time.sleep(delay)
 
 
 def main(args: Optional[List[str]] = None) -> int:
@@ -142,16 +228,23 @@ def main(args: Optional[List[str]] = None) -> int:
     cmd.registerParameter("np", "number of processes")
     cmd.registerParameter("cpu", "virtual CPU devices per process")
     cmd.registerParameter("port", "coordinator port")
+    cmd.registerParameter("max-restarts",
+                          "restart-the-world budget on failure")
+    cmd.registerParameter("backoff", "initial restart backoff seconds")
     prog = args[split + 1:]
     if not prog:
         print("launch: nothing to run after --", file=sys.stderr)
         return 2
-    return launch(
+    return supervise(
         prog,
         nprocs=int(cmd.get_value("np")) if cmd.hasParameter("np") else 1,
         cpu_devices=int(cmd.get_value("cpu"))
         if cmd.hasParameter("cpu") else 0,
-        port=int(cmd.get_value("port")) if cmd.hasParameter("port") else 0)
+        port=int(cmd.get_value("port")) if cmd.hasParameter("port") else 0,
+        max_restarts=int(cmd.get_value("max-restarts"))
+        if cmd.hasParameter("max-restarts") else 0,
+        backoff_s=float(cmd.get_value("backoff"))
+        if cmd.hasParameter("backoff") else 1.0)
 
 
 if __name__ == "__main__":

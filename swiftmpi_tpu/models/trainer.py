@@ -32,7 +32,11 @@ import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from swiftmpi_tpu.cluster.bootstrap import host_array, is_writer
-from swiftmpi_tpu.io.checkpoint import atomic_savez, npz_path
+from swiftmpi_tpu.io.checkpoint import (atomic_savez, npz_path,
+                                        prune_generations,
+                                        rotate_before_write,
+                                        verify_checkpoint)
+from swiftmpi_tpu.testing import faults
 from swiftmpi_tpu.models.transformer import (TransformerConfig, init_params,
                                              lm_loss, param_shardings)
 from swiftmpi_tpu.utils.logger import get_logger
@@ -83,6 +87,9 @@ class Trainer:
         self.optimizer = make_optimizer(optimizer, **opt_kwargs)
         self.aux_weight = aux_weight
         self._step_fn = None
+        # host-side step counter for the fault/observability bus: the
+        # device-side state.step would cost a sync per step to read
+        self._host_steps = 0
 
     # -- state ------------------------------------------------------------
     def init_state(self, key) -> TrainState:
@@ -145,6 +152,8 @@ class Trainer:
 
     def step(self, state: TrainState, tokens) -> Tuple[TrainState,
                                                        jax.Array]:
+        faults.step_event(self._host_steps)
+        self._host_steps += 1
         if self._step_fn is None:
             self._step_fn = self._build_step()
         if self.mesh is not None:
@@ -164,8 +173,8 @@ class Trainer:
             state.params, state.opt_state, state.step, tokens)
         return TrainState(params, opt_state, step), loss
 
-    # -- checkpoints (multihost-safe, atomic) ------------------------------
-    def save(self, state: TrainState, path: str) -> None:
+    # -- checkpoints (multihost-safe, atomic, CRC-validated) ---------------
+    def save(self, state: TrainState, path: str, retain: int = 1) -> None:
         flat, treedef = jax.tree.flatten(state.tree())
         # every process gathers (host_array is a collective); only the
         # writer touches the disk — and logs from the gathered copy, so no
@@ -176,19 +185,27 @@ class Trainer:
         payload["treedef"] = np.frombuffer(
             repr(treedef).encode(), dtype=np.uint8)
         dst = npz_path(path)
+        rotate_before_write(dst, retain)
         atomic_savez(dst, payload)
+        prune_generations(dst, retain)
         step_i = next(i for i, v in enumerate(flat) if v is state.step)
         log.info("trainer checkpoint -> %s (step %d)", dst,
                  int(payload[f"leaf_{step_i}"]))
+        faults.checkpoint_event(dst)
 
-    def load(self, path: str, key=None) -> TrainState:
+    def load(self, path: str, key=None, verify: bool = True) -> TrainState:
         """Rebuild a TrainState from ``save`` output.  The tree structure
         comes from a fresh ``init_state`` (cfg must match); leaf order is
-        the flatten order, so shapes are validated leaf-by-leaf."""
+        the flatten order, so shapes are validated leaf-by-leaf.
+        ``verify`` CRC-checks every array first (CheckpointCorruptError
+        on a torn/bit-rotted file) — restoring damaged optimizer state
+        silently poisons the whole downstream run."""
         state = self.init_state(key if key is not None
                                 else jax.random.key(0))
         flat, treedef = jax.tree.flatten(state.tree())
         dst = npz_path(path)
+        if verify:
+            verify_checkpoint(dst)
         with np.load(dst) as z:
             saved_def = z["treedef"].tobytes().decode()
             if saved_def != repr(treedef):

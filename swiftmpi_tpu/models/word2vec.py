@@ -54,6 +54,7 @@ from swiftmpi_tpu.ops.sampling import (build_unigram_alias, sample_alias,
                                        sample_alias_slots)
 from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
 from swiftmpi_tpu.parameter import w2v_access
+from swiftmpi_tpu.testing import faults
 from swiftmpi_tpu.transfer import PushSpec
 from swiftmpi_tpu.utils.config import ConfigParser, global_config
 from swiftmpi_tpu.utils.logger import get_logger
@@ -916,6 +917,7 @@ class Word2Vec:
               batch_size: Optional[int] = None,
               checkpoint_path: Optional[str] = None,
               checkpoint_every: int = 1,
+              checkpoint_retain: int = 1,
               start_iter: int = 0,
               batcher=None) -> List[float]:
         """``data``: corpus path or list of key-list sentences.  Returns
@@ -926,6 +928,12 @@ class Word2Vec:
         (optimizer state included) every ``checkpoint_every`` iterations —
         a capability the reference lacks (SURVEY.md §5: checkpoint-out only
         at exit, optimizer state dropped).  Resume with ``resume()``.
+        ``checkpoint_retain`` keeps a last-k generation window on disk so
+        a corrupted latest checkpoint can rewind (io/checkpoint.py).
+
+        Every iteration reports to the fault/observability bus
+        (``testing.faults.step_event``) — chaos plans and the resume
+        loop's hang watchdog both hook there.
 
         ``batcher``: custom batch source with an ``epoch(batch_size)``
         iterator (e.g. the native C++ ``NativeCBOWBatcher``); its vocab
@@ -985,6 +993,10 @@ class Word2Vec:
         meter = Throughput()
         step_i = 0
         for it in range(niters):
+            # global step: cumulative across resumed runs, so a fault
+            # plan's crash-at-step-k means "after k completed steps"
+            # regardless of how many attempts it took to get there
+            faults.step_event(start_iter + it)
             if hogwild:
                 err_sum, err_cnt = self._hogwild_epoch(
                     batcher, batch_size, meter)
@@ -1082,14 +1094,17 @@ class Word2Vec:
                      it, loss, meter.rate())
             if checkpoint_path and (it + 1) % checkpoint_every == 0:
                 self.table.state = state
-                from swiftmpi_tpu.io.checkpoint import save_checkpoint
+                from swiftmpi_tpu.io.checkpoint import (npz_path,
+                                                        save_checkpoint)
                 # cumulative iteration: a resumed run must not rewind the
                 # counter, or a later resume re-trains finished iters
                 save_checkpoint(
                     self.table, checkpoint_path,
-                    extra={"iter": np.int64(start_iter + it + 1)})
+                    extra={"iter": np.int64(start_iter + it + 1)},
+                    retain=checkpoint_retain)
                 log.info("checkpoint @ iter %d -> %s", start_iter + it + 1,
                          checkpoint_path)
+                faults.checkpoint_event(npz_path(checkpoint_path))
         self.table.state = state
         return losses
 

@@ -5,9 +5,10 @@ training semantics are preserved here as sequential numpy oracles that
 tests — and benchmark baselines — compare against.
 """
 
+from swiftmpi_tpu.testing.faults import FaultPlan, InjectedFault
 from swiftmpi_tpu.testing.w2v_oracle import (W2VOracle, cbow_batch_grads,
                                              exp_table_sigmoid,
                                              gen_unigram_table)
 
-__all__ = ["W2VOracle", "cbow_batch_grads", "exp_table_sigmoid",
-           "gen_unigram_table"]
+__all__ = ["FaultPlan", "InjectedFault", "W2VOracle", "cbow_batch_grads",
+           "exp_table_sigmoid", "gen_unigram_table"]

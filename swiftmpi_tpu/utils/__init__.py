@@ -18,8 +18,8 @@ from swiftmpi_tpu.utils.buffer import BinaryBuffer, TextBuffer
 from swiftmpi_tpu.utils.timers import (Timer, Error, Throughput, Metrics,
                                        global_metrics)
 from swiftmpi_tpu.utils.logger import get_logger
-from swiftmpi_tpu.utils.health import (DeviceHealth, all_healthy,
-                                       check_devices)
+from swiftmpi_tpu.utils.health import (DeviceHangError, DeviceHealth,
+                                       all_healthy, check_devices)
 
 __all__ = [
     "ConfigParser", "ConfigError", "Item", "global_config",
@@ -27,5 +27,5 @@ __all__ = [
     "bkdr_hash", "bkdr_hash_batch", "Random", "global_random",
     "reset_global_random", "BinaryBuffer", "TextBuffer", "Timer", "Error",
     "Throughput", "Metrics", "global_metrics", "get_logger",
-    "DeviceHealth", "all_healthy", "check_devices",
+    "DeviceHangError", "DeviceHealth", "all_healthy", "check_devices",
 ]

@@ -21,6 +21,17 @@ from swiftmpi_tpu.utils.logger import get_logger
 log = get_logger(__name__)
 
 
+class DeviceHangError(RuntimeError):
+    """Training made no step progress within its watchdog deadline (see
+    io.resilience.train_with_resume's ``hang_timeout_s``).  The
+    ``recoverable`` attribute says whether the stalled attempt
+    acknowledged cancellation (True: restart from checkpoint in-process)
+    or is wedged in native code (False: only a process restart — the
+    supervised launcher — can recover)."""
+
+    recoverable: bool = True
+
+
 @dataclass
 class DeviceHealth:
     device: str

@@ -1,0 +1,59 @@
+"""Supervised-launcher chaos child: real Word2Vec training, killed
+mid-run by an injected fault (SMTPU_FAULT_PLAN in the env), resumed from
+checkpoint by the restarted world.
+
+Run via::
+
+    python -m swiftmpi_tpu.launch -np 1 -cpu 8 -max-restarts 2 \
+        -backoff 0.1 -- python tests/_chaos_child.py
+
+with SMTPU_CHAOS_DIR pointing at a scratch directory and SMTPU_FAULT_PLAN
+holding a plan whose kill/corrupt faults carry marker files (so the
+restarted world does not re-fire them).  Prints ``CHAOS_OK`` with the
+loss history length and the relative gap to an uninterrupted same-seed
+run; the test parses both.
+"""
+
+import os
+import sys
+
+
+def _model():
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.utils import ConfigParser
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla"},
+        "word2vec": {"len_vec": 8, "window": 2, "negative": 3,
+                     "sample": -1, "learning_rate": 0.05},
+        "server": {"initial_learning_rate": 0.3},
+        "worker": {"minibatch": 128},
+    })
+    return Word2Vec(config=cfg)
+
+
+def main() -> int:
+    out_dir = os.environ["SMTPU_CHAOS_DIR"]
+    from swiftmpi_tpu.data.text import synthetic_corpus
+    from swiftmpi_tpu.io.resilience import train_with_resume
+
+    corpus = synthetic_corpus(30, vocab_size=50, length=12, seed=6)
+    model = _model()
+    model.build(corpus)
+    # max_restarts=0: the kill fault takes the whole process down, so any
+    # recovery observed here is the SUPERVISOR's restart, not an
+    # in-process retry
+    losses = train_with_resume(
+        model, corpus, niters=4,
+        checkpoint_path=os.path.join(out_dir, "ck"),
+        checkpoint_every=1, max_restarts=0, retain=2, batch_size=64)
+
+    clean = _model()
+    clean.build(corpus)
+    clean_losses = clean.train(corpus, niters=4, batch_size=64)
+    rel = abs(losses[-1] - clean_losses[-1]) / abs(clean_losses[-1])
+    print(f"CHAOS_OK n_losses={len(losses)} rel={rel:.4f}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -95,3 +95,101 @@ def test_single_process_bootstrap_is_noop():
                                                 init_distributed)
     assert distributed_env() is None
     assert init_distributed() is False
+
+
+# -- supervised launcher (restart-the-world recovery) -----------------------
+#
+# These children are jax-free `python -c` one-liners: the supervisor's
+# contract (spawn, monitor, kill, reap, restart, propagate) is orthogonal
+# to what the child computes, and jax-free children keep the tests fast.
+
+
+def test_supervise_restarts_until_success(tmp_path):
+    """Rank 0 fails its first two lives, then succeeds; the supervisor's
+    restart-the-world loop rides through both failures and exits 0."""
+    prog = ("import os, sys\n"
+            "d = os.environ['SMTPU_TEST_DIR']\n"
+            "r = os.environ['SMTPU_PROCESS_ID']\n"
+            "f = os.path.join(d, 'attempt_' + r)\n"
+            "n = int(open(f).read()) if os.path.exists(f) else 0\n"
+            "open(f, 'w').write(str(n + 1))\n"
+            "sys.exit(1 if (r == '0' and n < 2) else 0)\n")
+    res = run_launch("-np", "2", "-max-restarts", "3", "-backoff", "0.05",
+                     "--", sys.executable, "-c", prog, timeout=120,
+                     env_extra={"SMTPU_TEST_DIR": str(tmp_path)})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "world recovered after 2 restart(s)" in res.stderr, res.stderr
+    assert open(tmp_path / "attempt_0").read() == "3"
+
+
+def test_supervise_budget_exhaustion_propagates_rc(tmp_path):
+    """A deterministic crash-loop exhausts the budget; the child's real
+    exit code surfaces instead of flapping forever."""
+    res = run_launch("-np", "2", "-max-restarts", "2", "-backoff", "0.05",
+                     "--", sys.executable, "-c", "import sys; sys.exit(5)",
+                     timeout=120)
+    assert res.returncode == 5, res.stdout + res.stderr
+    assert "restart budget exhausted (2)" in res.stderr, res.stderr
+
+
+def test_signal_death_maps_to_128_plus_signum():
+    """SIGKILL-ed children report 128+signum (137), not a negative code
+    truncated to an arbitrary byte at the OS boundary."""
+    prog = "import os, signal; os.kill(os.getpid(), signal.SIGKILL)"
+    res = run_launch("-np", "1", "--", sys.executable, "-c", prog,
+                     timeout=60)
+    assert res.returncode == 137, res.stdout + res.stderr
+
+
+def test_launcher_kills_stragglers_and_leaks_nothing(tmp_path):
+    """First failure tears the world down: a sibling that would sleep 60s
+    is killed promptly, reaped (no zombie), and really gone afterwards."""
+    import time
+    prog = ("import os, sys, time\n"
+            "r = os.environ['SMTPU_PROCESS_ID']\n"
+            "d = os.environ['SMTPU_TEST_DIR']\n"
+            "open(os.path.join(d, 'pid_' + r), 'w')"
+            ".write(str(os.getpid()))\n"
+            "if r == '0':\n"
+            "    sys.exit(7)\n"
+            "time.sleep(60)\n")
+    t0 = time.monotonic()
+    res = run_launch("-np", "2", "--", sys.executable, "-c", prog,
+                     timeout=120, env_extra={"SMTPU_TEST_DIR": str(tmp_path)})
+    elapsed = time.monotonic() - t0
+    assert res.returncode == 7, res.stdout + res.stderr
+    assert elapsed < 30, f"teardown took {elapsed:.1f}s (straggler waited?)"
+    pid = int(open(tmp_path / "pid_1").read())
+    with pytest.raises(OSError):     # ESRCH: the straggler is gone
+        os.kill(pid, 0)
+
+
+def test_supervised_chaos_recovery_end_to_end(tmp_path):
+    """The acceptance scenario: a fault plan kills rank 0 mid-training
+    AND corrupts the newest checkpoint; the supervisor restarts the
+    world, train_with_resume rejects the damaged file, falls back to the
+    previous valid generation, and finishes within tolerance of an
+    uninterrupted run.  Markers stop both faults from re-firing in the
+    restarted world."""
+    from swiftmpi_tpu.testing.faults import FaultPlan
+    plan = (FaultPlan()
+            .corrupt_checkpoint(at_save=2,
+                                marker=str(tmp_path / "corrupted"))
+            .kill_rank(0, at_step=2, marker=str(tmp_path / "killed")))
+    res = run_launch("-np", "1", "-cpu", "8", "-max-restarts", "2",
+                     "-backoff", "0.1", "--", sys.executable,
+                     os.path.join(REPO, "tests", "_chaos_child.py"),
+                     timeout=600,
+                     env_extra={"SMTPU_CHAOS_DIR": str(tmp_path),
+                                "SMTPU_FAULT_PLAN": plan.to_json()})
+    assert res.returncode == 0, res.stdout + res.stderr
+    assert "world recovered after 1 restart(s)" in res.stderr, res.stderr
+    assert (tmp_path / "killed").exists()
+    assert (tmp_path / "corrupted").exists()
+    # the iter-2 checkpoint was corrupted, so the restarted world resumed
+    # from the iter-1 generation: 3 of 4 iterations rerun
+    line = [l for l in res.stdout.splitlines() if "CHAOS_OK" in l]
+    assert line, res.stdout + res.stderr
+    assert "n_losses=3" in line[0], line[0]
+    rel = float(line[0].split("rel=")[1])
+    assert rel < 0.2, line[0]
