@@ -147,6 +147,23 @@ def _w2v_step_bytes(model, B) -> float:
     elif r == "sg_shared":                # skip-gram, batch-shared pool
         rows_pull = B + model.shared_pool + B * W2
         rows_push = 2 * B * W2 + model.shared_pool
+    elif r in ("stencil", "stencil_shared"):
+        # positional-stencil CBOW: contexts come from ONE pull of the
+        # S = B + 2W unique stream-span rows instead of B*2W per-pair
+        # gathers (~8x fewer context-row transactions at W=4), and the
+        # v-grads go back through the same S rows via push_span
+        S = B + W2
+        if r == "stencil":
+            rows_pull = S + B * (K + 1)   # span v + per-pair h targets
+        else:
+            rows_pull = S + B + model.shared_pool
+        rows_push = rows_pull
+        item = model.table.state["h"].dtype.itemsize
+        return (rows_pull * d * item
+                + rows_push * d * (2 * item + 2 * 4)
+                # push_span's sort-free dedup writes + scatter-mins a
+                # (capacity,) int32 representative plane per v push
+                + model.table.capacity * 4 * 2)
     else:
         return None
     item = model.table.state["h"].dtype.itemsize
@@ -441,14 +458,19 @@ def _bench_s2v(device, timed_calls, model):
 W2V_1M_VOCAB = 1_000_000
 
 
-def build_w2v_1m_model(device):
+def build_w2v_1m_model(device, stencil=False):
     """The 1M-vocab cell's model (BASELINE config #3 shape: demo.conf
     hyperparameters over a ~1M-word Zipf vocabulary / 1.3M-row table).
     ONE builder shared by the bench cell and the profiler ablation
     (scripts/profile_step.py) so a cell retune can never silently
     desynchronize the shape being profiled from the shape being timed.
     Returns (model, rng) with ``rng`` in its post-vocab state for batch
-    synthesis."""
+    synthesis.
+
+    ``stencil=True``: the positional-stencil rendering composed with
+    the shared negative pool — the BENCH_ONLY=scale_stencil cell's
+    shape.  A labeled rendering variant (like BENCH_SCALE_SHARED),
+    never compared against per-pair cells unlabeled."""
     import jax
     import numpy as np
     from swiftmpi_tpu.cluster.cluster import Cluster
@@ -473,7 +495,13 @@ def build_w2v_1m_model(device):
                      # to B+pool.  A labeled rendering variant, never
                      # compared against per-pair cells unlabeled.
                      **({"shared_negatives": 1, "shared_pool": 4096}
-                        if os.environ.get("BENCH_SCALE_SHARED") else {})},
+                        if os.environ.get("BENCH_SCALE_SHARED") else {}),
+                     # stencil kwarg: span rendering + shared pool (the
+                     # stencil attack is on the context gathers; the
+                     # pool already won the h-family fight, so the cell
+                     # composes both)
+                     **({"stencil": 1, "shared_negatives": 1,
+                         "shared_pool": 4096} if stencil else {})},
         # BENCH_DTYPE: the 1M-vocab regime is where half-width storage
         # may pay (byte-bound gathers at large capacity — the 01:09 UTC
         # grid halved the cap=262K gather in bf16)
@@ -488,31 +516,52 @@ def build_w2v_1m_model(device):
     return model, rng
 
 
-def _bench_w2v_1m(device, timed_calls):
+def _bench_w2v_1m(device, timed_calls, stencil=False):
     """BASELINE config #3 shape: the same fused step over a ~1M-word
     vocabulary (1.3M-row table).  Batches are synthesized directly in
     vocab-index space (uniform centers/contexts, Zipf counts for the
     sampler) — this measures the DEVICE pipeline at scale; the host
-    pipeline at 1M vocab is exercised by tests/test_scale.py."""
+    pipeline at 1M vocab is exercised by tests/test_scale.py.
+
+    ``stencil=True``: the positional-stencil rendering over synthetic
+    stream spans of S = B + 2W tokens — sentence ids in SENT_LEN
+    blocks, centers at consecutive positions, per-center dynamic
+    halves, matching the batcher's wire format exactly."""
     import jax
     import jax.numpy as jnp
 
     V = W2V_1M_VOCAB
-    model, rng = build_w2v_1m_model(device)
+    model, rng = build_w2v_1m_model(device, stencil=stencil)
     with jax.default_device(device):
         step = model._build_multi_step(INNER_STEPS)
         B, W2 = BATCH, 2 * model.window
-        centers = jnp.asarray(rng.integers(0, V, size=(INNER_STEPS, B)),
-                              jnp.int32)
-        contexts = jnp.asarray(rng.integers(0, V,
-                                            size=(INNER_STEPS, B, W2)),
-                               jnp.int32)
-        masks = jnp.asarray(rng.random((INNER_STEPS, B, W2)) < 0.8)
+        if stencil:
+            W = model.window
+            S = B + W2
+            tokens = jnp.asarray(
+                rng.integers(0, V, size=(INNER_STEPS, S)), jnp.int32)
+            sent_id = jnp.broadcast_to(
+                jnp.arange(S, dtype=jnp.int32) // SENT_LEN,
+                (INNER_STEPS, S))
+            center_pos = jnp.broadcast_to(
+                W + jnp.arange(B, dtype=jnp.int32), (INNER_STEPS, B))
+            half = jnp.asarray(
+                rng.integers(1, W + 1, size=(INNER_STEPS, B)), jnp.int32)
+            batch_args = (tokens, sent_id, center_pos, half)
+        else:
+            centers = jnp.asarray(rng.integers(0, V,
+                                               size=(INNER_STEPS, B)),
+                                  jnp.int32)
+            contexts = jnp.asarray(rng.integers(0, V,
+                                                size=(INNER_STEPS, B, W2)),
+                                   jnp.int32)
+            masks = jnp.asarray(rng.random((INNER_STEPS, B, W2)) < 0.8)
+            batch_args = (centers, contexts, masks)
         state = {f: jax.device_put(v, device)
                  for f, v in model.table.state.items()}
         args = tuple(jax.device_put(x, device) for x in
                      (model._slot_of_vocab, model._alias_prob,
-                      model._alias_idx, centers, contexts, masks))
+                      model._alias_idx) + batch_args)
         state, dt, _ = _timed_steps(step, state, args, timed_calls,
                                     jax.random.key(0))
     out = {"words_per_sec": B * INNER_STEPS * timed_calls / dt,
@@ -522,6 +571,8 @@ def _bench_w2v_1m(device, timed_calls):
            # distinguishable by content, not by stage/env metadata
            "dtype": os.environ.get("BENCH_DTYPE", "float32"),
            "rendering": getattr(model, "resolved_rendering", None)}
+    if stencil:
+        out["span"] = BATCH + 2 * model.window
     out.update(_roofline(device, dt / (timed_calls * INNER_STEPS),
                          hbm_bytes=_w2v_step_bytes(model, B)))
     return out
@@ -894,6 +945,17 @@ def _bench_tfm(device, timed_calls):
     H = max(D // 64, 1)
     while D % H:
         H -= 1
+    # validate head_dim parity UP FRONT: _rope rotates head_dim/2 pairs,
+    # so an odd head_dim (BENCH_TFM_DMODEL=129 -> H=1, hd=129; even
+    # d_model is not enough — 130 -> H=2, hd=65) crashes at TRACE time,
+    # after the stage already spent its tunnel window on the build
+    hd = D // H
+    if hd % 2:
+        raise ValueError(
+            f"BENCH_TFM_DMODEL={D} factors into n_heads={H} with an odd "
+            f"head_dim={hd}; rotary embedding rotates head_dim/2 pairs "
+            "and would crash at trace time — pick a d_model whose "
+            "derived head_dim is even (a multiple of 128 always works)")
     cfg = TransformerConfig(vocab_size=8192, d_model=D, n_heads=H,
                             n_layers=L, d_ff=4 * D, max_seq=S,
                             dtype=jnp.bfloat16,
@@ -1095,6 +1157,17 @@ def child_main(which: str) -> None:
         # which the bf16 stage would pay TWICE over (BENCH_DTYPE
         # changes the program) before reaching the one cell it wants
         out["w2v_1m"] = _bench_w2v_1m(device, max(timed // 2, 1))
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
+    if os.environ.get("BENCH_ONLY") == "scale_stencil":
+        # positional-stencil rendering at 1M vocab: ONE pull of the
+        # B+2W unique stream-span rows replaces the B*2W per-pair
+        # context gather, and the v push skips the 151K-key sort via
+        # push_span.  Own child + own key: a different program than
+        # w2v_1m, never merged into its cell
+        out["w2v_1m_stencil"] = _bench_w2v_1m(device, max(timed // 2, 1),
+                                              stencil=True)
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
@@ -1476,6 +1549,7 @@ _SECONDARY_CELLS = (
     ("w2v_skipgram", "w2v_sg", "words_per_sec", "words/s"),
     ("w2v_sg_shared", "w2v_sg_shared", "words_per_sec", "words/s"),
     ("w2v_1m_vocab", "w2v_1m", "words_per_sec", "words/s"),
+    ("w2v_1m_stencil", "w2v_1m_stencil", "words_per_sec", "words/s"),
     ("w2v_text8_epoch_wall", "w2v_text8", "epoch_wall_s", "s"),
     ("w2v_100m_epoch_wall", "w2v_100m", "epoch_wall_s", "s"),
     ("transformer_lm", "tfm", "tokens_per_sec", "tokens/s"),
@@ -1497,6 +1571,26 @@ _CELL_SHAPE_FIELDS = {
 }
 _LENIENT_SHAPE_FIELDS = {"scan_unroll", "remat_policy", "mode",
                          "d_model", "n_layers", "seq"}
+
+# In-process defaults for the lenient fields.  An older cached variant
+# MISSING a lenient field ran at the then-default — it may stand in for
+# this run's CPU cell only when the CPU cell also ran at that default.
+# The leniency is bidirectional: a fresh CPU cell tuned AWAY from the
+# default must not pair against a default-shape variant just because
+# the variant predates the knob (the one-way wildcard silently compared
+# two different programs).
+_LENIENT_FIELD_DEFAULTS = {
+    "lr": {"scan_unroll": 1},
+    "tfm": {"seq": 512, "d_model": 512, "n_layers": 4,
+            "remat_policy": "full"},
+}
+
+# Families whose headline cached cell is superseded by the best
+# same-family sweep variant (key_*): the degraded table must surface
+# the family's best measured number (e.g. tfm_b256_remat's 405K
+# tokens/s / 28.5% MFU), not whichever shape happened to land under
+# the bare key first.
+_BEST_OF_FAMILY = {"tfm"}
 
 
 def parent_main() -> None:
@@ -1755,7 +1849,34 @@ def parent_main() -> None:
                     cpu_cell = (cpu_res or {}).get(key)
                     cached_from = None
                     shape = _CELL_SHAPE_FIELDS.get(key)
-                    if shape and isinstance(cpu_cell, dict):
+
+                    def _m(a, b, f):
+                        return (a.get(f) is None or b.get(f) is None
+                                or a.get(f) == b.get(f))
+
+                    if key in _BEST_OF_FAMILY:
+                        # best-of-family promotion: surface the best
+                        # same-family sweep number under the headline
+                        # label, origin recorded via tpu_cached_from.
+                        # If its shape differs from this run's CPU
+                        # cell, say config_mismatch and DROP the CPU
+                        # pairing — a best-shape chip number over a
+                        # default-shape CPU run is not a speedup ratio.
+                        for alt_key in sorted(lk_res):
+                            alt = lk_res[alt_key]
+                            if (alt_key.startswith(key + "_")
+                                    and isinstance(alt, dict)
+                                    and field in alt
+                                    and alt[field] > cell[field]):
+                                cell, cached_from = alt, alt_key
+                        if (shape and isinstance(cpu_cell, dict)
+                                and not all(_m(cell, cpu_cell, f)
+                                            for f in shape)):
+                            out["secondary"].setdefault(
+                                name, {"unit": unit})[
+                                "config_mismatch"] = True
+                            cpu_cell = None
+                    elif shape and isinstance(cpu_cell, dict):
                         # config-matched pairing (generalized from the
                         # lr case by round-5 review): the cached
                         # headline cell may predate a default change;
@@ -1764,18 +1885,21 @@ def parent_main() -> None:
                         # run's CPU cell.  Headline check is lenient
                         # both ways (older cells miss fields); an alt
                         # candidate must match STRICTLY except on
-                        # fields whose absence means the then-default
-                        # — the wildcard must not promote a deliberate
-                        # A/B variant as the twin.
-                        def _m(a, b, f):
-                            return (a.get(f) is None or b.get(f) is None
-                                    or a.get(f) == b.get(f))
+                        # lenient fields whose absence means the
+                        # then-default — and only when this run's CPU
+                        # cell actually ran AT that default (the
+                        # wildcard must not promote a deliberate A/B
+                        # variant, nor pair a tuned fresh cell against
+                        # a default-shape variant).
+                        defaults = _LENIENT_FIELD_DEFAULTS.get(key, {})
 
                         def _twin(alt, f):
                             if cpu_cell.get(f) is None:
                                 return True
                             if alt.get(f) is None:
-                                return f in _LENIENT_SHAPE_FIELDS
+                                return (f in _LENIENT_SHAPE_FIELDS
+                                        and cpu_cell.get(f)
+                                        == defaults.get(f))
                             return alt.get(f) == cpu_cell.get(f)
                         if not all(_m(cell, cpu_cell, f) for f in shape):
                             for alt_key in sorted(lk_res):

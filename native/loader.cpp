@@ -208,6 +208,9 @@ struct SmtpuBatcher {
   std::vector<int64_t> order;   // sentence permutation for this epoch
   int64_t sent_i;               // position in `order`
   int64_t pos_i;                // position within current sentence
+  int pending_half;             // stencil: drawn-but-unadmitted center's
+                                // half-window (-1 = none); preserves the
+                                // rng stream across batch closes
 };
 
 SmtpuBatcher* smtpu_batcher_new(const int32_t* tokens, const int64_t* offsets,
@@ -225,6 +228,7 @@ SmtpuBatcher* smtpu_batcher_new(const int32_t* tokens, const int64_t* offsets,
   std::shuffle(b->order.begin(), b->order.end(), b->rng);
   b->sent_i = 0;
   b->pos_i = 0;
+  b->pending_half = -1;
   return b;
 }
 
@@ -233,6 +237,7 @@ void smtpu_batcher_reset(SmtpuBatcher* b, uint64_t seed) {
   std::shuffle(b->order.begin(), b->order.end(), b->rng);
   b->sent_i = 0;
   b->pos_i = 0;
+  b->pending_half = -1;
 }
 
 // Fill up to batch_size examples; contexts/mask are (batch_size, 2*window).
@@ -286,6 +291,94 @@ int64_t smtpu_batcher_next(SmtpuBatcher* b, int64_t batch_size,
 }
 
 void smtpu_batcher_free(SmtpuBatcher* b) { delete b; }
+
+// ---- positional-stencil batcher -------------------------------------------
+//
+// Emits stream spans instead of per-pair rows: `tokens`/`sent_id` hold a
+// contiguous slice of the shuffled sentence stream (capacity S = batch_size
+// + 2*window — the unique gather working set), `center_pos`/`half` index
+// into it.  Expansion semantics match data/text.py's stencil_to_cbow; the
+// rng is consumed in exactly smtpu_batcher_next's per-position order (keep
+// coin, then shrink only if kept), so the expanded pair stream for a seed
+// equals the per-pair epoch's.  Do not interleave per-pair and stencil
+// calls on one batcher without a reset: they share the walk cursors.
+//
+// Output buffers: tokens (S,) int32, sent_id (S,) int32 (-1 = padding),
+// center_pos (batch_size,) int32 (-1 = padding), half (batch_size,) int32.
+// Returns admitted center count; 0 = epoch exhausted.
+int64_t smtpu_batcher_next_stencil(SmtpuBatcher* b, int64_t batch_size,
+                                   int32_t* tokens, int32_t* sent_id,
+                                   int32_t* center_pos, int32_t* half) {
+  const int W = b->window;
+  const int64_t S = batch_size + 2 * W;
+  std::uniform_real_distribution<float> unif(0.0f, 1.0f);
+  for (int64_t i = 0; i < S; i++) { tokens[i] = 0; sent_id[i] = -1; }
+  for (int64_t i = 0; i < batch_size; i++) {
+    center_pos[i] = -1;
+    half[i] = 0;
+  }
+  int64_t fill = 0;   // span rows used
+  int64_t nc = 0;     // centers admitted
+  int32_t ns = 0;     // batch-local sentence counter
+  while (b->sent_i < b->n_sents) {
+    int64_t s = b->order[b->sent_i];
+    const int32_t* sent = b->tokens + b->offsets[s];
+    int64_t L = b->offsets[s + 1] - b->offsets[s];
+    int64_t p = b->pos_i;
+    int64_t p0 = 0;       // first sentence position resident in the span
+    int64_t base = fill;  // span index of sentence position p0
+    int64_t have = 0;     // positions [p0, p0+have) are appended
+    int32_t sid = ns++;
+    if (p > 0) {
+      // mid-sentence resume (only at call start, fill == 0): replay the
+      // left tail so upcoming centers keep their left context
+      p0 = p - W > 0 ? p - W : 0;
+      base = fill;
+      for (int64_t k = 0; k < p - p0; k++) {
+        tokens[fill + k] = sent[p0 + k];
+        sent_id[fill + k] = sid;
+      }
+      fill += p - p0;
+      have = p - p0;
+    }
+    for (; p < L; p++) {
+      int hf;
+      if (b->pending_half >= 0) {
+        hf = b->pending_half;       // drawn before the previous close
+        b->pending_half = -1;
+      } else {
+        // center-only subsample gate, then shrink (word2vec.h:555,561)
+        if (b->keep_prob && unif(b->rng) >= b->keep_prob[sent[p]]) continue;
+        hf = W - (int)(b->rng() % (uint64_t)W);
+      }
+      int64_t left = hf < p ? hf : p;
+      int64_t right = hf < L - 1 - p ? hf : L - 1 - p;
+      if (left + right == 0) continue;
+      if (have == 0 && p - W > p0) p0 = p - W;  // skip unreachable prefix
+      int64_t end = p + right;  // last sentence position this window needs
+      if (nc == batch_size || base + (end - p0) >= S) {
+        b->pending_half = hf;   // re-admit p in the next span
+        b->pos_i = p;
+        return nc;
+      }
+      if (end - p0 >= have) {   // append contiguously through the window
+        int64_t n_new = end - p0 + 1 - have;
+        for (int64_t k = 0; k < n_new; k++) {
+          tokens[fill + k] = sent[p0 + have + k];
+          sent_id[fill + k] = sid;
+        }
+        fill += n_new;
+        have += n_new;
+      }
+      center_pos[nc] = (int32_t)(base + (p - p0));
+      half[nc] = (int32_t)hf;
+      nc++;
+    }
+    b->sent_i++;
+    b->pos_i = 0;
+  }
+  return nc;
+}
 
 // ---- prefetch executor ----------------------------------------------------
 //

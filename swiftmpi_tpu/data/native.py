@@ -20,7 +20,7 @@ from typing import Iterator, List, Optional, Sequence
 
 import numpy as np
 
-from swiftmpi_tpu.data.text import CBOWBatch, Vocab
+from swiftmpi_tpu.data.text import CBOWBatch, StencilBatch, Vocab
 from swiftmpi_tpu.utils.logger import get_logger
 
 log = get_logger(__name__)
@@ -85,6 +85,10 @@ def _load_lib():
         lib.smtpu_batcher_next.restype = c.c_int64
         lib.smtpu_batcher_next.argtypes = [c.c_void_p, c.c_int64, c.c_void_p,
                                            c.c_void_p, c.c_void_p]
+        lib.smtpu_batcher_next_stencil.restype = c.c_int64
+        lib.smtpu_batcher_next_stencil.argtypes = [
+            c.c_void_p, c.c_int64, c.c_void_p, c.c_void_p, c.c_void_p,
+            c.c_void_p]
         lib.smtpu_batcher_free.argtypes = [c.c_void_p]
         lib.smtpu_prefetcher_new.restype = c.c_void_p
         lib.smtpu_prefetcher_new.argtypes = [c.c_void_p, c.c_int64,
@@ -215,6 +219,27 @@ class NativeCBOWBatcher:
             batch_size,
             lambda c, x, m: lib.smtpu_batcher_next(
                 self._h, batch_size, c, x, m))
+
+    def epoch_stencil(self, batch_size: int) -> Iterator[StencilBatch]:
+        """Stream-span epoch (same wire format as
+        ``CBOWBatcher.epoch_stencil``): spans of ``batch_size + 2W``
+        unique tokens with per-center positions, assembled in C++."""
+        lib = self._lib
+        W = self.window
+        S = batch_size + 2 * W
+        self._epoch_i += 1
+        lib.smtpu_batcher_reset(self._h, self._seed + self._epoch_i)
+        while True:
+            tokens = np.zeros(S, np.int32)
+            sids = np.zeros(S, np.int32)
+            cpos = np.zeros(batch_size, np.int32)
+            half = np.zeros(batch_size, np.int32)
+            n = lib.smtpu_batcher_next_stencil(
+                self._h, batch_size, tokens.ctypes.data, sids.ctypes.data,
+                cpos.ctypes.data, half.ctypes.data)
+            if n == 0:
+                return
+            yield StencilBatch(tokens, sids, cpos, half, int(n))
 
     def __del__(self):
         try:

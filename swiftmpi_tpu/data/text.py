@@ -110,6 +110,57 @@ class CBOWBatch:
         return len(self.centers)
 
 
+@dataclass
+class StencilBatch:
+    """Positional-stencil wire format: the batch is a *stream span* of
+    unique tokens plus per-center positions into it, so the device pulls
+    at most ``B + 2W`` rows instead of ``B * 2W`` context gathers.
+
+    Expansion semantics (see :func:`stencil_to_cbow`): center row ``i``
+    with ``p = center_pos[i]`` and ``h = half[i]`` has center token
+    ``tokens[p]`` and contexts ``tokens[j]`` for ``j`` in
+    ``[p-h, p+h]``, ``j != p``, ``0 <= j < S`` and
+    ``sent_id[j] == sent_id[p]`` (sentence-boundary mask), in increasing
+    ``j`` — identical content and order to the per-pair ``CBOWBatch``.
+    """
+
+    tokens: np.ndarray      # (S,) int32 span vocab indices; 0 at padding
+    sent_id: np.ndarray     # (S,) int32 batch-local sentence id; -1 pad
+    center_pos: np.ndarray  # (B,) int32 span index per center; -1 pad
+    half: np.ndarray        # (B,) int32 effective half-window; 0 pad
+    n_words: int            # real (unpadded) center count
+
+    def __len__(self) -> int:
+        return len(self.center_pos)
+
+    @property
+    def span(self) -> int:
+        return len(self.tokens)
+
+
+def stencil_to_cbow(batch: StencilBatch, window: int) -> CBOWBatch:
+    """Host-side expansion of a stencil batch to per-pair rows — the
+    parity anchor: with the same seed, the expanded stream must equal
+    the per-pair batcher's stream element for element."""
+    W = int(window)
+    B = len(batch.center_pos)
+    S = batch.span
+    centers = np.zeros(B, np.int32)
+    ctxs = np.zeros((B, 2 * W), np.int32)
+    mask = np.zeros((B, 2 * W), bool)
+    for i in range(batch.n_words):
+        p = int(batch.center_pos[i])
+        h = int(batch.half[i])
+        sid = int(batch.sent_id[p])
+        js = [j for j in range(p - h, p + h + 1)
+              if j != p and 0 <= j < S and batch.sent_id[j] == sid]
+        ctx = batch.tokens[js]
+        centers[i] = batch.tokens[p]
+        ctxs[i, :len(ctx)] = ctx
+        mask[i, :len(ctx)] = True
+    return CBOWBatch(centers, ctxs, mask, batch.n_words)
+
+
 class CBOWBatcher:
     """Streams fixed-size CBOW batches over a corpus."""
 
@@ -182,6 +233,95 @@ class CBOWBatcher:
                 ctxs.append(np.zeros(2 * W, np.int32))
                 masks.append(np.zeros(2 * W, bool))
             yield flush(n_real)
+
+    def epoch_stencil(self, batch_size: int) -> Iterator[StencilBatch]:
+        """One pass emitting :class:`StencilBatch` stream spans.
+
+        Consumes the rng in *exactly* the order :meth:`epoch` does
+        (permutation, then per-sentence shrink array + keep array), so
+        the expanded pair stream for a given seed is identical to the
+        per-pair epoch — the CPU parity tests pin this.
+
+        Invariants (by construction, not by dedup):
+        * span capacity is fixed at ``S = batch_size + 2W`` — the unique
+          gather working set per batch;
+        * every admitted center's full (sentence-clipped) window is
+          resident in the span, so expansion never loses a context;
+        * a sentence split across batches replays its last ``W`` tokens
+          into the new span so left contexts survive the split.
+        """
+        W = self.window
+        S = batch_size + 2 * W
+        tokens = np.zeros(S, np.int32)
+        sids = np.full(S, -1, np.int32)
+        cpos = np.full(batch_size, -1, np.int32)
+        halves = np.zeros(batch_size, np.int32)
+        fill = 0   # span rows used
+        nc = 0     # centers admitted
+        ns = 0     # batch-local sentence counter
+
+        def flush():
+            nonlocal tokens, sids, cpos, halves, fill, nc, ns
+            out = StencilBatch(tokens, sids, cpos, halves, nc)
+            tokens = np.zeros(S, np.int32)
+            sids = np.full(S, -1, np.int32)
+            cpos = np.full(batch_size, -1, np.int32)
+            halves = np.zeros(batch_size, np.int32)
+            fill = nc = ns = 0
+            return out
+
+        for si in self.rng.permutation(len(self._sents)):
+            sent = self._sents[si]
+            L = len(sent)
+            bs = self.rng.integers(0, W, size=L)
+            if self.sample >= 0:
+                center_keep = (self.rng.random(L)
+                               < self.keep_prob[sent])
+            else:
+                center_keep = np.ones(L, bool)
+            sid = ns
+            ns += 1
+            p0 = 0       # first sentence position resident in the span
+            base = fill  # span index of sentence position p0
+            have = 0     # sentence positions [p0, p0+have) are appended
+            p = 0
+            while p < L:
+                half = W - int(bs[p])
+                left = min(half, p)
+                right = min(half, L - 1 - p)
+                if not center_keep[p] or left + right == 0:
+                    p += 1
+                    continue
+                if have == 0:
+                    # nothing resident yet: skip any keep-dropped prefix
+                    # no future window can reach (all reach >= p - W)
+                    p0 = max(p0, p - W)
+                end = p + right         # last sentence position needed
+                if nc == batch_size or base + (end - p0) >= S:
+                    yield flush()
+                    # resume mid-sentence: replay the left tail so
+                    # upcoming centers keep their left context
+                    p0 = max(0, p - W)
+                    base = 0
+                    n = p - p0
+                    tokens[:n] = sent[p0:p]
+                    sids[:n] = 0
+                    fill = have = n
+                    sid, ns = 0, 1
+                    continue            # re-admit p in the fresh span
+                # append (contiguously) through the window's right edge
+                if end - p0 >= have:
+                    n_new = end - p0 + 1 - have
+                    tokens[fill:fill + n_new] = sent[p0 + have:end + 1]
+                    sids[fill:fill + n_new] = sid
+                    fill += n_new
+                    have += n_new
+                cpos[nc] = base + (p - p0)
+                halves[nc] = half
+                nc += 1
+                p += 1
+        if nc:
+            yield flush()
 
 
 def synthetic_corpus(n_sentences: int, vocab_size: int, length: int = 20,

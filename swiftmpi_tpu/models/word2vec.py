@@ -116,6 +116,17 @@ def _stack_group(batches):
     return c, x, m
 
 
+def _stack_group_stencil(batches):
+    """StencilBatch variant of ``_stack_group``.  Every stencil batch is
+    fixed-shape (span and center arrays are padded, only ``n_words``
+    varies), so even epoch tails stack and fuse."""
+    t = jnp.asarray(np.stack([np.asarray(b.tokens) for b in batches]))
+    s = jnp.asarray(np.stack([np.asarray(b.sent_id) for b in batches]))
+    c = jnp.asarray(np.stack([np.asarray(b.center_pos) for b in batches]))
+    h = jnp.asarray(np.stack([np.asarray(b.half) for b in batches]))
+    return t, s, c, h
+
+
 def _cbow_targets(slot_of_vocab, alias_prob, alias_idx, centers,
                   contexts, ctx_mask, key, K):
     """Shared CBOW batch layout: draw the negatives and build the
@@ -189,6 +200,14 @@ class Word2Vec:
         self.shared_negatives = g(
             "word2vec", "shared_negatives", 0).to_int32()
         self.shared_pool = g("word2vec", "shared_pool", 1024).to_int32()
+        # TPU-first opt-in: positional-stencil rendering — the batcher
+        # emits stream POSITIONS over a span of B + 2W tokens and the
+        # step gathers only the span's unique rows (≤ B + 2W instead of
+        # B·2W context rows), computing context sums as a fixed-offset
+        # sliding window with sentence-boundary masks.  Composes with
+        # shared_negatives for the pool-negative h side.  See
+        # _build_grads_stencil.
+        self.stencil = g("word2vec", "stencil", 0).to_int32()
         # TPU-first opt-in with PARITY semantics: compute the NS phase
         # through full (B, capacity) logits on the MXU instead of
         # random row gathers (see _build_grads_dense) — same sampling
@@ -269,6 +288,17 @@ class Word2Vec:
         grads_fn = self._build_grads()
         apply_fn = self._build_apply()
 
+        if self.stencil:
+            @partial(jax.jit, donate_argnums=0)
+            def step_st(state, slot_of_vocab, alias_prob, alias_idx,
+                        tokens, sent_id, center_pos, half, key):
+                pushes, es, ec = grads_fn(
+                    state, slot_of_vocab, alias_prob, alias_idx,
+                    tokens, sent_id, center_pos, half, key)
+                return apply_fn(state, pushes), es, ec
+
+            return step_st
+
         @partial(jax.jit, donate_argnums=0)
         def step(state, slot_of_vocab, alias_prob, alias_idx,
                  centers, contexts, ctx_mask, key):
@@ -311,6 +341,25 @@ class Word2Vec:
         Batches arrive stacked on a leading (n_inner, ...) axis."""
         grads_fn = self._build_grads()
         apply_fn = self._build_apply()
+
+        if self.stencil:
+            @partial(jax.jit, donate_argnums=0)
+            def multi_st(state, slot_of_vocab, alias_prob, alias_idx,
+                         tokens_s, sids_s, cpos_s, half_s, key):
+                keys = jax.random.split(key, n_inner)
+
+                def body(state, xs):
+                    t, s, c, h, k = xs
+                    pushes, es, ec = grads_fn(
+                        state, slot_of_vocab, alias_prob, alias_idx,
+                        t, s, c, h, k)
+                    return apply_fn(state, pushes), (es, ec)
+
+                state, (es, ec) = jax.lax.scan(
+                    body, state, (tokens_s, sids_s, cpos_s, half_s, keys))
+                return state, es.sum(), ec.sum()
+
+            return multi_st
 
         @partial(jax.jit, donate_argnums=0)
         def multi(state, slot_of_vocab, alias_prob, alias_idx,
@@ -382,12 +431,11 @@ class Word2Vec:
                 "transfer: each worker replica trains locally, and the "
                 "'tpu' backend's shard_map routing cannot nest inside the "
                 "per-worker mesh (set [cluster] transfer: xla)")
-        if jax.process_count() > 1:
-            raise NotImplementedError(
-                "async_mode=hogwild is a single-process SPMD mode (the "
-                "worker axis spans this process's devices); combine it "
-                "with multi-process dp by running sync dp across hosts "
-                "instead")
+        # Single-process SPMD mode: the worker axis spans this process's
+        # devices.  Multi-process runs are routed by train() to the
+        # snapshot bounded-staleness mode (measured loss envelope within
+        # +0.02% of hogwild at realistic scale — docs/ARCHITECTURE.md
+        # "Async modes") rather than refused.
         grads_fn = self._build_grads()
         apply_fn = self._build_apply()
         mesh = self.cluster.mesh
@@ -469,6 +517,26 @@ class Word2Vec:
         math, per-key mean normalization — no push.  Split out so the async
         (``local_steps``) mode can compute grads against a *stale* state
         snapshot while pushes land on the live state."""
+        if self.stencil:
+            if self.sg:
+                raise ValueError(
+                    "stencil is a CBOW-only rendering (span positions "
+                    "index a center's context window); drop sg or "
+                    "stencil")
+            if self.dense_logits:
+                raise ValueError(
+                    "dense_logits and stencil are two different "
+                    "renderings of the gather working set — pick one")
+            if getattr(self.transfer, "name", "") != "xla":
+                raise ValueError(
+                    "the stencil rendering pushes its span family "
+                    "through XlaTransfer.push_span — set [cluster] "
+                    "transfer: xla")
+            if self.shared_negatives:
+                self.resolved_rendering = "stencil_shared"
+                return self._build_grads_stencil(shared=True)
+            self.resolved_rendering = "stencil"
+            return self._build_grads_stencil(shared=False)
         if self.sg:
             if self.dense_logits:
                 raise ValueError(
@@ -741,6 +809,162 @@ class Word2Vec:
 
         return grads_fn
 
+    def _build_grads_stencil(self, shared: bool):
+        """Positional-stencil rendering of the CBOW gradient phase
+        (opt-in, ``stencil: 1``): collapse the context gather to the
+        batch's UNIQUE stream-span rows.
+
+        Consecutive centers in a sequential stream share context
+        tokens, so the per-pair rendering's (B, 2W) context gather
+        touches at most S = B + 2W unique rows — ~16.4K instead of
+        ~131K at bench shape, ~8x fewer HBM transactions against the
+        measured 28ns/row random-gather floor (docs/ROUND5_NOTES.md).
+        The batcher emits positions over the span (data/text.py
+        ``StencilBatch``; the native loader emits the identical wire
+        format) and the context sum becomes a fixed-stencil
+        sliding-window reduction:
+
+          v_span  = pull span rows            — ONE ≤(B+2W)-row gather
+          ctx_idx = center_pos ± {1..W}       — static stencil offsets
+          masks   = in-span ∧ same-sentence ∧ |offset| ≤ half ∧ valid
+          neu1    = Σ_offsets v_span[ctx_idx]·mask   — gathered from
+                    the span ARRAY, not the capacity table
+
+        The v-gradient inverts the same stencil: per-pair context
+        grads scatter onto SPAN positions (batch-local dense indices),
+        then one position-indexed push dedups duplicate tokens WITHOUT
+        the generic path's 151K-key sort (transfer/xla.py
+        ``push_span``).  Sentence boundaries and the reference's
+        dynamic window shrink (word2vec.h:556) are masks, equal by
+        construction to the per-pair batcher's expansion —
+        data/text.py ``stencil_to_cbow`` is the executable statement
+        of that equivalence and the parity tests pin it.
+
+        ``shared=False``: per-center K negatives drawn from the SAME
+        sampling stream as the parity gather rendering — directly
+        checkable against the numpy oracle.  ``shared=True``
+        (``shared_negatives: 1``): the batch-shared pool of
+        ``_build_grads_shared`` on the h side — the 1M-vocab bench
+        cell's composition."""
+        access = self.access
+        transfer = self.transfer
+        W = self.window
+        alpha = self.alpha
+        d = self.len_vec
+        K = self.shared_pool if shared else self.negative
+
+        offsets = jnp.concatenate(
+            [jnp.arange(-W, 0), jnp.arange(1, W + 1)])      # (2W,)
+
+        def stencil_parts(state, slot_of_vocab, tokens, sent_id,
+                          center_pos, half):
+            S = tokens.shape[0]
+            span_valid = sent_id >= 0
+            span_slots = jnp.where(span_valid, slot_of_vocab[tokens], -1)
+            # THE gather this rendering exists for: ≤ B + 2W unique rows
+            v_span = transfer.pull(
+                state, span_slots, access, fields=("v",)
+            )["v"].astype(jnp.float32)                       # (S, d)
+            row_valid = center_pos >= 0
+            cp = jnp.clip(center_pos, 0, S - 1)
+            centers = tokens[cp]                             # (B,) vocab
+            c_slots = jnp.where(row_valid, span_slots[cp], -1)
+            ctx_idx = cp[:, None] + offsets[None, :]         # (B, 2W)
+            ci = jnp.clip(ctx_idx, 0, S - 1)
+            ctx_mask = ((ctx_idx >= 0) & (ctx_idx < S)
+                        & (sent_id[ci] == sent_id[cp][:, None])
+                        & (jnp.abs(offsets)[None, :] <= half[:, None])
+                        & row_valid[:, None])
+            v_ctx = v_span[ci]        # span-local gather, not HBM rows
+            neu1 = jnp.sum(v_ctx * ctx_mask[..., None], axis=1)
+            return span_slots, centers, c_slots, ci, ctx_mask, neu1
+
+        def v_push(span_slots, ci, ctx_mask, neu1e, S):
+            # invert the stencil: per-pair context grads land on SPAN
+            # positions (dense batch-local indices, not a capacity
+            # scatter); contribution counts ride along so push_span's
+            # mean normalization divides by the true pair count
+            contrib = jnp.where(ctx_mask[..., None],
+                                neu1e[:, None, :], 0.0)
+            vg = jnp.zeros((S, d), jnp.float32).at[
+                ci.reshape(-1)].add(contrib.reshape(-1, d))
+            vc = jnp.zeros((S,), jnp.float32).at[
+                ci.reshape(-1)].add(
+                ctx_mask.reshape(-1).astype(jnp.float32))
+            return PushSpec(span_slots, {"v": vg}, mean=True, counts=vc)
+
+        def grads_fn(state, slot_of_vocab, alias_prob, alias_idx,
+                     tokens, sent_id, center_pos, half, key):
+            S = tokens.shape[0]
+            B = center_pos.shape[0]
+            (span_slots, centers, c_slots, ci, ctx_mask,
+             neu1) = stencil_parts(state, slot_of_vocab, tokens,
+                                   sent_id, center_pos, half)
+            row_valid = center_pos >= 0
+            if shared:
+                negs = sample_alias(key, alias_prob, alias_idx, (K,))
+                n_slots = slot_of_vocab[negs]                # (K,)
+                pulled_h = transfer.pull(
+                    state, jnp.concatenate([c_slots, n_slots]), access,
+                    fields=("h",))["h"].astype(jnp.float32)
+                h_pos = pulled_h[:B]
+                h_neg = pulled_h[B:B + K]
+                f_pos = jnp.einsum("bd,bd->b", neu1, h_pos)
+                f_neg = neu1 @ h_neg.T                       # (B, K) MXU
+                g_pos = jnp.where(
+                    row_valid, (1.0 - sigmoid_clipped(f_pos)) * alpha,
+                    0.0)
+                # negative == center skipped (word2vec.h:584-586)
+                n_valid = (negs[None, :] != centers[:, None]) \
+                    & row_valid[:, None]
+                g_neg = jnp.where(
+                    n_valid, (0.0 - sigmoid_clipped(f_neg)) * alpha, 0.0)
+                gw = g_neg * (self.negative / K)
+                gh_pos = g_pos[:, None] * neu1
+                gh_neg = gw.T @ neu1                         # (K, d) MXU
+                neu1e = g_pos[:, None] * h_pos + gw @ h_neg
+                neg_slots = jnp.where(n_valid.any(axis=0), n_slots, -1)
+                # pool rows push as their own SUM family; see the
+                # normalization-collapse note in _build_grads_shared
+                pushes = (PushSpec(c_slots, {"h": gh_pos}, mean=True),
+                          PushSpec(neg_slots, {"h": gh_neg}),
+                          v_push(span_slots, ci, ctx_mask, neu1e, S))
+                ratio = self.negative / K
+                err_sum = jnp.sum(1e4 * g_pos * g_pos) \
+                    + ratio * jnp.sum(1e4 * g_neg * g_neg)
+                err_cnt = row_valid.sum() + ratio * n_valid.sum()
+                return pushes, err_sum, err_cnt
+            # parity negatives: per-center draws from the SAME sampling
+            # stream as _cbow_targets — the oracle test's anchor
+            negs, neg_slots = sample_alias_slots(
+                key, alias_prob, alias_idx, slot_of_vocab, (B, K))
+            t_slots = jnp.concatenate(
+                [c_slots[:, None], neg_slots], axis=1)       # (B, K+1)
+            t_valid = jnp.concatenate(
+                [jnp.ones((B, 1), bool), negs != centers[:, None]],
+                axis=1)
+            t_valid = t_valid & row_valid[:, None]
+            t_slots = jnp.where(t_valid, t_slots, -1)
+            h_t = transfer.pull(
+                state, t_slots.reshape(-1), access, fields=("h",)
+            )["h"].reshape(B, K + 1, d).astype(jnp.float32)
+            f = jnp.einsum("bd,bkd->bk", neu1, h_t)
+            labels = jnp.concatenate(
+                [jnp.ones((B, 1)), jnp.zeros((B, K))], axis=1)
+            g = (labels - sigmoid_clipped(f)) * alpha
+            g = jnp.where(t_valid, g, 0.0)                   # (B, K+1)
+            h_contrib = g[..., None] * neu1[:, None, :]      # (B,K+1,d)
+            neu1e = jnp.einsum("bk,bkd->bd", g, h_t)         # (B, d)
+            pushes = (PushSpec(t_slots.reshape(-1),
+                               {"h": h_contrib.reshape(-1, d)},
+                               mean=True),
+                      v_push(span_slots, ci, ctx_mask, neu1e, S))
+            err_sum = jnp.sum(1e4 * g * g)          # word2vec.h:593
+            err_cnt = t_valid.sum()
+            return pushes, err_sum, err_cnt
+
+        return grads_fn
+
     def _build_grads_sg(self):
         """Skip-gram gradient phase.  Pair axis (B, 2W): input v[context],
         targets h[center]+K negatives sampled fresh *per pair* (word2vec.c
@@ -905,6 +1129,13 @@ class Word2Vec:
                     new_fields = access.apply_push(state, spec.grads)
                     state = dict(state)
                     state.update(new_fields)
+                elif getattr(spec, "counts", None) is not None:
+                    # position-indexed span family (stencil rendering):
+                    # rows are pre-summed with data counts — sort-free
+                    # dedup path
+                    state = transfer.push_span(
+                        state, spec.slots, spec.grads, spec.counts,
+                        access, mean=spec.mean)
                 else:
                     state = transfer.push(state, spec.slots, spec.grads,
                                           access, mean=spec.mean)
@@ -955,8 +1186,33 @@ class Word2Vec:
                     "call build()/build_from_vocab() before train() with a "
                     "vocab-less batcher")
         hogwild = self.async_mode == "hogwild"
-        sync = self.local_steps <= 1 and not hogwild
         nprocs = jax.process_count()
+        if hogwild and nprocs > 1:
+            # hogwild's worker axis spans ONE process's devices; the
+            # measured multi-host substitute is the snapshot bounded-
+            # staleness mode — loss envelope within +0.02% of hogwild
+            # at realistic scale (docs/ARCHITECTURE.md "Async modes"),
+            # so route there with a notice instead of refusing the run
+            self.local_steps = max(self.local_steps, 2)
+            log.warning(
+                "async_mode=hogwild spans a single process's devices; "
+                "multi-process run falls back to snapshot bounded "
+                "staleness (local_steps=%d; measured loss envelope "
+                "+0.02%% vs hogwild at realistic scale — see "
+                "docs/ARCHITECTURE.md)", self.local_steps)
+            hogwild = False
+        stencil = bool(self.stencil)
+        if stencil and hogwild:
+            raise ValueError(
+                "async_mode=hogwild drives per-pair batches; the stencil "
+                "rendering composes with the snapshot (local_steps) "
+                "async mode instead")
+        if stencil and nprocs > 1:
+            raise ValueError(
+                "the stencil rendering is single-process for now "
+                "(DistributedBatcher shards per-pair batches); drop "
+                "stencil or run single-process")
+        sync = self.local_steps <= 1 and not hogwild
         # fused multi-step only makes sense single-process (distributed
         # batches are global arrays that cannot be host-stacked)
         fuse = sync and self.inner_steps > 1 and nprocs == 1
@@ -1016,9 +1272,17 @@ class Word2Vec:
                 def run_single(batch):
                     nonlocal state, frozen, step_i
                     self._key, sub = jax.random.split(self._key)
-                    args = (self._slot_of_vocab, self._alias_prob,
-                            self._alias_idx, _dev(batch.centers),
-                            _dev(batch.contexts), _dev(batch.ctx_mask), sub)
+                    if stencil:
+                        args = (self._slot_of_vocab, self._alias_prob,
+                                self._alias_idx, _dev(batch.tokens),
+                                _dev(batch.sent_id),
+                                _dev(batch.center_pos),
+                                _dev(batch.half), sub)
+                    else:
+                        args = (self._slot_of_vocab, self._alias_prob,
+                                self._alias_idx, _dev(batch.centers),
+                                _dev(batch.contexts),
+                                _dev(batch.ctx_mask), sub)
                     if sync:
                         state, es, ec = self._step(state, *args)
                         # the step donates (deletes) the input state
@@ -1063,18 +1327,24 @@ class Word2Vec:
                         group = []
                         return
                     self._key, sub = jax.random.split(self._key)
-                    c, x, m = _stack_group(group)
+                    stacked = _stack_group_stencil(group) if stencil \
+                        else _stack_group(group)
                     state, es, ec = fused(
                         state, self._slot_of_vocab, self._alias_prob,
-                        self._alias_idx, c, x, m, sub)
+                        self._alias_idx, *stacked, sub)
                     self.table.state = state
                     es_q.add(es)
                     ec_q.add(ec)
                     meter.record(sum(b.n_words for b in group))
                     group = []
 
-                for batch in batcher.epoch(batch_size):
-                    if fuse and len(batch.centers) == batch_size:
+                epoch_iter = (batcher.epoch_stencil(batch_size)
+                              if stencil else batcher.epoch(batch_size))
+                for batch in epoch_iter:
+                    # every stencil batch is fixed-shape (padded span),
+                    # so all of them group-fuse, tails included
+                    if fuse and (stencil
+                                 or len(batch.centers) == batch_size):
                         group.append(batch)
                         if len(group) == self.inner_steps:
                             run_group()

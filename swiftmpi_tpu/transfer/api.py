@@ -64,25 +64,33 @@ class PushSpec:
     normalized (e.g. the dense-logits w2v mode computes the h-grad as
     a (capacity, d) matmul output): the apply step feeds them straight
     to the access method, skipping the transfer's scatter/dedup —
-    ``slots`` is unused and should be None."""
+    ``slots`` is unused and should be None.
+
+    ``counts`` (non-None) marks a POSITION-INDEXED span family (the
+    stencil w2v rendering): each row already carries the sum of its
+    window-overlap contributions and ``counts[i]`` says how many, so
+    ``mean`` normalization needs the data counts rather than
+    1-per-row, and the apply step routes through the sort-free
+    ``push_span`` dedup instead of the generic sorted push."""
 
     def __init__(self, slots, grads, mean: bool = False,
-                 dense: bool = False):
+                 dense: bool = False, counts=None):
         self.slots = slots
         self.grads = grads
         self.mean = bool(mean)
         self.dense = bool(dense)
+        self.counts = counts
 
     def __iter__(self):
         return iter((self.slots, self.grads, self.mean))
 
     def tree_flatten(self):
-        return (self.slots, self.grads), (self.mean, self.dense)
+        return (self.slots, self.grads, self.counts), (self.mean, self.dense)
 
     @classmethod
     def tree_unflatten(cls, aux, children):
         mean, dense = aux
-        return cls(children[0], children[1], mean, dense)
+        return cls(children[0], children[1], mean, dense, children[2])
 
 
 class Transfer:

@@ -482,3 +482,95 @@ def test_degraded_lr_ratio_marks_unmatchable_config(
     assert lr["tpu_cached"] == 11.75e6          # headline kept, not lr_u4
     assert "tpu_cached_from" not in lr
     assert lr["config_mismatch"] is True
+
+
+def test_twin_leniency_requires_cpu_cell_at_default(
+        monkeypatch, tmp_path, capsys):
+    """Bidirectional leniency: a cached variant MISSING a lenient shape
+    field (absence = the then-default) may only twin a fresh CPU cell
+    that actually ran AT that default.  A CPU cell tuned away from the
+    default (scan_unroll=4 here) must not pair against a default-shape
+    variant — that is the same two-different-programs ratio the strict
+    fields already block."""
+    import json
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "FULL_REPORT_PATH",
+                        str(tmp_path / "BENCH_REPORT.json"))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "device_kind": "TPU v5 lite",
+         "w2v": {"words_per_sec": 1.4e6, "step_ms": 11.6,
+                 "loss": 1.0, "rendering": "gather"},
+         "lr": {"rows_per_sec": 11.75e6, "epochs_per_dispatch": 32},
+         "lr_e128": {"rows_per_sec": 42.5e6,      # no scan_unroll field
+                     "epochs_per_dispatch": 128}})
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: False)
+
+    def fake_run_child(which, timeout_s, extra_env=None):
+        return ({"platform": "cpu", "device": "TFRT_CPU_0",
+                 "w2v": {"words_per_sec": 1e5, "step_ms": 2.0,
+                         "loss": 5.0, "rendering": "gather"},
+                 "lr": {"rows_per_sec": 15.2e6,
+                        "epochs_per_dispatch": 128,
+                        "scan_unroll": 4}},      # tuned off the default
+                None, 1.0)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench.parent_main()
+    capsys.readouterr()
+    full = json.load(open(str(tmp_path / "BENCH_REPORT.json")))
+    lr = full["secondary"]["lr_a9a"]
+    assert lr["tpu_cached"] == 11.75e6          # headline kept
+    assert "tpu_cached_from" not in lr
+    assert lr["config_mismatch"] is True
+
+
+def test_tfm_best_of_family_variant_promoted(monkeypatch, tmp_path,
+                                             capsys):
+    """The transformer secondary must report the family's BEST measured
+    cell (tfm_b256_remat's 405K tokens/s / 28.5% MFU), labeled with its
+    origin, not the stale first-measured headline shape — and because
+    the promoted shape differs from the fresh CPU cell's, the ratio is
+    dropped with an explicit config_mismatch instead of printed
+    cross-config."""
+    import json
+
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "FULL_REPORT_PATH",
+                        str(tmp_path / "BENCH_REPORT.json"))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    bench._cache_tpu_result(
+        {"platform": "tpu", "device_kind": "TPU v5 lite",
+         "w2v": {"words_per_sec": 1.4e6, "step_ms": 11.6,
+                 "loss": 1.0, "rendering": "gather"},
+         "tfm": {"tokens_per_sec": 283732.0, "mfu_pct": 20.0,
+                 "batch": 64, "seq": 512, "d_model": 512,
+                 "n_layers": 4, "remat": False, "remat_policy": "full"},
+         "tfm_b256_remat": {"tokens_per_sec": 405014.0, "mfu_pct": 28.5,
+                            "batch": 256, "seq": 512, "d_model": 512,
+                            "n_layers": 4, "remat": True,
+                            "remat_policy": "full"}})
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: False)
+
+    def fake_run_child(which, timeout_s, extra_env=None):
+        return ({"platform": "cpu", "device": "TFRT_CPU_0",
+                 "w2v": {"words_per_sec": 1e5, "step_ms": 2.0,
+                         "loss": 5.0, "rendering": "gather"},
+                 "tfm": {"tokens_per_sec": 9000.0, "batch": 64,
+                         "seq": 512, "d_model": 512, "n_layers": 4,
+                         "remat": False, "remat_policy": "full"}},
+                None, 1.0)
+
+    monkeypatch.setattr(bench, "_run_child", fake_run_child)
+    bench.parent_main()
+    capsys.readouterr()
+    full = json.load(open(str(tmp_path / "BENCH_REPORT.json")))
+    tfm = full["secondary"]["transformer_lm"]
+    assert tfm["tpu_cached"] == 405014.0
+    assert tfm["tpu_cached_from"] == "tfm_b256_remat"
+    assert tfm["mfu_pct"] == 28.5
+    assert tfm["config_mismatch"] is True
+    assert "vs_baseline_stale" not in tfm      # cross-config ratio dropped

@@ -118,3 +118,39 @@ def test_tfm_cell_knobs_tiny(tiny_shapes, monkeypatch):
     assert out["d_model"] % out["n_heads"] == 0
     assert out["remat"] is True
     assert out["tokens_per_sec"] > 0 and np.isfinite(out["loss"])
+
+
+def test_scale_stencil_cell_tiny(tiny_shapes, monkeypatch):
+    """BENCH_ONLY=scale_stencil's cell: the positional-stencil rendering
+    composed with the shared negative pool at (shrunk) 1M-vocab shape —
+    labels itself stencil_shared, records the span working set
+    (B + 2W), and produces a finite rate with an HBM bytes model."""
+    monkeypatch.setattr(bench, "W2V_1M_VOCAB", 5000)
+    dev = jax.devices()[0]
+    out = bench._bench_w2v_1m(dev, timed_calls=1, stencil=True)
+    assert out["rendering"] == "stencil_shared"
+    assert out["span"] == bench.BATCH + 8          # window 4 -> 2W = 8
+    assert out["vocab"] == 5000
+    assert out["words_per_sec"] > 0
+    # the stencil branch of the step-bytes model resolves (non-None)
+    model, _ = bench.build_w2v_1m_model(dev, stencil=True)
+    model._build_multi_step(2)
+    assert bench._w2v_step_bytes(model, bench.BATCH) is not None
+
+
+def test_tfm_odd_head_dim_fails_fast(tiny_shapes, monkeypatch):
+    """BENCH_TFM_DMODEL values whose derived head_dim is odd must fail
+    up front with a clear message, not crash _rope at trace time after
+    the stage spent its tunnel window.  129 -> H=1, hd=129; even
+    d_model is not enough: 130 -> H=2, hd=65."""
+    for dm in ("129", "130"):
+        monkeypatch.setenv("BENCH_TFM_DMODEL", dm)
+        with pytest.raises(ValueError, match="head_dim"):
+            bench._bench_tfm(jax.devices()[0], timed_calls=1)
+    # the guard admits valid shapes (the existing D=40 sweep point)
+    monkeypatch.setenv("BENCH_TFM_BATCH", "2")
+    monkeypatch.setenv("BENCH_TFM_SEQ", "16")
+    monkeypatch.setenv("BENCH_TFM_DMODEL", "40")
+    monkeypatch.setenv("BENCH_TFM_LAYERS", "1")
+    out = bench._bench_tfm(jax.devices()[0], timed_calls=1)
+    assert out["tokens_per_sec"] > 0

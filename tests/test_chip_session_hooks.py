@@ -256,3 +256,31 @@ def test_run_agenda_degraded_full_rolls_back_and_retries(
                             "dense_logits"}
     log_text = open(chip_session.OUT).read()
     assert "verdict_rollback" in log_text
+
+def test_run_agenda_rollback_disables_ab_recording_downstream(
+        agenda_env, monkeypatch):
+    """After bench_full rolls back a kernel verdict, every LATER stage
+    must run with SMTPU_AB_RECORD=0 — otherwise a micro stage re-wins
+    its microbench and re-arms the exact verdict the retry cleared."""
+    degraded_tail = json.dumps(
+        {"degraded": ["tpu_unavailable: child rc=1"], "value": 1.0})
+    seen = iter([(True, degraded_tail), (True, "{}")])
+    envs = []
+
+    def run(name, cmd, timeout_s, env_extra=None, tpu_env=True):
+        envs.append((name, dict(env_extra or {})))
+        return next(seen) if name == "bench_full" else (True, "{}")
+    monkeypatch.setattr(chip_session, "run", run)
+    monkeypatch.setattr(bench, "_tpu_alive", lambda timeout_s=75: True)
+    monkeypatch.setattr(calibration, "clear", lambda kern: None)
+    chip_session.run_agenda(
+        [("bench_full", ["x"], 5, None),
+         ("micro_a", ["x"], 5, {"BENCH_ONLY": "gather"})], "test")
+    assert [n for n, _ in envs] == ["bench_full", "bench_full",
+                                    "micro_a"]
+    # first bench_full attempt ran un-gated; the retry and every stage
+    # after it carry the recording kill-switch
+    assert "SMTPU_AB_RECORD" not in envs[0][1]
+    assert envs[1][1].get("SMTPU_AB_RECORD") == "0"
+    assert envs[2][1].get("SMTPU_AB_RECORD") == "0"
+    assert envs[2][1]["BENCH_ONLY"] == "gather"   # original env kept
