@@ -156,7 +156,7 @@ def main():
         roof = ""
         if "hbm_pct" in cell:
             roof = f"{cell['hbm_pct']}% HBM ({cell.get('hbm_gbps')} GB/s)"
-        elif "mfu_pct" in cell:
+        elif isinstance(cell.get("mfu_pct"), (int, float)):
             roof = f"{cell['mfu_pct']}% MFU ({cell.get('tflops')} TF/s)"
         prov = f" *(merged {merged[key][:10]})*" if key in merged else ""
         print(f"| {label} | **{_fmt(t, unit)}** | {_fmt(c, unit)} | "

@@ -14,8 +14,8 @@ Config surface mirrors the reference ``[cluster]`` section
 * ``server_num``   — number of table shards (the ``model``/``shard`` axis
   size; the reference's inverted present/absent branch is NOT replicated —
   absent means "all devices").
-* ``transfer``     — data-plane backend (``xla``/``tpu``/``local``),
-  the BASELINE.json north-star flag.
+* ``transfer``     — data-plane backend (``xla``/``tpu``/``hybrid``/
+  ``local``), the BASELINE.json north-star flag.
 * ``frag_num``     — hashfrag granularity (``[server]`` section, like the
   reference server.frag_num).
 """
@@ -63,21 +63,23 @@ class Cluster:
                      else len(devices))
         backend = (self.config.get("cluster", "transfer").to_string()
                    if self.config.has("cluster", "transfer") else "xla")
-        if backend == "tpu":
+        if backend in ("tpu", "hybrid"):
             # explicit routing wants the both-roles mesh: every device is
             # worker+server.  Single-process: 1-D, shard count == device
             # count.  Multi-process: hybrid (data x shard) — the shard
             # routing axis stays within each process (ICI), data groups
             # replicate the table and reconcile via one dense psum per
             # push (the only DCN traffic).  See ps_mesh/TpuTransfer.
+            # ``hybrid`` shares the mesh: its tail path IS the tpu
+            # routing, its hot head is replicated over every axis.
             self.mesh = ps_mesh(devices=devices, hybrid=multi_process)
             shard_size = int(self.mesh.shape[SHARD_AXIS])
             if (n_servers != shard_size
                     and self.config.has("cluster", "server_num")):
                 log.warning(
-                    "transfer=tpu sizes the server count by its shard "
-                    "axis; overriding server_num=%d -> %d", n_servers,
-                    shard_size)
+                    "transfer=%s sizes the server count by its shard "
+                    "axis; overriding server_num=%d -> %d", backend,
+                    n_servers, shard_size)
             self.table_axis = SHARD_AXIS
             n_servers = shard_size
         else:
@@ -95,7 +97,7 @@ class Cluster:
         frag_num = (self.config.get("server", "frag_num").to_int32()
                     if self.config.has("server", "frag_num") else None)
         self.hashfrag = HashFrag(n_servers, frag_num)
-        kwargs = {"mesh": self.mesh} if backend == "tpu" else {}
+        kwargs = {"mesh": self.mesh} if backend in ("tpu", "hybrid") else {}
         self.transfer = get_transfer(backend, **kwargs)
         self._initialized = True
         log.info("cluster up: %s transfer=%s", mesh_info(self.mesh), backend)
@@ -103,11 +105,15 @@ class Cluster:
 
     # -- tables ------------------------------------------------------------
     def create_table(self, name: str, access: AccessMethod,
-                     capacity_per_shard: int, seed: int = 0) -> SparseTable:
+                     capacity_per_shard: int, seed: int = 0,
+                     partition=None) -> SparseTable:
+        """``partition``: optional ``HotColdPartition`` reserving a
+        replicated hot head in the table (hybrid transfer); tail keys
+        keep the hashfrag-sharded layout."""
         if not self._initialized:
             raise RuntimeError("Cluster.initialize() first")
         ki = KeyIndex(self.n_servers, capacity_per_shard,
-                      hashfrag=self.hashfrag)
+                      hashfrag=self.hashfrag, partition=partition)
         table = SparseTable(access, ki, mesh=self.mesh,
                             axis=self.table_axis, seed=seed)
         self.tables[name] = table

@@ -85,3 +85,23 @@ class HashFrag:
 
     def __repr__(self) -> str:  # pragma: no cover
         return f"HashFrag(shards={self.num_shards}, frags={self.num_frags})"
+
+
+def split_route(hashfrag: HashFrag, partition, keys):
+    """Hybrid hot/cold routing: resolve each key to EITHER a hot slot
+    (replicated head, no shard owner) OR its hash-owned shard.
+
+    Returns ``(hot_slots, shard_ids)`` — ``hot_slots[i] >= 0`` marks a hot
+    key whose shard id is -1 (it is never routed); tail keys carry -1 hot
+    slot and their ``to_shard_id`` owner.  This is the single place where
+    the frequency partition overrides the murmur routing rule, so the
+    precedence (partition first, hash second) is identical everywhere:
+    KeyIndex.lookup, the hybrid transfer's traffic accounting, and tests.
+    ``partition=None`` degenerates to pure hash routing.
+    """
+    keys = np.asarray(keys, dtype=np.uint64)
+    shards = hashfrag.to_shard_id(keys).astype(np.int64)
+    if partition is None:
+        return np.full(keys.shape, -1, dtype=np.int64), shards
+    hot = partition.hot_slot(keys)
+    return hot, np.where(hot >= 0, -1, shards)

@@ -27,7 +27,8 @@ from typing import Callable, Dict, List, Optional
 import numpy as np
 
 from swiftmpi_tpu.cluster.bootstrap import host_array, is_writer
-from swiftmpi_tpu.parameter.sparse_table import SparseTable
+from swiftmpi_tpu.parameter.sparse_table import (SparseTable, base_field,
+                                                 hot_name)
 
 Formatter = Callable[[Dict[str, np.ndarray]], str]
 Parser = Callable[[str], Dict[str, np.ndarray]]
@@ -90,14 +91,15 @@ def dump_table_text(table: SparseTable, path: str,
         from swiftmpi_tpu.data import native
         if native.available():
             keys, slots = _index_arrays(table.key_index)
-            # host_array is a collective in multi-process runs: gather on
-            # every process, write once
-            arrs = [host_array(table.state[f])[slots] for f in fields]
+            # unified_rows_host is a collective in multi-process runs:
+            # gather on every process, write once.  Unified view: hot
+            # rows first, tail rows offset — slots index it directly.
+            arrs = [table.unified_rows_host(f)[slots] for f in fields]
             if not is_writer():
                 return len(keys)
             return native.dump_rows_native(path, keys, arrs)
         formatter = default_formatter(fields)
-    rows = {f: host_array(table.state[f]) for f in table.access.fields}
+    rows = {f: table.unified_rows_host(f) for f in table.access.fields}
     if not is_writer():
         return len(table.key_index)
     n = 0
@@ -138,12 +140,8 @@ def load_table_text(table: SparseTable, path: str,
             idx = np.asarray(_lookup_growing(table, key_arr), np.int32)
             state = dict(table.state)
             for fname, block in zip(fields, arrs):
-                # host_array, not np.asarray: state may be a non-fully-
-                # addressable global array in multi-process runs (gather is
-                # collective — every process reaches this line)
-                arr = host_array(state[fname]).copy()
-                arr[idx] = block.reshape(len(idx), -1)
-                state[fname] = _replace(table, fname, arr)
+                _scatter_unified(table, state, fname, idx,
+                                 block.reshape(len(idx), -1))
             table.state = state
             return len(key_arr)
         parser = default_parser(fields)
@@ -179,16 +177,34 @@ def load_table_text(table: SparseTable, path: str,
         if not vals:
             continue
         block = np.stack(vals).reshape(len(slots), -1)
-        arr = host_array(state[fname]).copy()   # multihost-safe read side
-        arr[idx] = block
-        state[fname] = _replace(table, fname, arr)
+        _scatter_unified(table, state, fname, idx, block)
     table.state = state
     return n
 
 
+def _scatter_unified(table: SparseTable, state: dict, fname: str,
+                     idx: np.ndarray, block: np.ndarray) -> None:
+    """Scatter ``block`` rows at UNIFIED slots ``idx`` into ``state``,
+    splitting between the replicated hot array (``slot < n_hot``) and the
+    sharded tail (rebased by ``-n_hot``).  Mutates ``state`` in place.
+    host_array, not np.asarray, on the read side: state may be a
+    non-fully-addressable global array in multi-process runs (the gather
+    is collective — every process reaches this line)."""
+    n_hot = table.n_hot
+    tail_sel = idx >= n_hot
+    arr = host_array(state[fname]).copy()
+    arr[idx[tail_sel] - n_hot] = block[tail_sel]
+    state[fname] = _replace(table, fname, arr)
+    if n_hot and not tail_sel.all():
+        hn = hot_name(fname)
+        harr = host_array(state[hn]).copy()
+        harr[idx[~tail_sel]] = block[~tail_sel]
+        state[hn] = _replace(table, hn, harr)
+
+
 def _replace(table: SparseTable, fname: str, arr: np.ndarray):
     import jax
-    sharding = table.row_sharding()
+    sharding = table.field_sharding(fname)
     if sharding is None:
         return jax.numpy.asarray(arr)
     return jax.device_put(arr, sharding)
@@ -413,6 +429,10 @@ def save_checkpoint(table: SparseTable, path: str,
     payload["num_shards"] = np.int64(table.key_index.num_shards)
     payload["capacity_per_shard"] = np.int64(
         table.key_index.capacity_per_shard)
+    # hybrid placement: the hot-head size travels with the checkpoint so
+    # load can refuse a table built under a different frequency split
+    # (the @hot field arrays are in the field__ payload like any other)
+    payload["n_hot"] = np.int64(table.n_hot)
     for k, v in (extra or {}).items():
         payload[f"extra__{k}"] = np.asarray(v)
     if not is_writer():        # gather above was the collective part
@@ -453,9 +473,23 @@ def load_checkpoint(table: SparseTable, path: str,
                 f"checkpoint capacity_per_shard {saved_cap} is smaller "
                 f"than the table's {table.key_index.capacity_per_shard}; "
                 "shrinking on load is not supported")
+        saved_hot = int(z["n_hot"]) if "n_hot" in z.files else 0
+        if saved_hot != table.n_hot:
+            raise ValueError(
+                f"checkpoint has n_hot={saved_hot}, table has "
+                f"n_hot={table.n_hot} — the hot/cold partition is fixed "
+                "at vocab build; rebuild the model under the same "
+                "frequency split before restoring")
         state = {}
-        for name, fs in table.access.fields.items():
-            arr = z[f"field__{name}"]
+        for zname in z.files:
+            if not zname.startswith("field__"):
+                continue
+            name = zname[len("field__"):]
+            # @hot arrays restore next to their base field with the same
+            # storage dtype (and their replicated placement, via
+            # _replace's per-name sharding)
+            fs = table.access.fields[base_field(name)]
+            arr = z[zname]
             if arr.dtype != fs.dtype:
                 # bf16 fields were saved upcast to fp32 (npz has no
                 # bfloat16); restore the table's storage dtype exactly

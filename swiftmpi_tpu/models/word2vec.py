@@ -46,6 +46,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
 from swiftmpi_tpu.cluster.cluster import Cluster
 from swiftmpi_tpu.data.text import (CBOWBatcher, Vocab, build_vocab,
                                     load_corpus)  # noqa: F401 (Vocab: API)
@@ -253,6 +254,9 @@ class Word2Vec:
         self._fused_cache = {}
         self._tail_fuse_frozen = False
         self._key = jax.random.key(seed ^ 0x5EED)
+        # per-train() observability: hogwild tail-skip count, hybrid
+        # transfer traffic counters — refreshed by every train() call
+        self.train_metrics: dict = {}
 
     # -- vocab / table bring-up (word2vec_global.h:385-444) ----------------
     def build(self, sentences) -> "Word2Vec":
@@ -270,8 +274,25 @@ class Word2Vec:
         if self.table is None:
             cap = self._capacity_per_shard or max(
                 64, int(V * 1.3 / self.cluster.n_servers) + 1)
+            partition = None
+            if getattr(self.transfer, "name", "") == "hybrid":
+                # Zipf-aware hot/cold split: replicate the measured
+                # frequency head, shard the tail (transfer/hybrid.py).
+                # batch_rows drives the dense-vs-sparse crossover in the
+                # calibration: the head pays off while its dense psum
+                # stays comparable to the head hits a batch routes.
+                from swiftmpi_tpu.parameter.key_index import \
+                    HotColdPartition
+                partition = HotColdPartition.from_counts(
+                    self.vocab.keys, self.vocab.counts,
+                    batch_rows=self.minibatch)
+                log.info(
+                    "hybrid placement: %d hot keys (%.1f%% of token "
+                    "mass) replicated; %d tail keys sharded",
+                    partition.n_hot, 100 * (partition.head_mass or 0.0),
+                    V - partition.n_hot)
             self.table = self.cluster.create_table(
-                "w2v", self.access, cap)
+                "w2v", self.access, cap, partition=partition)
         slots = self.table.key_index.lookup(self.vocab.keys)
         self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
         prob, alias = build_unigram_alias(self.vocab.counts)
@@ -425,12 +446,12 @@ class Word2Vec:
         linearly with worker count, so large fleets amortize it with
         bigger ``local_steps`` or prefer the snapshot
         (``local_steps``-only) async mode."""
-        if getattr(self.transfer, "name", "") == "tpu":
+        if getattr(self.transfer, "name", "") in ("tpu", "hybrid"):
             raise ValueError(
                 "async_mode=hogwild requires the gather/scatter 'xla' "
                 "transfer: each worker replica trains locally, and the "
-                "'tpu' backend's shard_map routing cannot nest inside the "
-                "per-worker mesh (set [cluster] transfer: xla)")
+                "'tpu'/'hybrid' backends' shard_map routing cannot nest "
+                "inside the per-worker mesh (set [cluster] transfer: xla)")
         # Single-process SPMD mode: the worker axis spans this process's
         # devices.  Multi-process runs are routed by train() to the
         # snapshot bounded-staleness mode (measured loss envelope within
@@ -527,11 +548,12 @@ class Word2Vec:
                 raise ValueError(
                     "dense_logits and stencil are two different "
                     "renderings of the gather working set — pick one")
-            if getattr(self.transfer, "name", "") != "xla":
+            if getattr(self.transfer, "name", "") not in ("xla", "hybrid"):
                 raise ValueError(
                     "the stencil rendering pushes its span family "
-                    "through XlaTransfer.push_span — set [cluster] "
-                    "transfer: xla")
+                    "through push_span (XlaTransfer, or HybridTransfer's "
+                    "split hot/tail span paths) — set [cluster] "
+                    "transfer: xla or hybrid")
             if self.shared_negatives:
                 self.resolved_rendering = "stencil_shared"
                 return self._build_grads_stencil(shared=True)
@@ -565,7 +587,8 @@ class Word2Vec:
             # every auto condition except fit)
             fits = (self.table is not None
                     and self.table.capacity <= 20_000)
-            dense = (getattr(self.transfer, "name", "") != "tpu"
+            dense = (getattr(self.transfer, "name", "")
+                     not in ("tpu", "hybrid")
                      and calibration.gated("dense_logits",
                                            "SMTPU_DENSE_LOGITS", fits))
         # which rendering actually resolved — benches label their
@@ -648,12 +671,12 @@ class Word2Vec:
         Reference math being reproduced: word2vec.h:550-615 (the same
         f/g/neu1e quantities, batched).
         """
-        if getattr(self.transfer, "name", "") == "tpu":
+        if getattr(self.transfer, "name", "") in ("tpu", "hybrid"):
             raise ValueError(
                 "dense_logits computes the h-grad as a full-capacity "
-                "matmul and applies it directly — the 'tpu' backend's "
-                "row-sharded routing doesn't apply (set [cluster] "
-                "transfer: xla)")
+                "matmul and applies it directly — the 'tpu'/'hybrid' "
+                "backends' row-sharded routing doesn't apply (set "
+                "[cluster] transfer: xla)")
         access = self.access
         transfer = self.transfer
         K = self.negative
@@ -1248,14 +1271,16 @@ class Word2Vec:
         losses = []
         meter = Throughput()
         step_i = 0
+        hogwild_dropped = 0
         for it in range(niters):
             # global step: cumulative across resumed runs, so a fault
             # plan's crash-at-step-k means "after k completed steps"
             # regardless of how many attempts it took to get there
             faults.step_event(start_iter + it)
             if hogwild:
-                err_sum, err_cnt = self._hogwild_epoch(
+                err_sum, err_cnt, it_dropped = self._hogwild_epoch(
                     batcher, batch_size, meter)
+                hogwild_dropped += it_dropped
                 state = self.table.state
             else:
                 # Per-batch loss scalars are QUEUED as device arrays
@@ -1376,14 +1401,32 @@ class Word2Vec:
                          checkpoint_path)
                 faults.checkpoint_event(npz_path(checkpoint_path))
         self.table.state = state
+        # observability surface (returned data, not just logs): the
+        # hogwild drop bound is testable and the hybrid backend's
+        # traffic counters ride along for bench detail fields
+        self.train_metrics = {
+            "hogwild_skipped_tail_words": hogwild_dropped}
+        if hasattr(self.transfer, "traffic"):
+            self.train_metrics["transfer_traffic"] = \
+                self.transfer.traffic()
         return losses
 
     def _hogwild_epoch(self, batcher, batch_size: int, meter) -> tuple:
         """One epoch in hogwild mode: group ``n_workers * local_steps``
         fixed-shape batches per dispatch, one per worker-step.  A tail
-        too short for a full group is dropped and logged (workers in the
-        reference's async mode likewise end an iteration unevenly —
-        word2vec_global.h:630-651 joins threads wherever they ran out)."""
+        too short for a full group is dropped, logged, AND returned (the
+        third element of the result; summed into
+        ``train_metrics["hogwild_skipped_tail_words"]``).  Workers in
+        the reference's async mode likewise end an iteration unevenly —
+        word2vec_global.h:630-651 joins threads wherever they ran out.
+
+        Drop bound: per epoch at most ``group - 1`` full batches plus
+        the partial batches the batcher emits — under
+        ``group * batch_size * (1 + 2*window)`` words, a vanishing
+        fraction of any corpus large enough to satisfy the no-group
+        RuntimeError below.  The documented-drop-bound route is chosen
+        over pad+mask, which would compile a second (padded) step shape
+        per epoch to recover that fraction."""
         step, n_workers = self._step
         group = n_workers * max(self.local_steps, 1)
         state = self.table.state
@@ -1423,7 +1466,7 @@ class Word2Vec:
             log.info("hogwild: %d tail words skipped this iter (need "
                      "full groups of %d batches x %d centers)",
                      dropped, group, batch_size)
-        return err_sum, err_cnt
+        return err_sum, err_cnt, dropped
 
     def grow(self, new_capacity_per_shard: int) -> None:
         """Mid-run table growth (reference dense_hash_map self-growth,
@@ -1483,7 +1526,12 @@ class Word2Vec:
         if key not in self.table.key_index:
             return None
         slot = self.table.key_index.slot(key)
-        return np.asarray(self.table.state["v"][slot])  # one-row transfer
+        n_hot = self.table.n_hot
+        if slot < n_hot:            # replicated hot head (hybrid)
+            from swiftmpi_tpu.parameter.sparse_table import hot_name
+            return np.asarray(self.table.state[hot_name("v")][slot])
+        return np.asarray(
+            self.table.state["v"][slot - n_hot])  # one-row transfer
 
     def embedding_index(self, field: str = "v"):
         """Cosine-similarity index over the LIVE table (no dump round
@@ -1500,5 +1548,5 @@ class Word2Vec:
                 "no vocab; build()/build_from_vocab() first (after a "
                 "bare load(), use EmbeddingIndex.from_text on the dump)")
         slots = np.asarray(self._slot_of_vocab)
-        vecs = np.asarray(self.table.state[field])[slots]
+        vecs = self.table.unified_rows_host(field)[slots]
         return EmbeddingIndex(self.vocab.keys, vecs)

@@ -171,6 +171,35 @@ def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
     return verdict
 
 
+# every Pallas kernel behind a measurement gate; pallas_status walks
+# this list so a new kernel cannot silently count as validated
+_PALLAS_KERNELS = ("vmem_gather", "vmem_scatter", "replica_scatter")
+
+
+def pallas_status(kind: Optional[str] = None) -> str:
+    """One-line Pallas validation status for a device kind (r5 verdict
+    Next #6): the kernels count as a hardware capability ONLY once a
+    measured on-chip A/B verdict (pallas_ms vs xla_ms) exists for the
+    key — until then bench/calibration output must carry the explicit
+    ``unvalidated-on-tpu`` marker instead of implying the capability.
+    A recorded lowering *error* is an attempt, not a validation."""
+    if kind is None:
+        kind = device_key()
+    verdicts = {n: lookup(n, kind) for n in _PALLAS_KERNELS}
+    measured = {n: v for n, v in verdicts.items()
+                if v and "pallas_ms" in v and "xla_ms" in v}
+    if not measured:
+        errs = sorted(n for n, v in verdicts.items() if v and "error" in v)
+        if errs:
+            return ("unvalidated-on-tpu (attempted, lowering failed: "
+                    + ", ".join(errs) + ")")
+        return "unvalidated-on-tpu"
+    wins = sorted(n for n, v in measured.items() if v.get("win"))
+    if wins:
+        return "validated: win (" + ", ".join(wins) + ")"
+    return "validated: no-win"
+
+
 def gated(name: str, env_var: str, fits: bool,
           manual: bool = False) -> bool:
     """The shared measurement-driven gate policy (one copy for all

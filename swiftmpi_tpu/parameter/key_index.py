@@ -18,11 +18,25 @@ shapes and integer indexing, so the TPU design splits that hash map in two:
 Slot layout: ``slot = shard_id * capacity_per_shard + local_slot``.  With
 ``num_shards`` equal to the mesh's table-axis size, shard *i*'s range maps
 exactly onto device *i*'s row slice.
+
+Hybrid hot/cold placement (``transfer: hybrid``): an optional
+``HotColdPartition`` reserves the FIRST ``n_hot`` slots of the unified slot
+space for a frequency-ranked hot head that is replicated on every device
+(Parallax, arXiv:1808.02621).  Tail keys keep the sharded layout above,
+offset by ``n_hot``:
+
+    slot < n_hot                → hot slot (replicated row, dense psum)
+    slot = n_hot + shard*cap+l  → tail slot (hash-sharded row, all_to_all)
+
+Hot-first was chosen so hot slots survive ``grow()`` and elastic restore
+unchanged — ``n_hot`` is fixed at vocab build, while the tail layout is
+re-derived from ``capacity_per_shard`` whenever it changes.
 """
 
 from __future__ import annotations
 
-from typing import Dict, Iterable, List, Optional
+from itertools import chain
+from typing import Dict, Iterable, List, Optional, Tuple
 
 import numpy as np
 
@@ -34,14 +48,128 @@ class CapacityError(RuntimeError):
     """A shard ran out of slots; raise rather than silently evict."""
 
 
+def calibrate_hot_k(counts, mass_lo: float = 0.5, mass_hi: float = 0.8,
+                    batch_rows: Optional[int] = None,
+                    dense_ratio: float = 2.0) -> Tuple[int, float]:
+    """Pick the hot-head size K from a descending frequency histogram.
+
+    The CDF band [``mass_lo``, ``mass_hi``] bounds K to the head covering
+    ~50-80% of token mass (the Zipf knee).  Within the band, a measured
+    dense-vs-sparse crossover in the spirit of ``transfer/tpu.py:285``
+    decides how far to push: replicating K rows costs one dense psum of K
+    rows per step, while leaving them sharded costs ~``batch_rows *
+    cdf[K-1]`` routed rows (the expected head hits per batch).  The dense
+    head pays off while ``K <= dense_ratio * expected_head_hits`` — the
+    same "dense once sparse volume passes half the dense size" rule the
+    tpu backend applies to its DCN hop, applied per-partition.  Without a
+    batch-size hint the conservative band floor ``k_lo`` is used.
+
+    Returns ``(K, head_mass)`` where ``head_mass = cdf[K-1]``.
+    """
+    counts = np.asarray(counts, dtype=np.float64).ravel()
+    if counts.size == 0:
+        return 0, 0.0
+    if np.any(np.diff(counts) > 0):
+        counts = np.sort(counts)[::-1]
+    total = counts.sum()
+    if total <= 0:
+        return 0, 0.0
+    cdf = np.cumsum(counts) / total
+    k_lo = int(np.searchsorted(cdf, mass_lo, side="left")) + 1
+    k_hi = int(np.searchsorted(cdf, mass_hi, side="left")) + 1
+    k_lo = max(1, min(k_lo, counts.size))
+    k_hi = max(k_lo, min(k_hi, counts.size))
+    k = k_lo
+    if batch_rows:
+        ks = np.arange(1, counts.size + 1, dtype=np.float64)
+        ok = ((ks >= k_lo) & (ks <= k_hi)
+              & (ks <= dense_ratio * float(batch_rows) * cdf))
+        hits = np.flatnonzero(ok)
+        if hits.size:
+            k = int(hits[-1]) + 1
+    return k, float(cdf[k - 1])
+
+
+class HotColdPartition:
+    """Frequency split of the key space: hot head vs sharded cold tail.
+
+    ``hot_keys[i]`` owns hot slot ``i`` — slot order IS frequency rank, so
+    the split is deterministic under re-keying as long as the counts are
+    (ties broken by key value in :meth:`from_counts`).  The partition is
+    host-side routing metadata, the moral sibling of :class:`HashFrag`:
+    hashfrag answers "which shard owns this tail key", the partition
+    answers "is this key replicated, and at which hot slot".
+    """
+
+    def __init__(self, hot_keys):
+        hot = np.asarray(hot_keys, dtype=np.uint64).ravel()
+        if np.unique(hot).size != hot.size:
+            raise ValueError("hot_keys must be distinct")
+        self.hot_keys = hot
+        self.n_hot = int(hot.size)
+        self.head_mass: Optional[float] = None
+        self._order = np.argsort(hot, kind="stable")
+        self._sorted = hot[self._order]
+
+    @classmethod
+    def from_counts(cls, keys, counts, mass_lo: float = 0.5,
+                    mass_hi: float = 0.8,
+                    batch_rows: Optional[int] = None) -> "HotColdPartition":
+        """Calibrate K from the measured frequency CDF and take the top-K
+        keys by ``(-count, key)`` — the deterministic tie-break makes the
+        hot set a pure function of the histogram, independent of input
+        order (re-keying / vocab rebuild safety)."""
+        keys = np.asarray(keys, dtype=np.uint64).ravel()
+        counts = np.asarray(counts, dtype=np.int64).ravel()
+        if keys.shape != counts.shape:
+            raise ValueError("keys/counts length mismatch")
+        order = np.lexsort((keys, -counts))
+        k, mass = calibrate_hot_k(counts[order], mass_lo, mass_hi,
+                                  batch_rows)
+        part = cls(keys[order][:k])
+        part.head_mass = mass
+        return part
+
+    def hot_slot(self, keys) -> np.ndarray:
+        """Vectorized key → hot slot; -1 for tail keys."""
+        arr = np.asarray(keys, dtype=np.uint64)
+        flat = arr.ravel()
+        out = np.full(flat.shape, -1, dtype=np.int64)
+        if self.n_hot:
+            pos = np.searchsorted(self._sorted, flat)
+            pos_c = np.minimum(pos, self.n_hot - 1)
+            match = self._sorted[pos_c] == flat
+            out[match] = self._order[pos_c[match]]
+        return out.reshape(arr.shape)
+
+    def is_hot(self, keys) -> np.ndarray:
+        return self.hot_slot(keys) >= 0
+
+    def items(self) -> Iterable:
+        """(key, hot_slot) pairs in hot-slot (frequency-rank) order."""
+        return zip(self.hot_keys.tolist(), range(self.n_hot))
+
+    def __eq__(self, other) -> bool:
+        return (isinstance(other, HotColdPartition)
+                and np.array_equal(self.hot_keys, other.hot_keys))
+
+    def __repr__(self) -> str:  # pragma: no cover
+        mass = (f", head_mass={self.head_mass:.3f}"
+                if self.head_mass is not None else "")
+        return f"HotColdPartition(n_hot={self.n_hot}{mass})"
+
+
 class KeyIndex:
     def __init__(self, num_shards: int, capacity_per_shard: int,
-                 hashfrag: Optional[HashFrag] = None):
+                 hashfrag: Optional[HashFrag] = None,
+                 partition: Optional[HotColdPartition] = None):
         self.num_shards = int(num_shards)
         self.capacity_per_shard = int(capacity_per_shard)
         self.hashfrag = hashfrag or HashFrag(num_shards)
         if self.hashfrag.num_shards != self.num_shards:
             raise ValueError("hashfrag shard count mismatch")
+        self.partition = partition
+        self.n_hot = partition.n_hot if partition is not None else 0
         self._slot_of: Dict[int, int] = {}
         self._next_local = np.zeros(self.num_shards, dtype=np.int64)
         self._keys_by_shard: List[List[int]] = [
@@ -126,6 +254,11 @@ class KeyIndex:
         keys = np.asarray(keys, dtype=np.uint64)
         flat = keys.ravel()
         out_flat = self._ht_find(flat)
+        if self.partition is not None:
+            # hot keys never enter the sharded tail: their slot is fixed
+            # by frequency rank at vocab build, overlaying any miss
+            hot = self.partition.hot_slot(flat)
+            out_flat = np.where(hot >= 0, hot, out_flat)
         if create:
             miss_pos = np.flatnonzero(out_flat < 0)
             if miss_pos.size:
@@ -158,7 +291,7 @@ class KeyIndex:
         occ = np.empty(len(uniq), np.int64)
         occ[by_shard] = np.arange(len(uniq)) - group_start[shards[by_shard]]
         locals_ = self._next_local[shards] + occ
-        slots = shards * self.capacity_per_shard + locals_
+        slots = self.n_hot + shards * self.capacity_per_shard + locals_
         self._next_local += counts
         # mirror into the dict (authoritative order/introspection) and ht
         self._slot_of.update(
@@ -181,23 +314,41 @@ class KeyIndex:
     # -- introspection ----------------------------------------------------
     @property
     def capacity(self) -> int:
+        """Tail (sharded) capacity — the row count of sharded arrays."""
         return self.num_shards * self.capacity_per_shard
 
+    @property
+    def total_capacity(self) -> int:
+        """Hot + tail: the size of the unified slot space."""
+        return self.n_hot + self.capacity
+
     def __len__(self) -> int:
-        return len(self._slot_of)
+        return self.n_hot + len(self._slot_of)
 
     def __contains__(self, key: int) -> bool:
+        if self.partition is not None and \
+                int(self.partition.hot_slot(np.uint64(key))) >= 0:
+            return True
         return int(key) in self._slot_of
 
     def slot(self, key: int) -> int:
+        if self.partition is not None:
+            hs = int(self.partition.hot_slot(np.uint64(key)))
+            if hs >= 0:
+                return hs
         return self._slot_of[int(key)]
 
     def keys(self) -> Iterable[int]:
-        return self._slot_of.keys()
+        if self.partition is None:
+            return self._slot_of.keys()
+        return chain(self.partition.hot_keys.tolist(), self._slot_of.keys())
 
     def items(self) -> Iterable:
-        """(key, slot) pairs in insertion order per shard."""
-        return self._slot_of.items()
+        """(key, slot) pairs: hot pairs first (frequency-rank order),
+        then tail pairs in insertion order."""
+        if self.partition is None:
+            return self._slot_of.items()
+        return chain(self.partition.items(), self._slot_of.items())
 
     def shard_fill(self) -> np.ndarray:
         """Occupied slots per shard (load-balance introspection)."""
@@ -217,8 +368,8 @@ class KeyIndex:
         old = self.capacity_per_shard
         self.capacity_per_shard = new
         for key, slot in list(self._slot_of.items()):
-            shard, local = divmod(slot, old)
-            self._slot_of[key] = shard * new + local
+            shard, local = divmod(slot - self.n_hot, old)
+            self._slot_of[key] = self.n_hot + shard * new + local
         self._ht_grow(max(len(self._slot_of), 1))   # slot values changed
 
     # -- checkpoint restore ------------------------------------------------
@@ -232,7 +383,19 @@ class KeyIndex:
         per = self.capacity_per_shard
         for key, slot in zip(np.asarray(keys, np.uint64).tolist(),
                              np.asarray(slots, np.int64).tolist()):
-            shard, local = divmod(int(slot), per)
+            if int(slot) < self.n_hot:
+                # hot pair: the partition owns the mapping — validate it
+                # round-trips (a checkpoint written under a different
+                # frequency split must fail loudly, not scramble rows)
+                if self.partition is None or \
+                        int(self.partition.hot_slot(np.uint64(key))) \
+                        != int(slot):
+                    raise ValueError(
+                        f"hot slot {slot} for key {key} does not match "
+                        "the active HotColdPartition — rebuild the vocab "
+                        "(and its partition) before restoring")
+                continue
+            shard, local = divmod(int(slot) - self.n_hot, per)
             if not (0 <= shard < self.num_shards):
                 raise ValueError(f"slot {slot} outside table layout")
             self._slot_of[int(key)] = int(slot)

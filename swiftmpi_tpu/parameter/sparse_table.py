@@ -19,6 +19,13 @@ the host to materialize a row.
 The table *state* is a plain ``{field: jax.Array}`` dict — a pytree that
 training steps close over, donate, and return updated; the ``SparseTable``
 object is the host-side handle (spec, mesh placement, key index).
+
+Hybrid hot/cold placement: when the KeyIndex carries a
+``HotColdPartition``, each field ``f`` splits into a row-sharded tail array
+under its plain name (indexed by ``slot - n_hot``) and a REPLICATED hot
+array under ``f + "@hot"`` of shape ``(n_hot, dim)`` (indexed by the hot
+slot directly).  The unified slot space ``concat(hot, tail)`` is what
+callers see through :meth:`gather` / :meth:`unified_rows_host`.
 """
 
 from __future__ import annotations
@@ -35,6 +42,22 @@ from swiftmpi_tpu.parameter.access import AccessMethod
 from swiftmpi_tpu.parameter.key_index import KeyIndex
 
 TableState = Dict[str, jax.Array]
+
+#: suffix marking a replicated hot-head array in a table state dict
+HOT_SUFFIX = "@hot"
+
+
+def hot_name(field: str) -> str:
+    return field + HOT_SUFFIX
+
+
+def is_hot_field(name: str) -> bool:
+    return name.endswith(HOT_SUFFIX)
+
+
+def base_field(name: str) -> str:
+    """Strip the hot suffix: ``"v@hot" -> "v"``, plain names unchanged."""
+    return name[:-len(HOT_SUFFIX)] if is_hot_field(name) else name
 
 
 class SparseTable:
@@ -60,8 +83,24 @@ class SparseTable:
             return None
         return NamedSharding(self.mesh, PartitionSpec(self.axis))
 
+    def replicated_sharding(self) -> Optional[NamedSharding]:
+        """Placement of hot-head arrays: one full copy per device."""
+        if self.mesh is None:
+            return None
+        return NamedSharding(self.mesh, PartitionSpec())
+
+    @property
+    def n_hot(self) -> int:
+        return self.key_index.n_hot
+
+    def field_sharding(self, name: str) -> Optional[NamedSharding]:
+        """Sharding for a state-dict entry by name (hot → replicated)."""
+        return (self.replicated_sharding() if is_hot_field(name)
+                else self.row_sharding())
+
     def _init_state(self) -> TableState:
         cap = self.key_index.capacity
+        n_hot = self.n_hot
         fields = self.access.fields
 
         def init_all(key):
@@ -69,12 +108,23 @@ class SparseTable:
             for name, fs in sorted(fields.items()):
                 key, sub = jax.random.split(key)
                 out[name] = fs.init(sub, (cap, fs.dim)).astype(fs.dtype)
+            # hot arrays draw from the same stream AFTER the tail fields,
+            # so a table with n_hot=0 is bit-identical to the pre-hybrid
+            # layout
+            for name, fs in sorted(fields.items()):
+                if n_hot:
+                    key, sub = jax.random.split(key)
+                    out[hot_name(name)] = fs.init(
+                        sub, (n_hot, fs.dim)).astype(fs.dtype)
             return out
 
         sharding = self.row_sharding()
         if sharding is None:
             return jax.jit(init_all)(jax.random.key(self.seed))
         shardings = {name: sharding for name in fields}
+        if n_hot:
+            rep = self.replicated_sharding()
+            shardings.update({hot_name(name): rep for name in fields})
         return jax.jit(init_all, out_shardings=shardings)(
             jax.random.key(self.seed))
 
@@ -94,12 +144,15 @@ class SparseTable:
         ki = self.key_index
         old_per = ki.capacity_per_shard
         new_per = int(new_capacity_per_shard or 2 * old_per)
-        items = list(ki.items())
-        old_slots = np.asarray([s for _, s in items], np.int64)
+        n_hot = self.n_hot
+        # hot rows are untouched by growth (their slots sit below n_hot
+        # and never move); only tail rows re-stride
+        items = [(k, s) for k, s in ki.items() if s >= n_hot]
+        old_rows = np.asarray([s - n_hot for _, s in items], np.int64)
         ki.grow(new_per)                      # remaps key -> new slot
         # same remap the index applied, vectorized: shard and local parts
         # are preserved, only the stride changes
-        new_slots = (old_slots // old_per) * new_per + old_slots % old_per
+        new_rows = (old_rows // old_per) * new_per + old_rows % old_per
 
         fields = self.access.fields
         sharding = self.row_sharding()
@@ -108,37 +161,56 @@ class SparseTable:
         # growth so re-grown slots never repeat earlier row inits
         self.seed += 1
 
-        def remap(old_state, old_slots, new_slots, key):
+        def remap(old_state, old_rows, new_rows, key):
             out = {}
             for name, fs in sorted(fields.items()):
                 key, sub = jax.random.split(key)
                 arr = fs.init(sub, (new_cap, fs.dim)).astype(fs.dtype)
                 if len(items):
-                    arr = arr.at[new_slots].set(
-                        old_state[name][old_slots])
+                    arr = arr.at[new_rows].set(
+                        old_state[name][old_rows])
                 out[name] = arr
             return out
 
+        tail_state = {f: v for f, v in self.state.items()
+                      if not is_hot_field(f)}
         # no donation: the enlarged outputs can't reuse the smaller input
         # buffers anyway, and both copies must coexist during the scatter
         jitted = jax.jit(
             remap,
             out_shardings=None if sharding is None
             else {name: sharding for name in fields})
-        self.state = jitted(self.state, jnp.asarray(old_slots),
-                            jnp.asarray(new_slots),
-                            jax.random.key(self.seed))
+        new_state = jitted(tail_state, jnp.asarray(old_rows),
+                           jnp.asarray(new_rows),
+                           jax.random.key(self.seed))
+        # replicated hot arrays ride through unchanged
+        for f, v in self.state.items():
+            if is_hot_field(f):
+                new_state[f] = v
+        self.state = new_state
 
     # -- device-level row access ------------------------------------------
+    def _take_unified(self, field: str, slots) -> jax.Array:
+        """Row gather over the unified hot+tail slot space."""
+        tail = self.state[field]
+        n_hot = self.n_hot
+        if not n_hot:
+            return jnp.take(tail, slots, axis=0)
+        hot = self.state[hot_name(field)]
+        hot_rows = jnp.take(hot, jnp.clip(slots, 0, n_hot - 1), axis=0)
+        tail_rows = jnp.take(
+            tail, jnp.clip(slots - n_hot, 0, tail.shape[0] - 1), axis=0)
+        return jnp.where((slots < n_hot)[..., None], hot_rows, tail_rows)
+
     def gather(self, slots) -> TableState:
         """Rows for ``slots`` across pull-visible fields (device op)."""
         slots = jnp.asarray(slots)
-        return {f: jnp.take(self.state[f], slots, axis=0)
+        return {f: self._take_unified(f, slots)
                 for f in self.access.pull_fields}
 
     def gather_all_fields(self, slots) -> TableState:
         slots = jnp.asarray(slots)
-        return {f: jnp.take(self.state[f], slots, axis=0)
+        return {f: self._take_unified(f, slots)
                 for f in self.access.fields}
 
     # -- host-level introspection -----------------------------------------
@@ -155,6 +227,19 @@ class SparseTable:
         from swiftmpi_tpu.cluster.bootstrap import host_array
 
         return {f: host_array(v) for f, v in self.state.items()}
+
+    def unified_rows_host(self, field: str) -> np.ndarray:
+        """Host copy of ``field`` indexed by UNIFIED slot: rows
+        ``[0, n_hot)`` are the replicated hot head, rows ``[n_hot, ...)``
+        the sharded tail.  This is the view checkpoint text dumps and
+        embedding exports index with KeyIndex slots."""
+        from swiftmpi_tpu.cluster.bootstrap import host_array
+
+        tail = host_array(self.state[field])
+        if not self.n_hot:
+            return tail
+        return np.concatenate(
+            [host_array(self.state[hot_name(field)]), tail], axis=0)
 
     def __repr__(self) -> str:  # pragma: no cover
         return (f"SparseTable(fields={list(self.access.fields)}, "

@@ -18,6 +18,11 @@ Backends:
               gather/apply locally, ``all_to_all`` ships rows back.  The
               literal TPU translation of the reference pull/push RPC
               (SURVEY.md §3.2-3.3) on a 1-D ``shard`` mesh.
+* ``hybrid`` — Zipf-aware composition: frequency-hot rows replicated on
+              every device and reconciled with one dense ``psum`` per
+              push, cold-tail rows through the ``tpu`` routing above
+              (transfer/hybrid.py; requires a ``HotColdPartition`` on
+              the KeyIndex to be more than an alias of ``tpu``).
 * ``local`` — numpy golden model of the same semantics, for tests.
 
 Shared semantics (all backends, property-tested against each other):
@@ -138,8 +143,11 @@ def get_transfer(name: Optional[str] = None,
     if name == "tpu":
         from swiftmpi_tpu.transfer.tpu import TpuTransfer
         return TpuTransfer(**kwargs)
+    if name == "hybrid":
+        from swiftmpi_tpu.transfer.hybrid import HybridTransfer
+        return HybridTransfer(**kwargs)
     if name == "local":
         from swiftmpi_tpu.transfer.local import LocalTransfer
         return LocalTransfer(**kwargs)
     raise ValueError(f"unknown transfer backend {name!r} "
-                     "(expected xla|tpu|local)")
+                     "(expected xla|tpu|hybrid|local)")

@@ -1,0 +1,277 @@
+"""``hybrid`` transfer backend: replicated hot head + sharded cold tail.
+
+Zipf-aware placement (Parallax, arXiv:1808.02621): real vocabularies put
+most of the per-step traffic on a tiny frequency head, which under the pure
+``tpu`` backend inflates the routed-row count and skews bucket occupancy
+(the overflow counter measures exactly this).  The hybrid backend splits
+the unified slot space the ``HotColdPartition`` defines:
+
+* **hot** (``slot < n_hot``): rows live REPLICATED on every device as the
+  ``field + "@hot"`` state arrays.  Pull is a local ``take`` — zero
+  cross-chip bytes.  Push scatter-adds the local batch slice into an
+  ``(n_hot, width)`` dense buffer and reconciles with a SINGLE dense
+  ``psum`` over the whole mesh — no routing, no dedup sort (SparCML's
+  "densify once occupancy crosses the threshold", arXiv:1802.08021,
+  applied per-partition via ``calibrate_hot_k``).
+* **tail** (``slot >= n_hot``): rows stay in the hash-sharded table and
+  route through the unmodified :class:`TpuTransfer` all_to_all path,
+  re-based by ``-n_hot``.
+
+The composition sits behind the same ``pull``/``push``/``push_span`` API
+(including the PR-2 stencil span wire format), so models consume the split
+transparently.  Per-step traffic (routed tail rows, hot rows, psum bytes,
+bucket overflow) is accounted with the same tracer/eager discipline as the
+tpu backend's overflow counter and read via :meth:`traffic`.
+
+A state dict with no ``@hot`` fields (n_hot == 0, e.g. the LR loop, which
+has no upfront frequency histogram) degenerates to the pure tail path —
+``hybrid`` is then bit-identical to ``tpu``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+
+from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
+from swiftmpi_tpu.cluster.mesh import SHARD_AXIS
+from swiftmpi_tpu.parameter.sparse_table import (base_field, hot_name,
+                                                 is_hot_field)
+from swiftmpi_tpu.transfer.api import Transfer
+from swiftmpi_tpu.transfer.tpu import TpuTransfer
+
+
+class HybridTransfer(Transfer):
+    name = "hybrid"
+
+    def __init__(self, mesh: Mesh, axis: str = SHARD_AXIS,
+                 bucket_capacity: Optional[int] = None,
+                 debug_overflow: bool = False):
+        self.mesh = mesh
+        self.axis = axis
+        self.tail = TpuTransfer(mesh, axis, bucket_capacity, debug_overflow)
+        self._hot_push_cache: Dict = {}
+        self._hot_total = 0
+        self._psum_bytes_total = 0
+        self._hot_pending: list = []
+
+    # -- attribute forwarding to the tail backend --------------------------
+    @property
+    def metrics(self):
+        return self.tail.metrics
+
+    @metrics.setter
+    def metrics(self, m):
+        self.tail.metrics = m
+
+    @property
+    def count_traffic(self) -> bool:
+        return self.tail.count_traffic
+
+    @count_traffic.setter
+    def count_traffic(self, flag: bool):
+        self.tail.count_traffic = bool(flag)
+
+    @property
+    def bucket_capacity(self):
+        return self.tail.bucket_capacity
+
+    def overflow_count(self) -> int:
+        return self.tail.overflow_count()
+
+    # -- hot/tail split helpers --------------------------------------------
+    @staticmethod
+    def _n_hot(state) -> int:
+        for f, v in state.items():
+            if is_hot_field(f):
+                return int(v.shape[0])
+        return 0
+
+    @staticmethod
+    def _split_state(state):
+        tail = {f: v for f, v in state.items() if not is_hot_field(f)}
+        hot = {base_field(f): v for f, v in state.items()
+               if is_hot_field(f)}
+        return tail, hot
+
+    # -- traffic accounting ------------------------------------------------
+    def _accum_hot(self, psum_bytes: int, hot) -> None:
+        self._hot_total += int(hot)
+        self._psum_bytes_total += int(psum_bytes)
+
+    def _record_hot(self, hot, psum_bytes: int) -> None:
+        cb = partial(self._accum_hot, int(psum_bytes))
+        if isinstance(hot, jax.core.Tracer):
+            jax.debug.callback(cb, hot)
+        else:
+            self._hot_pending.append((int(psum_bytes), hot))
+            if len(self._hot_pending) >= 1024:
+                pending, self._hot_pending = self._hot_pending, []
+                for b, h in pending:
+                    self._accum_hot(b, h)
+
+    def traffic(self) -> Dict[str, int]:
+        """Cumulative per-step traffic counters (counted while
+        ``count_traffic`` is set): ``routed_rows`` (tail rows through
+        all_to_all), ``hot_rows`` (head hits served dense), ``psum_bytes``
+        (dense reconciliation volume), ``overflow_dropped``."""
+        jax.effects_barrier()
+        pending, self._hot_pending = self._hot_pending, []
+        for b, h in pending:
+            self._accum_hot(b, h)
+        t = self.tail.traffic()
+        out = {"routed_rows": t["routed_rows"],
+               "hot_rows": self._hot_total,
+               "psum_bytes": self._psum_bytes_total,
+               "overflow_dropped": t["overflow_dropped"]}
+        if self.metrics is not None:
+            self.metrics.set("transfer_hot_rows", out["hot_rows"])
+            self.metrics.set("transfer_psum_bytes", out["psum_bytes"])
+        return out
+
+    def _batch_divisor(self) -> int:
+        """The tail path shard_maps the batch dim over the mesh's data and
+        shard axes; request lengths must divide their product."""
+        div = int(self.mesh.shape[self.axis])
+        if self.tail.dp_axis:
+            div *= int(self.mesh.shape[self.tail.dp_axis])
+        return div
+
+    def _pad_batch(self, slots, grads=None, counts=None):
+        """Pad the batch dim to the next mesh multiple with -1 slots
+        (dropped by both the routed and dense paths) and zero grad rows.
+        Stencil spans are B + 2W rows — almost never mesh-aligned — so
+        the backend absorbs the alignment instead of every caller.
+        Returns ``(slots, grads, counts, orig_len)``."""
+        B = slots.shape[0]
+        pad = (-B) % self._batch_divisor()
+        if pad == 0:
+            return slots, grads, counts, B
+        slots = jnp.concatenate(
+            [slots, jnp.full((pad,) + slots.shape[1:], -1, slots.dtype)])
+        if grads is not None:
+            grads = {f: jnp.concatenate(
+                [g, jnp.zeros((pad,) + g.shape[1:], g.dtype)])
+                for f, g in ((f, jnp.asarray(g)) for f, g in grads.items())}
+        if counts is not None:
+            counts = jnp.concatenate(
+                [jnp.asarray(counts, jnp.float32),
+                 jnp.zeros((pad,), jnp.float32)])
+        return slots, grads, counts, B
+
+    # -- pull --------------------------------------------------------------
+    def pull(self, state, slots, access, fields=None):
+        fields = tuple(fields or access.pull_fields)
+        slots = jnp.asarray(slots, jnp.int32)
+        slots, _, _, B = self._pad_batch(slots)
+        tail_state, hot_state = self._split_state(state)
+        n_hot = self._n_hot(state)
+        if n_hot == 0:
+            out = self.tail.pull(tail_state, slots, access, fields)
+            return {f: v[:B] for f, v in out.items()}
+        is_hot = (slots >= 0) & (slots < n_hot)
+        tail_slots = jnp.where(slots >= n_hot, slots - n_hot, -1)
+        out = self.tail.pull(tail_state, tail_slots, access, fields)
+        if self.count_traffic:
+            self._record_hot(jnp.sum(is_hot), 0)
+        # hot rows are a LOCAL gather on the replicated head — the tail
+        # pull returned exact zeros at these positions (slot -1 padding)
+        hot_idx = jnp.clip(slots, 0, n_hot - 1)
+        for f in fields:
+            hot_rows = jnp.take(hot_state[f], hot_idx, axis=0)
+            out[f] = jnp.where(is_hot[..., None], hot_rows, out[f])[:B]
+        return out
+
+    # -- push --------------------------------------------------------------
+    def push(self, state, slots, grads, access, mean=False, counts=None):
+        slots = jnp.asarray(slots, jnp.int32)
+        slots, grads, counts, _ = self._pad_batch(slots, grads, counts)
+        tail_state, hot_state = self._split_state(state)
+        n_hot = self._n_hot(state)
+        if n_hot == 0:
+            return self.tail.push(tail_state, slots, grads, access,
+                                  mean=mean, counts=counts)
+        is_hot = (slots >= 0) & (slots < n_hot)
+        tail_slots = jnp.where(slots >= n_hot, slots - n_hot, -1)
+        new_tail = self.tail.push(tail_state, tail_slots, grads, access,
+                                  mean=mean, counts=counts)
+        if self.count_traffic:
+            width_bytes = sum(
+                np.dtype(jnp.asarray(g).dtype).itemsize * g.shape[1]
+                for g in grads.values()) + 4        # + f32 counts column
+            self._record_hot(jnp.sum(is_hot), n_hot * width_bytes)
+        new_hot = self._hot_push(hot_state, slots, grads, access,
+                                 mean, counts)
+        out = dict(new_tail)
+        out.update({hot_name(f): v for f, v in new_hot.items()})
+        return out
+
+    def push_span(self, state, slots, grads, counts, access, mean=False):
+        """Span push (stencil wire format): rows carry window-overlap
+        gradient SUMS with per-row data counts; both paths normalize by
+        the summed data counts, matching ``XlaTransfer.push_span``."""
+        return self.push(state, slots, grads, access, mean=mean,
+                         counts=counts)
+
+    def _hot_push(self, hot_state, slots, grads, access, mean, counts):
+        with_counts = counts is not None
+        sig = (self.tail._signature(hot_state, slots, grads),
+               mean, with_counts)
+        fn = self._hot_push_cache.get(sig)
+        if fn is None:
+            fn = self._hot_push_cache.setdefault(
+                sig, jax.jit(self._build_hot_push(
+                    hot_state, access, tuple(sorted(grads)), mean,
+                    with_counts)))
+        if with_counts:
+            return fn(hot_state, slots, grads,
+                      jnp.asarray(counts, jnp.float32))
+        return fn(hot_state, slots, grads)
+
+    def _build_hot_push(self, hot_state, access, grad_fields, mean,
+                        with_counts):
+        n_hot = next(iter(hot_state.values())).shape[0]
+        bspec = self.tail._batch_spec()
+        axes = (self.tail.dp_axis, self.axis) if self.tail.dp_axis \
+            else (self.axis,)
+        state_specs = {f: P() for f in hot_state}
+        grad_specs = {f: bspec for f in grad_fields}
+        in_specs = (state_specs, bspec, grad_specs)
+        if with_counts:
+            in_specs += (bspec,)
+
+        @partial(jax.shard_map, mesh=self.mesh, in_specs=in_specs,
+                 out_specs=state_specs, check_vma=False)
+        def _hot(hot_l, slots_l, grads_l, *maybe_counts):
+            valid = (slots_l >= 0) & (slots_l < n_hot)
+            # tail and padding slots scatter out-of-bounds and drop
+            safe = jnp.where(valid, slots_l, n_hot)
+            if with_counts:
+                c = maybe_counts[0] * valid
+            else:
+                c = valid.astype(jnp.float32)
+            acc = {}
+            for f in grad_fields:
+                g = jnp.asarray(grads_l[f])
+                acc[f] = jnp.zeros((n_hot, g.shape[1]), g.dtype).at[
+                    safe].add(g, mode="drop")
+            csum = jnp.zeros((n_hot,), jnp.float32).at[safe].add(
+                c, mode="drop")
+            # the whole reconciliation is this one dense psum: no
+            # routing, no dedup sort — duplicate hot slots summed by the
+            # scatter, cross-device duplicates summed by the reduction
+            acc, csum = jax.lax.psum((acc, csum), axes)
+            if mean:
+                inv = (1.0 / jnp.maximum(csum, 1.0))[:, None]
+                acc = {f: a * inv for f, a in acc.items()}
+            new_fields = access.apply_push(hot_l, acc)
+            out = dict(hot_l)
+            out.update(new_fields)
+            return out
+
+        return _hot

@@ -34,6 +34,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
 
+from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
 from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
 from swiftmpi_tpu.ops import calibration, pallas_gather
 from swiftmpi_tpu.transfer.api import Transfer
@@ -110,6 +111,12 @@ class TpuTransfer(Transfer):
         self.metrics = None              # optional utils.timers.Metrics
         self._overflow_total = 0
         self._overflow_pending: list = []   # eager-path device scalars
+        # optional routed-row accounting (off by default: one extra
+        # reduce per call) — the denominator of the hybrid backend's
+        # "N× fewer cross-shard rows" golden checks
+        self.count_traffic = False
+        self._routed_total = 0
+        self._routed_pending: list = []
         # jitted shard_map closures, keyed by static shape signature —
         # without this every pull/push call would re-trace and recompile.
         self._pull_cache: Dict = {}
@@ -164,6 +171,36 @@ class TpuTransfer(Transfer):
             self.metrics.set("transfer_overflow_dropped", total)
         return total
 
+    # -- traffic accounting ------------------------------------------------
+    def _accum_routed(self, count) -> None:
+        self._routed_total += int(count)
+
+    def _record_routed(self, count) -> None:
+        """Same tracer/eager discipline as :meth:`_record_overflow`."""
+        if isinstance(count, jax.core.Tracer):
+            jax.debug.callback(self._accum_routed, count)
+        else:
+            self._routed_pending.append(count)
+            if len(self._routed_pending) >= 1024:
+                pending, self._routed_pending = self._routed_pending, []
+                self._routed_total += sum(int(c) for c in pending)
+
+    def routed_rows(self) -> int:
+        """Total rows routed through all_to_all bucket routing since
+        construction (counted only while ``count_traffic`` is set)."""
+        jax.effects_barrier()
+        pending, self._routed_pending = self._routed_pending, []
+        self._routed_total += sum(int(c) for c in pending)
+        if self.metrics is not None:
+            self.metrics.set("transfer_routed_rows", self._routed_total)
+        return self._routed_total
+
+    def traffic(self) -> Dict[str, int]:
+        """Per-backend traffic counters in the hybrid-comparable shape."""
+        return {"routed_rows": self.routed_rows(),
+                "hot_rows": 0, "psum_bytes": 0,
+                "overflow_dropped": self.overflow_count()}
+
     def _signature(self, state, slots, grads=None):
         sig = (tuple(sorted((f, v.shape, str(v.dtype))
                             for f, v in state.items())),
@@ -177,6 +214,8 @@ class TpuTransfer(Transfer):
     def pull(self, state, slots, access, fields=None):
         fields = tuple(fields or access.pull_fields)
         slots = jnp.asarray(slots, jnp.int32)
+        if self.count_traffic:
+            self._record_routed(jnp.sum(slots >= 0))
         sig = self._signature(state, slots) + (fields,)
         fn = self._pull_cache.get(sig)
         if fn is None:
@@ -236,21 +275,41 @@ class TpuTransfer(Transfer):
         return _pull
 
     # -- push --------------------------------------------------------------
-    def push(self, state, slots, grads, access, mean=False):
+    def push(self, state, slots, grads, access, mean=False, counts=None):
+        """``counts`` (non-None) marks a position-indexed span family (the
+        stencil wire format): per-row contribution counts ship as a
+        synthetic width-1 grad field through the same bucket routing, so
+        ``mean`` normalization at the owner divides by DATA counts rather
+        than 1-per-request — matching ``XlaTransfer.push_span``."""
         slots = jnp.asarray(slots, jnp.int32)
-        sig = self._signature(state, slots, grads) + (mean,)
+        if self.count_traffic:
+            self._record_routed(jnp.sum(slots >= 0))
+        with_counts = counts is not None
+        if with_counts:
+            grads = dict(grads)
+            grads["__counts__"] = jnp.asarray(
+                counts, jnp.float32).reshape(-1, 1)
+        sig = self._signature(state, slots, grads) + (mean, with_counts)
         fn = self._push_cache.get(sig)
         if fn is None:
             fn = self._push_cache.setdefault(
                 sig, jax.jit(self._build_push(state, access,
-                                              tuple(sorted(grads)), mean)))
+                                              tuple(sorted(grads)), mean,
+                                              with_counts)))
         if self.bucket_capacity is None:
             return fn(state, slots, grads)
         out, ovf = fn(state, slots, grads)
         self._record_overflow("push", ovf)
         return out
 
-    def _build_push(self, state, access, grad_fields, mean=False):
+    def push_span(self, state, slots, grads, counts, access, mean=False):
+        """Sort-free span push (PR-2 stencil wire format) over the same
+        all_to_all routing; see :meth:`push` ``counts``."""
+        return self.push(state, slots, grads, access, mean=mean,
+                         counts=counts)
+
+    def _build_push(self, state, access, grad_fields, mean=False,
+                    with_counts=False):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
         bspec = self._batch_spec()
@@ -289,7 +348,7 @@ class TpuTransfer(Transfer):
                 rows_g = jax.lax.all_gather(
                     safe_rows, self.dp_axis).reshape(-1)
             inv = None
-            if mean:
+            if mean and not with_counts:
                 # contribution counts accumulate at the owning shard from
                 # the received requests themselves — no extra collective
                 if sparse_dcn:
@@ -332,7 +391,15 @@ class TpuTransfer(Transfer):
                         # capacity-sized psum: the right call only at
                         # batch ~ table scale (see strategy note above)
                         acc = jax.lax.psum(acc, self.dp_axis)
-                dense[f] = acc * inv if mean else acc
+                dense[f] = acc
+            if with_counts:
+                # span families: per-row DATA counts rode along as the
+                # synthetic field and summed at the owner like any grad
+                csum = dense.pop("__counts__")
+                if mean:
+                    inv = 1.0 / jnp.maximum(csum[:, :1], 1.0)
+            if mean:
+                dense = {f: a * inv for f, a in dense.items()}
             new_fields = access.apply_push(state_l, dense)
             out = dict(state_l)
             out.update(new_fields)

@@ -1,0 +1,57 @@
+"""JAX version compatibility shims.
+
+The codebase targets the current JAX surface (``jax.shard_map`` with the
+``check_vma`` flag).  Older releases still in circulation (<= 0.4.x) only
+export ``jax.experimental.shard_map.shard_map`` and spell the replication
+check ``check_rep``.  Rather than branching at every one of the ~10
+shard_map call sites, this module installs a top-level ``jax.shard_map``
+alias with the modern keyword when the runtime lacks one.  Imported for its
+side effect by every module that calls ``jax.shard_map`` (all of which
+import jax at module level already), so call sites can assume the modern
+spelling.  NOT imported from ``swiftmpi_tpu.utils.__init__`` — that chain
+must stay jax-free so ``utils.xla_env`` can set XLA flags before backend
+init (test_utils.py::test_xla_env_import_is_jax_free).
+"""
+
+from __future__ import annotations
+
+import jax
+
+
+def _install_shard_map_alias() -> None:
+    try:
+        jax.shard_map  # modern runtime: nothing to do
+        return
+    except AttributeError:
+        pass
+    from jax.experimental.shard_map import shard_map as _legacy
+
+    def shard_map(f=None, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return lambda g: _legacy(g, **kwargs)
+        return _legacy(f, **kwargs)
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size_alias() -> None:
+    if hasattr(jax.lax, "axis_size"):
+        return
+    from jax import core as _core
+
+    def axis_size(axis_name):
+        # legacy axis env: core.axis_frame(name) IS the (static) size
+        if isinstance(axis_name, (tuple, list)):
+            out = 1
+            for a in axis_name:
+                out *= int(_core.axis_frame(a))
+            return out
+        return int(_core.axis_frame(axis_name))
+
+    jax.lax.axis_size = axis_size
+
+
+_install_shard_map_alias()
+_install_axis_size_alias()

@@ -138,6 +138,27 @@ def test_scale_stencil_cell_tiny(tiny_shapes, monkeypatch):
     assert bench._w2v_step_bytes(model, bench.BATCH) is not None
 
 
+def test_scale_hybrid_cell_tiny(tiny_shapes, monkeypatch):
+    """BENCH_ONLY=scale_hybrid's cell: ``transfer=hybrid`` over the
+    stencil+pool rendering at (shrunk) 1M-vocab shape — labels the
+    transfer, reports the replicated head size, and carries the
+    per-step traffic ledger (routed vs hot rows, psum bytes) the cell
+    exists to measure."""
+    monkeypatch.setattr(bench, "W2V_1M_VOCAB", 5000)
+    dev = jax.devices()[0]
+    out = bench._bench_w2v_1m(dev, timed_calls=1, hybrid=True)
+    assert out["rendering"] == "stencil_shared"
+    assert out["transfer"] == "hybrid"
+    assert out["hot_head_rows"] > 0
+    assert out["words_per_sec"] > 0
+    # traffic counters were armed before the jit build, so both the
+    # replicated-head and routed-tail paths recorded real rows
+    assert out["hot_rows_per_step"] > 0
+    assert out["routed_rows_per_step"] > 0
+    assert out["psum_bytes_per_step"] > 0
+    assert out["overflow_dropped"] == 0
+
+
 def test_tfm_odd_head_dim_fails_fast(tiny_shapes, monkeypatch):
     """BENCH_TFM_DMODEL values whose derived head_dim is odd must fail
     up front with a clear message, not crash _rope at trace time after

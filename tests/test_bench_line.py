@@ -405,3 +405,72 @@ def test_roofline_models():
         device_kind = "cpu"
         platform = "cpu"
     assert bench._roofline(Cpu(), 0.01, hbm_bytes=1e9) == {}
+
+
+def test_roofline_mfu_na_when_not_compute_bound():
+    """r5 verdict Next #7: a cell whose MFU rounds below 0.05% of peak
+    (a9a-scale LR) must say "n/a", never render a 0.0 that reads as
+    "not computed" — hbm_pct stays numeric as the ruling metric."""
+
+    class Dev:
+        device_kind = "TPU v5 lite"
+
+    r = bench._roofline(Dev(), 0.06, flops=31.5e6, hbm_bytes=1e9)
+    assert r["mfu_pct"] == "n/a"
+    assert isinstance(r["hbm_pct"], float) and r["hbm_pct"] > 0
+    # a genuinely compute-bound cell keeps the numeric field
+    r2 = bench._roofline(Dev(), 0.052, flops=6.0 * 29.1e6 * 64 * 512)
+    assert isinstance(r2["mfu_pct"], float) and r2["mfu_pct"] > 0
+
+
+def test_same_mode_sg_shared_comparator(monkeypatch, tmp_path, capsys):
+    """r5 verdict Next #4: with a same-mode CPU twin (reduced batch,
+    stated), the sg_shared cell gets a real vs_baseline plus the CPU
+    shape beside it and the labeled vs_cpu_sg fallback stops firing;
+    a rendering mismatch between the children is NAMED in the field,
+    never rendered as a bare vs_baseline."""
+    monkeypatch.setattr(bench, "CACHE_DIR", str(tmp_path))
+    monkeypatch.setattr(bench, "FULL_REPORT_PATH",
+                        str(tmp_path / "BENCH_REPORT.json"))
+    for var in bench._SHAPE_ENV:
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setattr(bench, "_tpu_alive", lambda *a, **k: True)
+    tpu = _fat_chip_result()
+    tpu["w2v_sg_shared"]["batch"] = 16384
+    cpu = {"platform": "cpu", "device": "TFRT_CPU_0",
+           "w2v": {"words_per_sec": 112000.0, "rendering": "gather"},
+           "w2v_sg": {"words_per_sec": 13585.9},
+           "w2v_sg_shared": {"words_per_sec": 9500.0, "batch": 2048,
+                             "rendering": "sg_shared"},
+           # rendering mismatch: the chip lr resolved dense, this run's
+           # CPU lr sparse — must be named, not passed as vs_baseline
+           "lr": {"rows_per_sec": 11544900.0, "rendering": "sparse"},
+           "cpp_oracle": {"words_per_sec": 120000.0}}
+    monkeypatch.setattr(
+        bench, "_run_child",
+        lambda which, t, extra_env=None: (
+            dict(tpu) if which == "tpu" else dict(cpu), None, 1.0))
+    bench.parent_main()
+    capsys.readouterr()
+    full = json.load(open(str(tmp_path / "BENCH_REPORT.json")))
+    sgs = full["secondary"]["w2v_sg_shared"]
+    assert sgs["vs_baseline"] == round(1250000.0 / 9500.0, 2)
+    assert sgs["cpu_batch"] == 2048
+    assert "vs_cpu_sg" not in sgs
+    lr = full["secondary"]["lr_a9a"]
+    assert "vs_baseline" not in lr
+    assert lr["vs_cpu_sparse"] == round(3000676.0650775912 / 11544900.0, 2)
+
+
+def test_stale_same_mode_sg_shared(monkeypatch, tmp_path, capsys):
+    """Degraded path twin of the same-mode rule: a cached sg_shared
+    chip cell paired against this run's reduced-batch CPU twin yields
+    vs_baseline_stale + the stated CPU batch, not vs_cpu_sg_stale."""
+    _degraded_line(monkeypatch, tmp_path, capsys, cpu_extra={
+        "w2v_sg_shared": {"words_per_sec": 9500.0, "batch": 2048,
+                          "rendering": "sg_shared"}})
+    full = json.load(open(str(tmp_path / "BENCH_REPORT.json")))
+    sgs = full["secondary"]["w2v_sg_shared"]
+    assert sgs["vs_baseline_stale"] == round(1250000.0 / 9500.0, 2)
+    assert sgs["cpu_batch"] == 2048
+    assert "vs_cpu_sg_stale" not in sgs

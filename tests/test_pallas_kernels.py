@@ -311,3 +311,39 @@ def test_calibration_clear_removes_only_named_kernel(monkeypatch,
     assert calibration.lookup("vmem_scatter", "TPU v5 lite")["win"]
     calibration.clear("nonexistent")          # no-op, no crash
     calibration.reset_cache()
+
+
+def test_pallas_status_marker(monkeypatch, tmp_path):
+    """r5 verdict Next #6: with no measured on-chip A/B verdict for a
+    device key, pallas_status says `unvalidated-on-tpu` explicitly; a
+    recorded lowering error is an attempt, not a validation; only a
+    measured pallas_ms/xla_ms pair flips the status to validated."""
+    from swiftmpi_tpu.ops import calibration
+
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(tmp_path / "calib.json"))
+    calibration.reset_cache()
+    assert calibration.pallas_status("TPU v5 lite") == "unvalidated-on-tpu"
+    # a bare win flag without the measured A/B pair does not validate
+    calibration.record("vmem_gather", "TPU v5 lite", {"win": True})
+    assert calibration.pallas_status(
+        "TPU v5 lite") == "unvalidated-on-tpu"
+    # a lowering failure: attempted, named, still unvalidated
+    calibration.record("vmem_scatter", "TPU v5 lite",
+                       {"win": False, "error": "remote compile 500",
+                        "xla_ms": 5.0})
+    st = calibration.pallas_status("TPU v5 lite")
+    assert st.startswith("unvalidated-on-tpu (attempted")
+    assert "vmem_scatter" in st
+    # a measured no-win A/B validates (the capability question has a
+    # measured answer, even if the answer is "XLA rules")
+    calibration.record("vmem_gather", "TPU v5 lite",
+                       {"win": False, "pallas_ms": 6.0, "xla_ms": 5.0})
+    assert calibration.pallas_status("TPU v5 lite") == "validated: no-win"
+    # a measured win names the winning kernel
+    calibration.record("replica_scatter", "TPU v5 lite",
+                       {"win": True, "pallas_ms": 1.0, "xla_ms": 5.0})
+    assert calibration.pallas_status(
+        "TPU v5 lite") == "validated: win (replica_scatter)"
+    # other device kinds stay independently unvalidated
+    assert calibration.pallas_status("TPU v4") == "unvalidated-on-tpu"
+    calibration.reset_cache()
