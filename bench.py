@@ -483,7 +483,8 @@ def _bench_s2v(device, timed_calls, model):
 W2V_1M_VOCAB = 1_000_000
 
 
-def build_w2v_1m_model(device, stencil=False, hybrid=False):
+def build_w2v_1m_model(device, stencil=False, hybrid=False,
+                       window_steps=1):
     """The 1M-vocab cell's model (BASELINE config #3 shape: demo.conf
     hyperparameters over a ~1M-word Zipf vocabulary / 1.3M-row table).
     ONE builder shared by the bench cell and the profiler ablation
@@ -502,7 +503,12 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False):
     hash-sharded (transfer/hybrid.py).  The BENCH_ONLY=scale_hybrid
     cell's shape; its traffic counters (routed/hot rows, psum bytes)
     ride in the cell so the artifact shows the placement win next to
-    the throughput."""
+    the throughput.
+
+    ``window_steps=W``: window-coalesced push ([cluster] push_window) —
+    W fused steps accumulate their pushes and exchange ONCE through the
+    density-adaptive wire format.  The BENCH_ONLY=scale_window cell's
+    shape (window over the hybrid stencil+pool rendering)."""
     import jax
     import numpy as np
     from swiftmpi_tpu.cluster.cluster import Cluster
@@ -517,7 +523,9 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False):
                   counts=counts, index={})
     cfg = ConfigParser().update({
         "cluster": {"transfer": "hybrid" if hybrid else "xla",
-                    "server_num": 1},
+                    "server_num": 1,
+                    **({"push_window": int(window_steps)}
+                       if window_steps > 1 else {})},
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
                      "sample": -1, "learning_rate": 0.05,
                      # BENCH_SCALE_SHARED=1: the batch-shared negative
@@ -551,7 +559,8 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False):
     return model, rng
 
 
-def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False):
+def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
+                  window_steps=1):
     """BASELINE config #3 shape: the same fused step over a ~1M-word
     vocabulary (1.3M-row table).  Batches are synthesized directly in
     vocab-index space (uniform centers/contexts, Zipf counts for the
@@ -566,11 +575,13 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False):
     import jax.numpy as jnp
 
     V = W2V_1M_VOCAB
-    model, rng = build_w2v_1m_model(device, stencil=stencil, hybrid=hybrid)
-    if hybrid:
+    model, rng = build_w2v_1m_model(device, stencil=stencil, hybrid=hybrid,
+                                    window_steps=window_steps)
+    if hybrid or window_steps > 1:
         # arm the traffic counters BEFORE the jit build: the per-step
-        # routed/hot row counts are recorded by callbacks traced into
-        # the compiled program (transfer/hybrid.py)
+        # routed/hot row counts — and the window wire ledger (bytes,
+        # dispatches, sparse/dense decisions) — are recorded by
+        # callbacks traced into the compiled program (transfer/)
         model.transfer.count_traffic = True
     with jax.default_device(device):
         step = model._build_multi_step(INNER_STEPS)
@@ -623,6 +634,28 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False):
         out["hot_rows_per_step"] = round(tr["hot_rows"] / steps, 1)
         out["psum_bytes_per_step"] = round(tr["psum_bytes"] / steps, 1)
         out["overflow_dropped"] = tr["overflow_dropped"]
+        out["wire_bytes_per_step"] = round(tr.get("wire_bytes", 0) / steps,
+                                           1)
+        out["dispatches_per_step"] = round(tr.get("dispatches", 0) / steps,
+                                           3)
+    if window_steps > 1:
+        out["push_window"] = int(window_steps)
+        tr = model.transfer.traffic()
+        steps = max((WARMUP_CALLS + timed_calls) * INNER_STEPS, 1)
+        windows = max(steps // window_steps, 1)
+        # the acceptance ratio the window cell exists to report: push
+        # exchanges per coalescing window (per-step cells sit at one
+        # dispatch per push family per step, i.e. W× this)
+        out["dispatches_per_window"] = round(tr["dispatches"] / windows, 3)
+        out["wire_bytes_per_step"] = round(tr["wire_bytes"] / steps, 1)
+        out["window_sparse"] = tr["window_sparse"]
+        out["window_dense"] = tr["window_dense"]
+        out["coalesced_rows_in"] = tr["coalesced_rows_in"]
+        out["coalesced_rows_out"] = tr["coalesced_rows_out"]
+        if tr["coalesced_rows_in"]:
+            out["coalesce_ratio"] = round(
+                tr["coalesced_rows_in"] / max(tr["coalesced_rows_out"], 1),
+                2)
     out.update(_roofline(device, dt / (timed_calls * INNER_STEPS),
                          hbm_bytes=_w2v_step_bytes(model, B)))
     return out
@@ -1239,6 +1272,20 @@ def child_main(which: str) -> None:
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
+    if os.environ.get("BENCH_ONLY") == "scale_window":
+        # window-coalesced push at 1M vocab over the hybrid stencil+pool
+        # rendering: one density-adaptive exchange per BENCH_WINDOW
+        # (default: the whole fused group) steps instead of one per
+        # step.  Own child + own key — identical declared rendering to
+        # w2v_1m_hybrid, so the wire_bytes / dispatches deltas between
+        # the two cells are the coalescing win, not a shape change
+        win = int(os.environ.get("BENCH_WINDOW", INNER_STEPS))
+        out["w2v_1m_window"] = _bench_w2v_1m(device, max(timed // 2, 1),
+                                             hybrid=True,
+                                             window_steps=win)
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
     # emit after EVERY bench so a timeout/crash in a later (secondary)
     # bench never discards an already-measured number — the parent takes
     # the last BENCH_CHILD line it can find
@@ -1623,6 +1670,7 @@ _SECONDARY_CELLS = (
     ("w2v_1m_vocab", "w2v_1m", "words_per_sec", "words/s"),
     ("w2v_1m_stencil", "w2v_1m_stencil", "words_per_sec", "words/s"),
     ("w2v_1m_hybrid", "w2v_1m_hybrid", "words_per_sec", "words/s"),
+    ("w2v_1m_window", "w2v_1m_window", "words_per_sec", "words/s"),
     ("w2v_text8_epoch_wall", "w2v_text8", "epoch_wall_s", "s"),
     ("w2v_100m_epoch_wall", "w2v_100m", "epoch_wall_s", "s"),
     ("transformer_lm", "tfm", "tokens_per_sec", "tokens/s"),

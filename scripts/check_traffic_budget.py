@@ -1,0 +1,130 @@
+#!/usr/bin/env python
+"""Traffic-budget regression gate over two bench JSONs.
+
+The window-coalesced push exists to cut wire traffic; this script makes
+that a *checked* property instead of a one-time measurement.  It reads
+two bench result files (the ``.bench_cache/tpu_*.json`` shape:
+``{"ts": ..., "result": {cell: {metric: value}}}``), lines up every
+cell present in both, and fails when a traffic metric regressed beyond
+tolerance:
+
+    python scripts/check_traffic_budget.py baseline.json candidate.json
+    python scripts/check_traffic_budget.py base.json cand.json \
+        --tolerance 0.05 --cells w2v_1m_window,w2v_1m_hybrid
+
+Traffic metrics are lower-is-better wire/dispatch counters
+(``wire_bytes_per_step``, ``dispatches_per_step``,
+``dispatches_per_window``); cells without them (pure throughput cells)
+are skipped.  Exit codes: 0 within budget, 1 regression, 2 usage /
+unreadable input.  ``scripts/run_tier1.sh`` runs this advisorily when
+``BENCH_BASELINE``/``BENCH_CANDIDATE`` point at files — the tier-1
+verdict stays pytest's, but the regression is printed next to it.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+#: lower-is-better counters the budget covers, with the detail fields
+#: printed for context when a covered cell is reported
+TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
+                   "dispatches_per_window")
+DETAIL_METRICS = ("window_sparse", "window_dense", "coalesce_ratio",
+                  "push_window")
+
+
+def load_cells(path: str) -> dict:
+    try:
+        with open(path) as f:
+            doc = json.load(f)
+    except (OSError, ValueError) as e:
+        print(f"check_traffic_budget: cannot read {path}: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    cells = doc.get("result", doc)
+    if not isinstance(cells, dict):
+        print(f"check_traffic_budget: {path} has no result cells",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return {c: m for c, m in cells.items() if isinstance(m, dict)}
+
+
+def compare(base: dict, cand: dict, tolerance: float,
+            only_cells=None) -> list:
+    """Return [(cell, metric, base, cand, rel_change)] regressions."""
+    regressions = []
+    for cell in sorted(set(base) & set(cand)):
+        if only_cells and cell not in only_cells:
+            continue
+        for metric in TRAFFIC_METRICS:
+            b, c = base[cell].get(metric), cand[cell].get(metric)
+            if b is None or c is None:
+                continue
+            b, c = float(b), float(c)
+            if b <= 0:
+                continue
+            rel = (c - b) / b
+            if rel > tolerance:
+                regressions.append((cell, metric, b, c, rel))
+    return regressions
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="fail when bench traffic counters regressed")
+    ap.add_argument("baseline", help="baseline bench JSON")
+    ap.add_argument("candidate", help="candidate bench JSON")
+    ap.add_argument("--tolerance", type=float, default=0.10,
+                    help="allowed relative increase (default 0.10)")
+    ap.add_argument("--cells", default=None,
+                    help="comma-separated cell allowlist (default: every "
+                         "cell present in both files)")
+    args = ap.parse_args(argv)
+
+    base = load_cells(args.baseline)
+    cand = load_cells(args.candidate)
+    only = set(args.cells.split(",")) if args.cells else None
+    if only:
+        missing = sorted(only - (set(base) & set(cand)))
+        if missing:
+            print("check_traffic_budget: requested cells absent from "
+                  "one side: " + ", ".join(missing), file=sys.stderr)
+            return 2
+
+    covered = 0
+    for cell in sorted(set(base) & set(cand)):
+        if only and cell not in only:
+            continue
+        metrics = [m for m in TRAFFIC_METRICS
+                   if m in base[cell] and m in cand[cell]]
+        if not metrics:
+            continue
+        covered += 1
+        for m in metrics:
+            b, c = float(base[cell][m]), float(cand[cell][m])
+            rel = (c - b) / b if b else 0.0
+            print(f"  {cell}.{m}: {b:g} -> {c:g} ({rel:+.1%})")
+        details = {m: cand[cell][m] for m in DETAIL_METRICS
+                   if m in cand[cell]}
+        if details:
+            print(f"    detail: {details}")
+    if covered == 0:
+        print("check_traffic_budget: no cells with traffic counters in "
+              "both files — nothing to check")
+        return 0
+
+    regressions = compare(base, cand, args.tolerance, only)
+    if regressions:
+        print(f"TRAFFIC BUDGET EXCEEDED (tolerance {args.tolerance:.0%}):")
+        for cell, metric, b, c, rel in regressions:
+            print(f"  {cell}.{metric}: {b:g} -> {c:g} ({rel:+.1%})")
+        return 1
+    print(f"traffic budget OK: {covered} cell(s) within "
+          f"{args.tolerance:.0%}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -87,6 +87,35 @@ class HashFrag:
         return f"HashFrag(shards={self.num_shards}, frags={self.num_frags})"
 
 
+def shard_load_histogram(hashfrag: HashFrag, keys,
+                         weights=None) -> np.ndarray:
+    """Per-shard request load for a key stream: how many of ``keys``
+    (optionally weighted, e.g. by frequency counts) each shard owns.
+    The window-coalesced push uses this to sanity-check that the static
+    per-window wire-format decision (key_index.window_wire_format) is
+    not skewed by a pathological shard imbalance — the crossover assumes
+    requests spread roughly evenly over the routing blocks."""
+    shards = hashfrag.to_shard_id(keys)
+    w = None if weights is None else np.asarray(weights, np.float64)
+    return np.bincount(shards, weights=w, minlength=hashfrag.num_shards)
+
+
+def expected_unique_rows(counts, rows: int) -> float:
+    """Expected number of UNIQUE keys among ``rows`` draws from the
+    frequency histogram ``counts`` — the post-dedup wire rows of one
+    coalesced window: E[U] = sum_k 1 - (1 - p_k)^rows.  Zipf streams
+    saturate far below ``rows`` (the head repeats in nearly every step
+    of a window), which is exactly the regime where coalescing pays."""
+    c = np.asarray(counts, np.float64).ravel()
+    total = c.sum()
+    if total <= 0 or rows <= 0:
+        return 0.0
+    p = c / total
+    # log1p formulation: (1-p)^rows underflows for the Zipf head where
+    # p ~ 1e-1 and rows ~ 1e5 — exp(rows*log1p(-p)) flushes to 0 exactly
+    return float(np.sum(-np.expm1(rows * np.log1p(-np.minimum(p, 1.0)))))
+
+
 def split_route(hashfrag: HashFrag, partition, keys):
     """Hybrid hot/cold routing: resolve each key to EITHER a hot slot
     (replicated head, no shard owner) OR its hash-owned shard.

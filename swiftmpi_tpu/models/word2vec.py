@@ -227,6 +227,15 @@ class Word2Vec:
         # lax.scan (amortizes per-dispatch latency, ~5ms through the
         # tunnel).  Default 1 = exactly one dispatch per batch.
         self.inner_steps = g("worker", "inner_steps", 1).to_int32()
+        # [cluster] push_window: coalesce W consecutive steps' pushes
+        # into ONE exchange per push family (transfer.push_window).
+        # Gradients inside a window are computed against window-start
+        # state, so staleness is bounded by W-1 steps; W=1 (default)
+        # keeps the per-step path bit-identically.  Only meaningful on
+        # the fused (inner_steps > 1) sync path.
+        self.push_window_size = g("cluster", "push_window", 1).to_int32()
+        if self.push_window_size < 1:
+            raise ValueError("[cluster] push_window must be >= 1")
         self.local_steps = g("word2vec", "local_steps", 1).to_int32()
         # "" /"snapshot" (bounded-staleness via local_steps) / "hogwild"
         # (genuinely unsynchronized per-device replicas, see
@@ -295,6 +304,15 @@ class Word2Vec:
                 "w2v", self.access, cap, partition=partition)
         slots = self.table.key_index.lookup(self.vocab.keys)
         self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
+        if self.push_window_size > 1 and hasattr(
+                self.transfer, "window_expected_unique"):
+            # sharpen the per-window sparse/dense wire-format crossover
+            # with the Zipf-aware expected unique-row count of a window's
+            # worth of token draws (cluster.hashfrag.expected_unique_rows)
+            from swiftmpi_tpu.cluster.hashfrag import expected_unique_rows
+            self.transfer.window_expected_unique = expected_unique_rows(
+                self.vocab.counts,
+                self.push_window_size * self.minibatch)
         prob, alias = build_unigram_alias(self.vocab.counts)
         self._alias_prob = jnp.asarray(prob)
         self._alias_idx = jnp.asarray(alias)
@@ -361,6 +379,8 @@ class Word2Vec:
         one fused step executes in ~0.1ms, comparable to dispatch).
         Batches arrive stacked on a leading (n_inner, ...) axis."""
         grads_fn = self._build_grads()
+        if self.push_window_size > 1:
+            return self._build_multi_step_windowed(n_inner, grads_fn)
         apply_fn = self._build_apply()
 
         if self.stencil:
@@ -398,6 +418,95 @@ class Word2Vec:
             return state, es.sum(), ec.sum()
 
         return multi
+
+    def _build_multi_step_windowed(self, n_inner: int, grads_fn):
+        """Window-coalesced fused scan ([cluster] push_window = W > 1):
+        steps inside a window compute gradients against the FROZEN
+        window-start state (scan carries it unchanged) and stack their
+        PushSpecs as scan outputs; the window then applies each push
+        family with ONE ``transfer.push_window`` exchange.  A Python loop
+        walks the ceil(n_inner / W) windows inside the same jit, so the
+        dispatch count per fused group is unchanged while collective
+        dispatches drop ~W-fold.  Staleness is bounded by W-1 steps (see
+        docs/ARCHITECTURE.md "Window-coalesced push")."""
+        W = self.push_window_size
+        apply_window = self._build_apply_window()
+        bounds = [(s, min(s + W, n_inner)) for s in range(0, n_inner, W)]
+        mesh = getattr(self.cluster, "mesh", None)
+        replicated = (jax.sharding.NamedSharding(
+            mesh, jax.sharding.PartitionSpec()) if mesh is not None else None)
+
+        def run_windows(state, statics, keys, xs_all):
+            es_tot, ec_tot = jnp.float32(0), jnp.float32(0)
+            for s, e in bounds:
+                xs = tuple(x[s:e] for x in xs_all) + (keys[s:e],)
+
+                def body(carry, x):
+                    # carry is the window-start state, returned untouched:
+                    # every step in the window sees the same snapshot
+                    pushes, es, ec = grads_fn(carry, *statics, *x)
+                    return carry, (pushes, es, ec)
+
+                _, (pushes_s, es, ec) = jax.lax.scan(body, state, xs)
+                if replicated is not None:
+                    # the stacked (W, ...) push buffers must stay
+                    # replicated: letting GSPMD infer a sharding for them
+                    # from the row-sharded scatter consumer miscompiles
+                    # the partitioned scatter (wrong sums on the emulated
+                    # mesh) — pin them before the window apply
+                    pushes_s = jax.tree_util.tree_map(
+                        lambda x: jax.lax.with_sharding_constraint(
+                            x, replicated), pushes_s)
+                state = apply_window(state, pushes_s)
+                es_tot += es.sum()
+                ec_tot += ec.sum()
+            return state, es_tot, ec_tot
+
+        if self.stencil:
+            @partial(jax.jit, donate_argnums=0)
+            def multi_st(state, slot_of_vocab, alias_prob, alias_idx,
+                         tokens_s, sids_s, cpos_s, half_s, key):
+                keys = jax.random.split(key, n_inner)
+                return run_windows(state,
+                                   (slot_of_vocab, alias_prob, alias_idx),
+                                   keys, (tokens_s, sids_s, cpos_s, half_s))
+
+            return multi_st
+
+        @partial(jax.jit, donate_argnums=0)
+        def multi(state, slot_of_vocab, alias_prob, alias_idx,
+                  centers_s, contexts_s, masks_s, key):
+            keys = jax.random.split(key, n_inner)
+            return run_windows(state,
+                               (slot_of_vocab, alias_prob, alias_idx),
+                               keys, (centers_s, contexts_s, masks_s))
+
+        return multi
+
+    def _build_apply_window(self):
+        """Window analogue of :meth:`_build_apply`: each stacked (W, ...)
+        PushSpec family goes through ONE ``transfer.push_window`` call.
+        Dense (capacity-shaped) specs have no deferred-window semantics —
+        their grads are already normalized against live state — so
+        dense_logits mode is rejected at trace time rather than silently
+        de-coalesced."""
+        access = self.access
+        transfer = self.transfer
+
+        def apply_window(state, pushes):
+            for spec in pushes:
+                if getattr(spec, "dense", False):
+                    raise ValueError(
+                        "[cluster] push_window > 1 cannot coalesce dense "
+                        "(capacity-shaped) pushes — disable [word2vec] "
+                        "dense_logits or set push_window: 1")
+                state = transfer.push_window(
+                    state, spec.slots, spec.grads, access,
+                    mean=spec.mean,
+                    counts=getattr(spec, "counts", None))
+            return state
+
+        return apply_window
 
     def _build_hogwild_step(self, n_inner: int):
         """Genuinely unsynchronized async SGD — the TPU rendering of the

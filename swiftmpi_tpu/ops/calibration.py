@@ -175,6 +175,28 @@ def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
 # this list so a new kernel cannot silently count as validated
 _PALLAS_KERNELS = ("vmem_gather", "vmem_scatter", "replica_scatter")
 
+#: pseudo device-kind for interpret-mode (off-chip) oracle runs — a
+#: correctness exercise, never a performance verdict
+INTERPRET_KIND = "interpret"
+
+
+def record_interpret(name: str, correct: bool, shape: str = None,
+                     extra: dict = None) -> dict:
+    """Record an interpret-mode numpy-oracle exercise for a kernel.
+
+    This is the off-chip half of the validation story: it proves the
+    kernel's *semantics* (against a host oracle, interpret=True) without
+    touching a chip, so ``pallas_status`` can distinguish "never
+    exercised" from "exercised off-chip, awaiting on-chip A/B".  It
+    carries no timing and can never flip a ``gated()`` decision — the
+    gate only consults the real device kind."""
+    verdict = {"correct": bool(correct), "interpret": True}
+    if shape:
+        verdict["shape"] = shape
+    verdict.update(extra or {})
+    record(name, INTERPRET_KIND, verdict)
+    return verdict
+
 
 def pallas_status(kind: Optional[str] = None) -> str:
     """One-line Pallas validation status for a device kind (r5 verdict
@@ -182,7 +204,9 @@ def pallas_status(kind: Optional[str] = None) -> str:
     measured on-chip A/B verdict (pallas_ms vs xla_ms) exists for the
     key — until then bench/calibration output must carry the explicit
     ``unvalidated-on-tpu`` marker instead of implying the capability.
-    A recorded lowering *error* is an attempt, not a validation."""
+    A recorded lowering *error* is an attempt, not a validation, and an
+    interpret-mode oracle pass (``record_interpret``) upgrades the
+    marker to "exercised off-chip" without clearing it."""
     if kind is None:
         kind = device_key()
     verdicts = {n: lookup(n, kind) for n in _PALLAS_KERNELS}
@@ -193,6 +217,12 @@ def pallas_status(kind: Optional[str] = None) -> str:
         if errs:
             return ("unvalidated-on-tpu (attempted, lowering failed: "
                     + ", ".join(errs) + ")")
+        interp = sorted(
+            n for n in _PALLAS_KERNELS
+            if (lookup(n, INTERPRET_KIND) or {}).get("correct"))
+        if interp:
+            return ("unvalidated-on-tpu (exercised off-chip, "
+                    "interpret-mode correct: " + ", ".join(interp) + ")")
         return "unvalidated-on-tpu"
     wins = sorted(n for n, v in measured.items() if v.get("win"))
     if wins:

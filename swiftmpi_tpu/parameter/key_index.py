@@ -90,6 +90,32 @@ def calibrate_hot_k(counts, mass_lo: float = 0.5, mass_hi: float = 0.8,
     return k, float(cdf[k - 1])
 
 
+def window_wire_format(rows: int, capacity: int, row_bytes: int,
+                       dense_ratio: float = 2.0,
+                       expected_unique: Optional[float] = None) -> str:
+    """Sparse-vs-dense wire format for one coalesced push window.
+
+    The same crossover rule :func:`calibrate_hot_k` applies to placement
+    ("dense once sparse volume passes half the dense size", SparCML
+    arXiv:1802.08021), applied per-window to the exchange representation:
+
+      sparse volume = rows_on_wire x (4-byte index + row_bytes)
+      dense volume  = capacity x row_bytes
+
+    and the window densifies when ``sparse >= dense / dense_ratio``.
+    ``rows`` is the window's flattened request count; ``expected_unique``
+    (when the caller has a frequency histogram — see
+    ``cluster.hashfrag.expected_unique_rows``) caps it at the rows the
+    pre-exchange dedup will actually leave on the wire.  The decision is
+    host-static so the compiled window program bakes in one format."""
+    eff = float(min(rows, capacity))
+    if expected_unique is not None:
+        eff = min(eff, float(expected_unique))
+    sparse_vol = eff * (4.0 + row_bytes)
+    dense_vol = float(capacity) * row_bytes
+    return "dense" if sparse_vol * dense_ratio >= dense_vol else "sparse"
+
+
 class HotColdPartition:
     """Frequency split of the key space: hot head vs sharded cold tail.
 

@@ -20,6 +20,23 @@ The table *state* is a plain ``{field: jax.Array}`` dict — a pytree that
 training steps close over, donate, and return updated; the ``SparseTable``
 object is the host-side handle (spec, mesh placement, key index).
 
+Window-coalesced updates and the AdaGrad accumulator: with ``[cluster]
+push_window: W`` the transfer layer sums a window's W per-step gradient
+batches into ONE push, so the access rule — including the ``*2sum``
+AdaGrad accumulator rows this table stores — runs once per unique row
+per window instead of once per step.  At ``W == 1`` the coalesced push
+is the flatten of a unit axis and the update is bit-identical to the
+per-step path.  At ``W > 1`` two bounded deviations apply: (a) steps
+inside a window read the window-start snapshot, so a row's gradient can
+be up to W-1 steps stale, and (b) the accumulator advances once with
+``(Σg)²`` instead of W times with ``Σ(g²)`` — by Cauchy-Schwarz
+``(Σg)² ≤ W·Σg²``, so one window adds at most W× a step's mass when the
+window's gradients align, and as little as 0 when they cancel: the
+effective AdaGrad step size drifts within a factor-of-√W band of the
+per-step trajectory.  Both effects vanish as W→1 and are characterized in
+docs/ARCHITECTURE.md "Window-coalesced push"; parity tests pin the
+envelope in tests/test_window_push.py.
+
 Hybrid hot/cold placement: when the KeyIndex carries a
 ``HotColdPartition``, each field ``f`` splits into a row-sharded tail array
 under its plain name (indexed by ``slot - n_hot``) and a REPLICATED hot

@@ -46,13 +46,30 @@ serialization, word2vec.h:120-132) stays the caller's job via
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Dict, Optional
 
 import jax
+import jax.numpy as jnp
+import numpy as np
 
 from swiftmpi_tpu.parameter.access import AccessMethod
 from swiftmpi_tpu.parameter.sparse_table import TableState
 from swiftmpi_tpu.utils.config import ConfigParser
+
+
+def grad_row_bytes(grads, with_index: bool = True,
+                   with_counts: bool = False) -> int:
+    """Wire bytes per pushed row: the grad fields' widths at their dtypes,
+    plus an int32 index in the sparse representation and an f32 counts
+    column when a span family ships data counts.  One shared formula so
+    every backend's ``wire_bytes`` counter measures the same thing."""
+    total = 4 if with_index else 0
+    for g in grads.values():
+        g = jnp.asarray(g)
+        total += int(np.dtype(g.dtype).itemsize) * int(g.shape[-1])
+    if with_counts:
+        total += 4
+    return total
 
 
 @jax.tree_util.register_pytree_node_class
@@ -103,6 +120,96 @@ class Transfer:
 
     name: str = "?"
 
+    # -- wire traffic ledger (shared by every backend) ---------------------
+    # ``wire_bytes`` counts push-side exchange PAYLOAD bytes (sparse:
+    # valid rows x grad_row_bytes; dense: capacity x row bytes) and
+    # ``dispatches`` the number of push-side exchanges — pulls are not
+    # counted, so a window that coalesces W pushes into one exchange
+    # shows a W-fold dispatch drop regardless of the pull schedule.
+    # Counting is off until ``count_traffic`` is set (one extra reduce
+    # per push otherwise).  The counts are data-dependent under jit, so
+    # the same tracer/eager discipline as the tpu backend's overflow
+    # counter applies: traced values are staged via jax.debug.callback
+    # (fires per compiled execution), eager device scalars queue and
+    # materialize in :meth:`traffic`.
+
+    def _wire_state(self) -> dict:
+        st = self.__dict__.get("_wire_ledger")
+        if st is None:
+            st = self.__dict__["_wire_ledger"] = {
+                "wire_bytes": 0, "dispatches": 0,
+                "window_sparse": 0, "window_dense": 0,
+                "coalesced_rows_in": 0, "coalesced_rows_out": 0,
+                "pending": []}
+        return st
+
+    def _accum_wire(self, row_bytes, rows, ndisp: int = 1,
+                    decision: Optional[str] = None) -> None:
+        st = self._wire_state()
+        st["wire_bytes"] += int(rows) * int(row_bytes)
+        st["dispatches"] += ndisp
+        if decision:
+            st["window_" + decision] += 1
+
+    def _record_exchange(self, rows, row_bytes: int,
+                         decision: Optional[str] = None) -> None:
+        """Record one push exchange of ``rows`` (traced or eager count)
+        at ``row_bytes`` per row."""
+        if not getattr(self, "count_traffic", False):
+            return
+        from functools import partial
+        cb = partial(self._accum_wire, int(row_bytes), decision=decision)
+        if isinstance(rows, jax.core.Tracer):
+            jax.debug.callback(cb, rows)
+        else:
+            st = self._wire_state()
+            st["pending"].append((int(row_bytes), rows, decision))
+            if len(st["pending"]) >= 1024:
+                pending, st["pending"] = st["pending"], []
+                for rb, r, d in pending:
+                    self._accum_wire(rb, r, decision=d)
+
+    def _accum_coalesce(self, decision, rows_in, rows_out) -> None:
+        st = self._wire_state()
+        st["coalesced_rows_in"] += int(rows_in)
+        st["coalesced_rows_out"] += int(rows_out)
+        if decision:
+            st["window_" + decision] += 1
+
+    def _record_coalesce(self, rows_in, rows_out,
+                         decision: Optional[str] = None) -> None:
+        """Record one window's pre-exchange dedup (rows before/after) and
+        its wire-format decision; fires per compiled execution under an
+        outer trace, same discipline as :meth:`_record_exchange`."""
+        if not getattr(self, "count_traffic", False):
+            return
+        from functools import partial
+        cb = partial(self._accum_coalesce, decision)
+        if isinstance(rows_in, jax.core.Tracer) \
+                or isinstance(rows_out, jax.core.Tracer):
+            jax.debug.callback(cb, rows_in, rows_out)
+        else:
+            self._accum_coalesce(decision, rows_in, rows_out)
+
+    def wire_traffic(self) -> Dict[str, int]:
+        """Cumulative wire ledger (flushes traced callbacks and queued
+        eager scalars): ``wire_bytes``, ``dispatches``, and the window
+        path's ``window_sparse``/``window_dense`` decision counts plus
+        ``coalesced_rows_in``/``coalesced_rows_out`` (rows before/after
+        the per-window dedup)."""
+        jax.effects_barrier()
+        st = self._wire_state()
+        pending, st["pending"] = st["pending"], []
+        for rb, r, d in pending:
+            self._accum_wire(rb, r, decision=d)
+        return {k: v for k, v in st.items() if k != "pending"}
+
+    def traffic(self) -> Dict[str, int]:
+        """Cumulative traffic counters; every backend reports at least
+        the wire ledger so cross-backend goldens compare like with
+        like.  Backends with routed/hot paths extend this dict."""
+        return self.wire_traffic()
+
     def pull(self, state: TableState, slots, access: AccessMethod,
              fields=None) -> TableState:
         """Gather rows for ``slots``.  ``fields`` restricts the pull to a
@@ -125,6 +232,37 @@ class Transfer:
         w2v step, docs/ARCHITECTURE.md), and matches the reference's
         sum-then-divide order of operations bit-for-bit."""
         raise NotImplementedError
+
+    def push_window(self, state: TableState, slots, grads: TableState,
+                    access: AccessMethod, mean: bool = False,
+                    counts=None) -> TableState:
+        """Window-coalesced push: ``slots`` is ``(W, B)``, ``grads``
+        ``{f: (W, B, d)}``, ``counts`` (optional) ``(W, B)`` — W steps'
+        pushes accumulated into one buffer and exchanged ONCE.
+
+        Semantics are push's sum-then-apply-once rule extended across
+        the window: every (step, position) contribution to a key is
+        summed, ``mean=True`` divides by the TOTAL window contribution
+        count, and the access rule runs once per unique row per window.
+        At ``W == 1`` this is the flatten of a unit axis followed by the
+        per-step ``push``/``push_span`` — bit-identical to the per-step
+        path by construction, so every existing parity oracle applies.
+        At ``W > 1`` the update differs from W sequential applies by the
+        optimizer's window staleness (bounded by W-1 steps; envelope
+        documented in docs/ARCHITECTURE.md "Window-coalesced push").
+
+        The base implementation flattens and delegates; the tpu/hybrid
+        backends override with a density-adaptive wire format (dedup +
+        sparse all_to_all below the crossover, dense psum above)."""
+        slots = jnp.asarray(slots)
+        flat = slots.reshape(-1)
+        fgrads = {f: jnp.asarray(g).reshape((-1,) + jnp.asarray(g).shape[2:])
+                  for f, g in grads.items()}
+        if counts is not None:
+            return self.push_span(state, flat, fgrads,
+                                  jnp.asarray(counts).reshape(-1),
+                                  access, mean=mean)
+        return self.push(state, flat, fgrads, access, mean=mean)
 
 
 def get_transfer(name: Optional[str] = None,

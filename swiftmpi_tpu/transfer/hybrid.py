@@ -81,6 +81,16 @@ class HybridTransfer(Transfer):
     def bucket_capacity(self):
         return self.tail.bucket_capacity
 
+    @property
+    def window_expected_unique(self):
+        """Expected-unique-rows hint for the window wire-format crossover
+        (see TpuTransfer); lives on the tail, which makes the decision."""
+        return self.tail.window_expected_unique
+
+    @window_expected_unique.setter
+    def window_expected_unique(self, v):
+        self.tail.window_expected_unique = v
+
     def overflow_count(self) -> int:
         return self.tail.overflow_count()
 
@@ -125,10 +135,15 @@ class HybridTransfer(Transfer):
         for b, h in pending:
             self._accum_hot(b, h)
         t = self.tail.traffic()
+        w = self.wire_traffic()       # own ledger: hot-psum exchanges
         out = {"routed_rows": t["routed_rows"],
                "hot_rows": self._hot_total,
                "psum_bytes": self._psum_bytes_total,
                "overflow_dropped": t["overflow_dropped"]}
+        for k in ("wire_bytes", "dispatches", "window_sparse",
+                  "window_dense", "coalesced_rows_in",
+                  "coalesced_rows_out"):
+            out[k] = t.get(k, 0) + w.get(k, 0)
         if self.metrics is not None:
             self.metrics.set("transfer_hot_rows", out["hot_rows"])
             self.metrics.set("transfer_psum_bytes", out["psum_bytes"])
@@ -205,6 +220,9 @@ class HybridTransfer(Transfer):
                 np.dtype(jnp.asarray(g).dtype).itemsize * g.shape[1]
                 for g in grads.values()) + 4        # + f32 counts column
             self._record_hot(jnp.sum(is_hot), n_hot * width_bytes)
+            # wire ledger: the hot psum is one dispatch shipping the full
+            # replicated head (dense; token keeps the rows value traced)
+            self._record_exchange(jnp.sum(is_hot) * 0 + n_hot, width_bytes)
         new_hot = self._hot_push(hot_state, slots, grads, access,
                                  mean, counts)
         out = dict(new_tail)
@@ -217,6 +235,56 @@ class HybridTransfer(Transfer):
         the summed data counts, matching ``XlaTransfer.push_span``."""
         return self.push(state, slots, grads, access, mean=mean,
                          counts=counts)
+
+    # -- window-coalesced push ---------------------------------------------
+    def push_window(self, state, slots, grads, access, mean=False,
+                    counts=None):
+        """Window-coalesced push over the hot/tail split.  ``W == 1``
+        delegates to the per-step :meth:`push` (bit-identical).  For
+        ``W > 1`` the window is deduplicated ONCE in the unified slot
+        space, then split: the hot slice reconciles with the usual single
+        dense psum, the tail slice rides the TpuTransfer window path
+        (``pre_deduped`` — the dedup pass is not paid twice)."""
+        slots = jnp.asarray(slots, jnp.int32)
+        if slots.ndim < 2 or slots.shape[0] == 1:
+            return super().push_window(state, slots, grads, access,
+                                       mean=mean, counts=counts)
+        flat = slots.reshape(-1)
+        fgrads = {f: jnp.asarray(g).reshape((-1,) + jnp.asarray(g).shape[2:])
+                  for f, g in grads.items()}
+        fcounts = None if counts is None else jnp.asarray(
+            counts, jnp.float32).reshape(-1)
+        flat, fgrads, fcounts, _ = self._pad_batch(flat, fgrads, fcounts)
+        tail_state, hot_state = self._split_state(state)
+        n_hot = self._n_hot(state)
+        if n_hot == 0:
+            return self.tail._push_window_flat(tail_state, flat, fgrads,
+                                               access, mean, fcounts)
+        cap_tail = next(iter(tail_state.values())).shape[0]
+        ded_slots, ded_grads, ded_counts = self.tail._window_dedup(
+            flat, fgrads, fcounts, n_hot + cap_tail)
+        if self.count_traffic:
+            self._record_coalesce(jnp.sum(flat >= 0),
+                                  jnp.sum(ded_slots >= 0))
+        is_hot = (ded_slots >= 0) & (ded_slots < n_hot)
+        tail_slots = jnp.where(ded_slots >= n_hot, ded_slots - n_hot, -1)
+        # mean normalization now depends on the collapsed multiplicities,
+        # so both slices take the counts wire format
+        need_counts = mean or (counts is not None)
+        new_tail = self.tail._push_window_flat(
+            tail_state, tail_slots, ded_grads, access, mean,
+            ded_counts if need_counts else None, pre_deduped=True)
+        if self.count_traffic:
+            width_bytes = sum(
+                np.dtype(jnp.asarray(g).dtype).itemsize * g.shape[1]
+                for g in ded_grads.values()) + 4
+            self._record_hot(jnp.sum(is_hot), n_hot * width_bytes)
+            self._record_exchange(jnp.sum(is_hot) * 0 + n_hot, width_bytes)
+        new_hot = self._hot_push(hot_state, ded_slots, ded_grads, access,
+                                 mean, ded_counts if need_counts else None)
+        out = dict(new_tail)
+        out.update({hot_name(f): v for f, v in new_hot.items()})
+        return out
 
     def _hot_push(self, hot_state, slots, grads, access, mean, counts):
         with_counts = counts is not None

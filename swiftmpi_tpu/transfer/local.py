@@ -12,11 +12,18 @@ from __future__ import annotations
 import numpy as np
 
 from swiftmpi_tpu.parameter.access import AccessMethod
-from swiftmpi_tpu.transfer.api import Transfer
+from swiftmpi_tpu.transfer.api import Transfer, grad_row_bytes
 
 
 class LocalTransfer(Transfer):
     name = "local"
+
+    def __init__(self):
+        # wire ledger parity with the device backends: local has no
+        # actual wire, so wire_bytes counts the NOTIONAL sparse payload
+        # (valid rows x grad_row_bytes) the same exchange would ship —
+        # the oracle for cross-backend traffic goldens
+        self.count_traffic = False
 
     def pull(self, state, slots, access, fields=None):
         slots = np.asarray(slots, np.int64)
@@ -32,6 +39,7 @@ class LocalTransfer(Transfer):
     def push(self, state, slots, grads, access, mean=False):
         slots = np.asarray(slots, np.int64)
         valid = slots >= 0
+        self._record_exchange(int(valid.sum()), grad_row_bytes(grads))
         uniq, counts = np.unique(slots[valid], return_counts=True)
         combined = {}
         for f in grads:
@@ -42,6 +50,36 @@ class LocalTransfer(Transfer):
             np.add.at(acc, pos, g[valid])
             if mean:
                 acc /= np.maximum(counts, 1)[:, None]
+            combined[f] = acc
+        current = {f: np.asarray(state[f])[uniq]
+                   for f in access.touched_fields(grads)}
+        updated = access.apply_push(current, combined)
+        out = {f: np.asarray(state[f]).copy() for f in state}
+        for f in updated:
+            out[f][uniq] = np.asarray(updated[f])
+        return out
+
+    def push_span(self, state, slots, grads, counts, access, mean=False):
+        """Span-family oracle (stencil wire format): rows carry window-
+        overlap gradient SUMS with per-row DATA counts; ``mean`` divides
+        each unique key's gradient sum by its summed data count —
+        matching ``XlaTransfer.push_span`` exactly."""
+        slots = np.asarray(slots, np.int64)
+        counts = np.asarray(counts, np.float32)
+        valid = slots >= 0
+        self._record_exchange(int(valid.sum()),
+                              grad_row_bytes(grads, with_counts=True))
+        uniq = np.unique(slots[valid])
+        pos = np.searchsorted(uniq, slots[valid])
+        csum = np.zeros((len(uniq),), np.float32)
+        np.add.at(csum, pos, counts[valid])
+        combined = {}
+        for f in grads:
+            g = np.asarray(grads[f], np.float32)
+            acc = np.zeros((len(uniq), g.shape[1]), np.float32)
+            np.add.at(acc, pos, g[valid])
+            if mean:
+                acc /= np.maximum(csum, 1.0)[:, None]
             combined[f] = acc
         current = {f: np.asarray(state[f])[uniq]
                    for f in access.touched_fields(grads)}

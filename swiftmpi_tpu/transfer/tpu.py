@@ -36,8 +36,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
 from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
-from swiftmpi_tpu.ops import calibration, pallas_gather
-from swiftmpi_tpu.transfer.api import Transfer
+from swiftmpi_tpu.ops import calibration, pallas_gather, pallas_scatter
+from swiftmpi_tpu.parameter.key_index import window_wire_format
+from swiftmpi_tpu.transfer.api import Transfer, grad_row_bytes
 
 
 def _shard_gather(arr: jax.Array, flat_idx: jax.Array) -> jax.Array:
@@ -121,6 +122,14 @@ class TpuTransfer(Transfer):
         # without this every pull/push call would re-trace and recompile.
         self._pull_cache: Dict = {}
         self._push_cache: Dict = {}
+        # window-coalesced push (push_window): per-signature caches for
+        # the pre-exchange dedup pass and the dense psum program, plus
+        # an optional expected-unique-rows hint (set from the vocab
+        # frequency histogram via cluster.hashfrag.expected_unique_rows)
+        # that sharpens the static sparse/dense wire-format crossover
+        self._dedup_cache: Dict = {}
+        self._window_dense_cache: Dict = {}
+        self.window_expected_unique: Optional[float] = None
 
     # -- overflow accounting ----------------------------------------------
     def _accum_overflow(self, op: str, count) -> None:
@@ -196,10 +205,14 @@ class TpuTransfer(Transfer):
         return self._routed_total
 
     def traffic(self) -> Dict[str, int]:
-        """Per-backend traffic counters in the hybrid-comparable shape."""
-        return {"routed_rows": self.routed_rows(),
-                "hot_rows": 0, "psum_bytes": 0,
-                "overflow_dropped": self.overflow_count()}
+        """Per-backend traffic counters in the hybrid-comparable shape,
+        merged with the base wire ledger (wire_bytes / dispatches /
+        window decision counters — see Transfer.wire_traffic)."""
+        out = {"routed_rows": self.routed_rows(),
+               "hot_rows": 0, "psum_bytes": 0,
+               "overflow_dropped": self.overflow_count()}
+        out.update(self.wire_traffic())
+        return out
 
     def _signature(self, state, slots, grads=None):
         sig = (tuple(sorted((f, v.shape, str(v.dtype))
@@ -282,9 +295,15 @@ class TpuTransfer(Transfer):
         ``mean`` normalization at the owner divides by DATA counts rather
         than 1-per-request — matching ``XlaTransfer.push_span``."""
         slots = jnp.asarray(slots, jnp.int32)
-        if self.count_traffic:
-            self._record_routed(jnp.sum(slots >= 0))
         with_counts = counts is not None
+        if self.count_traffic:
+            rows = jnp.sum(slots >= 0)
+            self._record_routed(rows)
+            # wire ledger: sparse (index, value) rows; counts ride as an
+            # extra 4-byte column on span families (computed BEFORE the
+            # synthetic field is attached so it isn't double-counted)
+            self._record_exchange(
+                rows, grad_row_bytes(grads, with_counts=with_counts))
         if with_counts:
             grads = dict(grads)
             grads["__counts__"] = jnp.asarray(
@@ -307,6 +326,199 @@ class TpuTransfer(Transfer):
         all_to_all routing; see :meth:`push` ``counts``."""
         return self.push(state, slots, grads, access, mean=mean,
                          counts=counts)
+
+    # -- window-coalesced push ---------------------------------------------
+    def push_window(self, state, slots, grads, access, mean=False,
+                    counts=None):
+        """Coalesce a (W, B) window of per-step pushes into ONE exchange.
+
+        ``W == 1`` delegates to the per-step path (bit-identical by
+        construction: the flattened (1, B) arrays are exactly the per-step
+        arrays).  For ``W > 1`` the wire format is chosen statically per
+        window shape (parameter.key_index.window_wire_format, same
+        dense_ratio=2.0 crossover as calibrate_hot_k):
+
+          sparse — a cached shard_map pre-pass segment-dedups the window
+            on-device (sort-free positional scatter-min, the push_span
+            trick), sums grads/counts into the first occurrence, then the
+            surviving rows go through the existing bucket routing ONCE.
+          dense — each device scatter-adds its window slice into a full
+            (capacity, width) buffer and one tiled ``psum_scatter``
+            reduce-scatters it onto the owning shard's slice; mean
+            normalization ships a (capacity,) counts plane the same way.
+
+        Both formats preserve sum-then-apply-once semantics; grads were
+        computed against window-start state, so staleness is bounded by
+        W-1 steps (envelope documented in ARCHITECTURE.md)."""
+        slots = jnp.asarray(slots, jnp.int32)
+        if slots.ndim < 2 or slots.shape[0] == 1:
+            return super().push_window(state, slots, grads, access,
+                                       mean=mean, counts=counts)
+        flat = slots.reshape(-1)
+        fgrads = {f: jnp.asarray(g).reshape((-1,) + jnp.asarray(g).shape[2:])
+                  for f, g in grads.items()}
+        fcounts = None if counts is None else jnp.asarray(
+            counts, jnp.float32).reshape(-1)
+        return self._push_window_flat(state, flat, fgrads, access, mean,
+                                      fcounts)
+
+    def _push_window_flat(self, state, flat, fgrads, access, mean, fcounts,
+                          pre_deduped=False):
+        """Flattened-window push; ``pre_deduped`` lets the hybrid backend
+        route its already-deduplicated tail slice here without paying the
+        dedup pass twice."""
+        capacity = next(iter(state.values())).shape[0]
+        with_counts = fcounts is not None
+        row_bytes = grad_row_bytes(fgrads, with_counts=with_counts)
+        decision = window_wire_format(
+            int(flat.shape[0]), capacity, row_bytes,
+            expected_unique=self.window_expected_unique)
+        if decision == "dense":
+            return self._push_window_dense(state, flat, fgrads, access,
+                                           mean, fcounts)
+        if pre_deduped:
+            ded_slots, ded_grads, ded_counts = flat, fgrads, fcounts
+            if self.count_traffic:
+                # the caller (hybrid) already logged the dedup row deltas
+                # on its own ledger, but the wire decision is made HERE —
+                # log it with zero row deltas; the traced zero keeps the
+                # callback firing once per compiled execution
+                zero = jnp.sum(flat >= 0) * 0
+                self._record_coalesce(zero, zero, decision="sparse")
+        else:
+            ded_slots, ded_grads, ded_counts = self._window_dedup(
+                flat, fgrads, fcounts, capacity)
+            if self.count_traffic:
+                self._record_coalesce(jnp.sum(flat >= 0),
+                                      jnp.sum(ded_slots >= 0),
+                                      decision="sparse")
+        # mean needs the original contribution multiplicities (dedup
+        # collapsed them into ded_counts); plain sums need no counts at
+        # all — pre-summing commutes with the owner-side segment sum
+        need_counts = mean or with_counts
+        return self.push(state, ded_slots, ded_grads, access, mean=mean,
+                         counts=ded_counts if need_counts else None)
+
+    def _window_dedup(self, flat, fgrads, fcounts, capacity):
+        """Device-local positional dedup of the flattened window: each
+        device collapses repeats WITHIN its own batch slice (cross-device
+        repeats still sum correctly at the owning shard).  Returns
+        (slots, grads, counts) of the same sharded shapes with non-first
+        occurrences marked -1 and their grads/counts folded into the
+        representative row."""
+        counts_in = fcounts if fcounts is not None else jnp.ones(
+            flat.shape, jnp.float32)
+        sig = (capacity, tuple(flat.shape),
+               tuple(sorted((f, tuple(v.shape), str(v.dtype))
+                            for f, v in fgrads.items())))
+        fn = self._dedup_cache.get(sig)
+        if fn is None:
+            fn = self._dedup_cache.setdefault(
+                sig, jax.jit(self._build_window_dedup(
+                    capacity, tuple(sorted(fgrads)))))
+        return fn(flat, fgrads, counts_in)
+
+    def _build_window_dedup(self, capacity, grad_fields):
+        bspec = self._batch_spec()
+        grad_specs = {f: bspec for f in grad_fields}
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(bspec, grad_specs, bspec),
+                 out_specs=(bspec, grad_specs, bspec), check_vma=False)
+        def _dedup(slots_l, grads_l, counts_l):
+            B = slots_l.shape[0]
+            valid = slots_l >= 0
+            pos = jnp.arange(B, dtype=jnp.int32)
+            safe = jnp.where(valid, slots_l, capacity)
+            # rep[k] = first window position holding slot k — sort-free
+            # scatter-min into a (capacity+1,) plane, exactly the
+            # XlaTransfer.push_span representative trick
+            rep = jnp.full((capacity + 1,), B, jnp.int32).at[safe].min(
+                jnp.where(valid, pos, B), mode="drop")
+            owner = jnp.where(valid, rep[safe], B)   # B == dropped
+            is_owner = valid & (owner == pos)
+            out_grads = {}
+            for f in grad_fields:
+                g = grads_l[f]
+                out_grads[f] = jnp.zeros_like(g).at[owner].add(
+                    g * valid[:, None].astype(g.dtype), mode="drop")
+            csum = jnp.zeros(counts_l.shape, counts_l.dtype).at[owner].add(
+                counts_l * valid, mode="drop")
+            return jnp.where(is_owner, slots_l, -1), out_grads, csum
+
+        return _dedup
+
+    def _push_window_dense(self, state, flat, fgrads, access, mean,
+                           fcounts):
+        capacity = next(iter(state.values())).shape[0]
+        with_counts = fcounts is not None
+        counts_in = fcounts if with_counts else jnp.ones(
+            flat.shape, jnp.float32)
+        sig = self._signature(state, flat, fgrads) + (
+            mean, with_counts, "window_dense")
+        fn = self._window_dense_cache.get(sig)
+        if fn is None:
+            fn = self._window_dense_cache.setdefault(
+                sig, jax.jit(self._build_push_window_dense(
+                    state, access, tuple(sorted(fgrads)), mean)))
+        if self.count_traffic:
+            # wire volume is the static table size, not the row count —
+            # the `flat[0] * 0 + capacity` token keeps the value traced
+            # so the callback fires once per compiled execution
+            self._record_exchange(
+                flat[0].astype(jnp.int32) * 0 + capacity,
+                grad_row_bytes(fgrads, with_index=False, with_counts=mean),
+                decision="dense")
+        return fn(state, flat, fgrads, counts_in)
+
+    def _build_push_window_dense(self, state, access, grad_fields, mean):
+        capacity = next(iter(state.values())).shape[0]
+        bspec = self._batch_spec()
+        state_specs = {f: P(self.axis) for f in state}
+        grad_specs = {f: bspec for f in grad_fields}
+
+        @partial(jax.shard_map, mesh=self.mesh,
+                 in_specs=(state_specs, bspec, grad_specs, bspec),
+                 out_specs=state_specs, check_vma=False)
+        def _push_dense(state_l, slots_l, grads_l, counts_l):
+            valid = slots_l >= 0
+            safe = jnp.where(valid, slots_l, capacity)  # OOB -> dropped
+            dense = {}
+            for f in grad_fields:
+                g = jnp.asarray(grads_l[f])
+                width = g.shape[1]
+                if calibration.gated(
+                        "vmem_scatter", "SMTPU_PALLAS_SCATTER",
+                        pallas_scatter.fits_vmem(capacity, width),
+                        manual=True):
+                    acc = pallas_scatter.masked_vmem_scatter_add(
+                        slots_l, valid, g, capacity)
+                else:
+                    acc = jnp.zeros((capacity, width), g.dtype).at[
+                        safe].add(g * valid[:, None].astype(g.dtype),
+                                  mode="drop")
+                # the ONE exchange of the window: tiled reduce-scatter
+                # lands each shard's summed slice on its owner directly
+                acc = jax.lax.psum_scatter(acc, self.axis,
+                                           scatter_dimension=0, tiled=True)
+                if self.dp_axis:
+                    acc = jax.lax.psum(acc, self.dp_axis)
+                dense[f] = acc
+            if mean:
+                cplane = jnp.zeros((capacity,), jnp.float32).at[safe].add(
+                    counts_l * valid, mode="drop")
+                cplane = jax.lax.psum_scatter(
+                    cplane, self.axis, scatter_dimension=0, tiled=True)
+                if self.dp_axis:
+                    cplane = jax.lax.psum(cplane, self.dp_axis)
+                inv = (1.0 / jnp.maximum(cplane, 1.0))[:, None]
+                dense = {f: a * inv for f, a in dense.items()}
+            new_fields = access.apply_push(state_l, dense)
+            out = dict(state_l)
+            out.update(new_fields)
+            return out
+
+        return _push_dense
 
     def _build_push(self, state, access, grad_fields, mean=False,
                     with_counts=False):

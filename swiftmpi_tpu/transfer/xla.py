@@ -25,7 +25,7 @@ import jax
 import jax.numpy as jnp
 
 from swiftmpi_tpu.ops import calibration, pallas_gather, pallas_scatter
-from swiftmpi_tpu.transfer.api import Transfer
+from swiftmpi_tpu.transfer.api import Transfer, grad_row_bytes
 
 # replica-spread scatter: cap the R-fold temporary at ~256MB so the
 # measured-win gate can never OOM a large table's push
@@ -70,6 +70,10 @@ class XlaTransfer(Transfer):
         crossover is measured in docs/ARCHITECTURE.md; word2vec-scale
         batches over demo-conf-scale tables land far on the dense side)."""
         self.dense_apply = dense_apply
+        # wire ledger (api.py): XLA chooses the actual collectives, so
+        # wire_bytes counts the representation-level payload — sparse:
+        # valid rows x (index + grad row); dense: capacity x grad row
+        self.count_traffic = False
 
     # -- pull (global_pull_access.h:28-43 equivalent) ----------------------
     def pull(self, state, slots, access, fields=None):
@@ -86,7 +90,10 @@ class XlaTransfer(Transfer):
         if dense is None:
             dense = slots.shape[0] >= capacity // 2
         if dense:
+            self._record_exchange(
+                capacity, grad_row_bytes(grads, with_index=False))
             return self._push_dense(state, slots, grads, access, mean)
+        self._record_exchange(jnp.sum(slots >= 0), grad_row_bytes(grads))
         return self._push_sparse(state, slots, grads, access, mean)
 
     def _push_dense(self, state, slots, grads, access, mean=False):
@@ -179,6 +186,8 @@ class XlaTransfer(Transfer):
         capacity = next(iter(state.values())).shape[0]
         S = slots.shape[0]
         valid = slots >= 0
+        self._record_exchange(jnp.sum(valid),
+                              grad_row_bytes(grads, with_counts=True))
         safe = jnp.where(valid, slots, 0)
         pos = jnp.arange(S, dtype=jnp.int32)
         rep = jnp.full((capacity,), S, jnp.int32).at[safe].min(

@@ -150,12 +150,23 @@ def test_lr_cli(tmp_path, devices8):
         for y, feats in data:
             f.write(f"{int(y)} " + " ".join(
                 f"{k}:{v:.4f}" for k, v in feats) + "\n")
+    # a real deployment always carries a conf (the reference's
+    # lr.conf); the stock defaults leave the learning rate so low the
+    # 25-iter run stalls at the class prior — provide the same settings
+    # the in-process tests above train with
+    conf = tmp_path / "lr.conf"
+    conf.write_text(
+        "[cluster]\nserver_num: 2\ntransfer: xla\n"
+        "[worker]\nminibatch: 50\n"
+        "[server]\ninitial_learning_rate: 0.5\nfrag_num: 200\n")
     weights = str(tmp_path / "w.txt")
-    assert main(["lr", "-mode", "train", "-dataset", str(train_file),
+    assert main(["lr", "-mode", "train", "-config", str(conf),
+                 "-dataset", str(train_file),
                  "-niters", "25", "-output", weights]) == 0
     assert len(open(weights).readlines()) > 0
     preds = str(tmp_path / "p.txt")
-    assert main(["lr", "-mode", "predict", "-dataset", str(train_file),
+    assert main(["lr", "-mode", "predict", "-config", str(conf),
+                 "-dataset", str(train_file),
                  "-param", weights, "-output", preds]) == 0
     assert len(open(preds).readlines()) == 80
     # -mode eval: the reference tools/evaluate.py flow in-process
@@ -163,7 +174,8 @@ def test_lr_cli(tmp_path, devices8):
     import io
     buf = io.StringIO()
     with contextlib.redirect_stdout(buf):
-        assert main(["lr", "-mode", "eval", "-dataset", str(train_file),
+        assert main(["lr", "-mode", "eval", "-config", str(conf),
+                     "-dataset", str(train_file),
                      "-param", weights]) == 0
     err = float(buf.getvalue().split()[-1])
     # trained-on-set error must beat the majority class (the 2-iter

@@ -6,6 +6,7 @@ wired to one coordinator and collectives cross process boundaries (gloo
 on CPU — the DCN stand-in).  These tests run real subprocesses.
 """
 
+import functools
 import os
 import subprocess
 import sys
@@ -22,11 +23,57 @@ def run_launch(*args, timeout=300, env_extra=None):
         env={**os.environ, "PYTHONPATH": REPO, **(env_extra or {})})
 
 
+@functools.lru_cache(maxsize=1)
+def _cross_process_collective_support():
+    """Capability probe (cached for the session): spawn 2 REAL
+    jax.distributed processes and attempt one cross-process collective.
+
+    The control plane (coordinator join, process_count) comes up fine on
+    the CPU backend; what may be missing is the DATA plane — jax raises
+    "Multiprocess computations aren't implemented on the CPU backend" at
+    the first collective, depending on the jax build's gloo support.
+    Probing with the actual operation (not a version check) keeps these
+    tests armed wherever the capability exists and names the real reason
+    where it doesn't.  Returns (ok, reason)."""
+    prog = (
+        "import jax, jax.numpy as jnp\n"
+        "from jax.experimental import multihost_utils\n"
+        "from swiftmpi_tpu.cluster import Cluster, shutdown_distributed\n"
+        "from swiftmpi_tpu.utils import ConfigParser\n"
+        "Cluster(ConfigParser().update({'cluster': {'transfer': 'xla',"
+        " 'server_num': 1}})).initialize()\n"
+        "multihost_utils.process_allgather(jnp.ones(()))\n"
+        "print('PROBE_COLLECTIVE_OK')\n"
+        "shutdown_distributed()\n")
+    try:
+        res = run_launch("-np", "2", "-cpu", "1", "--",
+                         sys.executable, "-c", prog, timeout=240)
+    except subprocess.TimeoutExpired:
+        return False, "2-process collective probe timed out"
+    if res.returncode == 0 and "PROBE_COLLECTIVE_OK" in res.stdout:
+        return True, ""
+    out = res.stdout + res.stderr
+    for line in out.splitlines():
+        if "implemented" in line or "Error" in line:
+            return False, line.strip()[:200]
+    return False, f"collective probe failed rc={res.returncode}"
+
+
+def require_cross_process_collectives():
+    ok, reason = _cross_process_collective_support()
+    if not ok:
+        pytest.skip(
+            "cross-process collectives unavailable in this jax build "
+            f"(probe: {reason}); the launcher/supervisor tests below "
+            "still cover the control plane")
+
+
 @pytest.mark.parametrize("nprocs", [2, 4])
 def test_multi_process_cluster_and_collective(nprocs):
     """N-way rendering of the reference's mpirun -np N: N jax.distributed
     processes x 2 virtual devices; at N=4 the hybrid transfer=tpu mesh
     gets 4 data groups (the _mp_child assertions scale with N)."""
+    require_cross_process_collectives()
     res = run_launch("-np", str(nprocs), "-cpu", "2", "--",
                      sys.executable, os.path.join(REPO, "tests",
                                                   "_mp_child.py"))
@@ -43,6 +90,7 @@ def test_multi_process_bounded_staleness_async():
     state — trained across 2 real jax.distributed processes, loss
     parity vs sync asserted inside the child (the multi-host rendering
     of word2vec_global.h:577-651)."""
+    require_cross_process_collectives()
     res = run_launch("-np", "2", "-cpu", "2", "--",
                      sys.executable, os.path.join(REPO, "tests",
                                                   "_mp_async_child.py"))
@@ -59,6 +107,7 @@ def test_eight_process_async_staleness():
     full local_steps ∈ {1,4,16} envelope is scripts/async_envelope.py
     (archived in .bench_cache/async_envelope.json, table in
     docs/ARCHITECTURE.md)."""
+    require_cross_process_collectives()
     res = run_launch("-np", "8", "-cpu", "2", "--",
                      sys.executable, os.path.join(REPO, "tests",
                                                   "_mp_async_child.py"),

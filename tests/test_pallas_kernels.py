@@ -347,3 +347,50 @@ def test_pallas_status_marker(monkeypatch, tmp_path):
     # other device kinds stay independently unvalidated
     assert calibration.pallas_status("TPU v4") == "unvalidated-on-tpu"
     calibration.reset_cache()
+
+
+def test_interpret_exercise_upgrades_marker(monkeypatch, tmp_path):
+    """An interpret-mode numpy-oracle pass recorded via
+    record_interpret distinguishes "never exercised" from "exercised
+    off-chip": the unvalidated-on-tpu marker stays (no chip was
+    involved) but names the kernels whose semantics a host oracle has
+    confirmed, and the gate itself must never consult the interpret
+    pseudo-kind."""
+    from swiftmpi_tpu.ops import calibration
+    from swiftmpi_tpu.ops.pallas_scatter import masked_vmem_scatter_add
+
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(tmp_path / "calib.json"))
+    calibration.reset_cache()
+    assert calibration.pallas_status("TPU v5 lite") == "unvalidated-on-tpu"
+
+    # the actual off-chip exercise: interpret-mode kernel vs numpy oracle
+    rng = np.random.default_rng(23)
+    cap, W, n = 53, 4, 200
+    slots = rng.integers(-1, cap, n).astype(np.int32)
+    valid = slots >= 0
+    g = rng.standard_normal((n, W)).astype(np.float32)
+    got = np.asarray(masked_vmem_scatter_add(
+        jnp.asarray(slots), jnp.asarray(valid), jnp.asarray(g), cap))
+    want = np.zeros((cap, W), np.float32)
+    np.add.at(want, slots[valid], g[valid])
+    correct = np.allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert correct
+    calibration.record_interpret("vmem_scatter", correct,
+                                 shape=f"cap={cap} n={n} W={W}")
+
+    st = calibration.pallas_status("TPU v5 lite")
+    assert st.startswith("unvalidated-on-tpu (exercised off-chip")
+    assert "vmem_scatter" in st
+    # the recorded exercise is visible under the interpret pseudo-kind...
+    v = calibration.lookup("vmem_scatter", calibration.INTERPRET_KIND)
+    assert v["correct"] and v["interpret"]
+    # ...but cannot arm the measurement gate for any real device kind
+    monkeypatch.setenv("SMTPU_PALLAS_SCATTER", "auto")
+    assert not calibration.gated("vmem_scatter", "SMTPU_PALLAS_SCATTER",
+                                 fits=True, manual=True)
+    # an on-chip measured A/B still wins over the off-chip marker
+    calibration.record("vmem_scatter", "TPU v5 lite",
+                       {"win": True, "pallas_ms": 1.0, "xla_ms": 5.0})
+    assert calibration.pallas_status(
+        "TPU v5 lite") == "validated: win (vmem_scatter)"
+    calibration.reset_cache()

@@ -1,0 +1,389 @@
+"""Window-coalesced push parity suite (ISSUE 4 acceptance).
+
+The contract under test, per ``Transfer.push_window``:
+
+* ``W == 1`` is the flatten of a unit axis — bit-identical to the
+  per-step ``push`` on every backend.
+* ``W > 1`` must equal the sum-then-apply-once oracle (flatten the
+  window, one ``push``/``push_span``): every (step, position)
+  contribution summed, mean over the TOTAL window contribution count,
+  access rule once per unique row.  The dense wire format re-associates
+  float sums, hence the looser rtol there.
+* The sparse/dense wire-format crossover (``window_wire_format``) is
+  host-static, steerable by ``window_expected_unique``, and visible in
+  the traffic ledger (``window_sparse``/``window_dense``).
+* Overflow accounting and the wire counters survive coalescing.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.cluster import SHARD_AXIS, ps_mesh
+from swiftmpi_tpu.cluster.hashfrag import expected_unique_rows
+from swiftmpi_tpu.parameter import KeyIndex, SparseTable, w2v_access
+from swiftmpi_tpu.parameter.access import lr_access
+from swiftmpi_tpu.parameter.key_index import (HotColdPartition,
+                                              window_wire_format)
+from swiftmpi_tpu.parameter.sparse_table import hot_name
+from swiftmpi_tpu.transfer.hybrid import HybridTransfer
+from swiftmpi_tpu.transfer.local import LocalTransfer
+from swiftmpi_tpu.transfer.tpu import TpuTransfer
+from swiftmpi_tpu.transfer.xla import XlaTransfer
+from swiftmpi_tpu.utils import ConfigParser
+
+DIM = 8
+
+
+def make_table(mesh=None, num_shards=8, cap=128, seed=0):
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    ki = KeyIndex(num_shards, cap)
+    table = SparseTable(access, ki, mesh=mesh,
+                        axis=SHARD_AXIS if mesh else None, seed=seed)
+    return table, ki, access
+
+
+def window_batch(ki, rng, W=4, B=64, key_hi=700):
+    """A (W, B) window with padding (-1), duplicates across steps and
+    within a step, plus integer counts — the full wire surface."""
+    keys = rng.integers(0, key_hi, size=W * B).astype(np.uint64)
+    slots = np.asarray(ki.lookup(keys), np.int32).reshape(W, B)
+    slots[:, ::7] = -1
+    grads = {f: rng.normal(size=(W, B, DIM)).astype(np.float32)
+             for f in ("h", "v")}
+    counts = rng.integers(1, 4, size=(W, B)).astype(np.float32)
+    counts[slots < 0] = 0
+    return slots, grads, counts
+
+
+def oracle_window(state_np, slots, grads, access, mean=False, counts=None):
+    """Sum-then-apply-once oracle: flatten the window, one local push."""
+    flat = slots.reshape(-1)
+    fgrads = {f: g.reshape(-1, DIM) for f, g in grads.items()}
+    st = {f: v.copy() for f, v in state_np.items()}
+    if counts is not None:
+        return LocalTransfer().push_span(st, flat, fgrads,
+                                         counts.reshape(-1), access,
+                                         mean=mean)
+    return LocalTransfer().push(st, flat, fgrads, access, mean=mean)
+
+
+def backend(name, mesh):
+    if name == "local":
+        return LocalTransfer()
+    if name == "xla":
+        return XlaTransfer()
+    if name == "tpu":
+        return TpuTransfer(mesh)
+    return HybridTransfer(mesh)
+
+
+# -- W == 1: bit-identity on every backend --------------------------------
+
+@pytest.mark.parametrize("name", ["local", "xla", "tpu", "hybrid"])
+def test_push_window_w1_bit_identical(name, devices8):
+    mesh = ps_mesh()
+    table, ki, access = make_table(mesh)
+    rng = np.random.default_rng(0)
+    slots, grads, _ = window_batch(ki, rng, W=1, B=64)
+    t = backend(name, mesh)
+    state = table.state if name in ("tpu", "hybrid") else {
+        f: jnp.asarray(np.asarray(v)) for f, v in table.state.items()}
+    per_step = t.push(state, slots[0], {f: g[0] for f, g in grads.items()},
+                      access, mean=True)
+    win = t.push_window(state, slots, grads, access, mean=True)
+    for f in access.fields:
+        assert np.array_equal(np.asarray(per_step[f]), np.asarray(win[f])), \
+            (name, f)
+
+
+# -- W > 1: oracle parity through the sparse wire format ------------------
+
+@pytest.mark.parametrize("mean,use_counts", [(False, False), (True, False),
+                                             (True, True), (False, True)])
+def test_tpu_push_window_matches_flat_oracle(mean, use_counts, devices8):
+    mesh = ps_mesh()
+    table, ki, access = make_table(mesh)
+    state_np = {f: np.asarray(v) for f, v in table.state.items()}
+    rng = np.random.default_rng(1)
+    slots, grads, counts = window_batch(ki, rng)
+    want = oracle_window(state_np, slots, grads, access, mean=mean,
+                         counts=counts if use_counts else None)
+    t = TpuTransfer(mesh)
+    t.count_traffic = True
+    got = t.push_window(table.state, slots, grads, access, mean=mean,
+                        counts=counts if use_counts else None)
+    for f in access.fields:
+        np.testing.assert_allclose(np.asarray(got[f]), want[f], rtol=1e-5,
+                                   atol=1e-6, err_msg=(f, mean, use_counts))
+    tr = t.traffic()
+    # one window, sparse format: dedup recorded rows in >= rows out, the
+    # decision is visible, and the exchange hit the wire ledger
+    assert tr["window_sparse"] == 1 and tr["window_dense"] == 0, tr
+    assert tr["coalesced_rows_in"] >= tr["coalesced_rows_out"] > 0, tr
+    assert tr["wire_bytes"] > 0 and tr["dispatches"] >= 1, tr
+
+
+# -- sparse/dense crossover -----------------------------------------------
+
+def test_window_wire_format_goldens_zipf_vs_uniform():
+    """The host-static decision on two frequency shapes at identical
+    geometry: a Zipf window dedups far below capacity (sparse pays), a
+    uniform window's unique rows approach min(rows, vocab) (densify)."""
+    vocab, rows, row_bytes = 50_000, 4 * 16_384, 68
+    capacity = 65_536
+    ranks = np.arange(1, vocab + 1, dtype=np.float64)
+    zipf = np.maximum((1e6 * ranks ** -1.0 / np.sum(ranks ** -1.0))
+                      .astype(np.int64), 1)
+    uniform = np.full(vocab, 20, np.int64)
+    eu_zipf = expected_unique_rows(zipf, rows)
+    eu_uni = expected_unique_rows(uniform, rows)
+    assert eu_zipf < eu_uni <= rows
+    assert window_wire_format(rows, capacity, row_bytes,
+                              expected_unique=eu_zipf) == "sparse"
+    assert window_wire_format(rows, capacity, row_bytes,
+                              expected_unique=eu_uni) == "dense"
+    # no histogram hint: the raw request count decides
+    assert window_wire_format(rows, capacity, row_bytes) == "dense"
+    assert window_wire_format(8, capacity, row_bytes) == "sparse"
+
+
+def test_tpu_push_window_dense_path_matches_oracle(devices8):
+    """A window covering most of a tiny table crosses to the dense
+    format: one capacity-shaped psum-style reduction, float-order noise
+    only (hence the looser tolerance), decision counted as dense."""
+    mesh = ps_mesh()
+    table, ki, access = make_table(mesh, cap=8)
+    state_np = {f: np.asarray(v) for f, v in table.state.items()}
+    rng = np.random.default_rng(2)
+    slots, grads, _ = window_batch(ki, rng, key_hi=24)
+    want = oracle_window(state_np, slots, grads, access, mean=True)
+    t = TpuTransfer(mesh)
+    t.count_traffic = True
+    got = t.push_window(table.state, slots, grads, access, mean=True)
+    for f in access.fields:
+        np.testing.assert_allclose(np.asarray(got[f]), want[f], rtol=1e-4,
+                                   atol=1e-5, err_msg=f)
+    tr = t.traffic()
+    assert tr["window_dense"] == 1 and tr["window_sparse"] == 0, tr
+    # dense wire volume is the static table size, not the row count
+    assert tr["wire_bytes"] >= ki.capacity * DIM * 4, tr
+
+
+def test_window_expected_unique_steers_runtime_decision(devices8):
+    """Same batch, same capacity: the raw request count alone densifies,
+    but a Zipf-aware expected-unique hint below the crossover keeps the
+    window sparse — and both results agree with the oracle."""
+    mesh = ps_mesh()
+    table, ki, access = make_table(mesh, cap=8)     # capacity 64
+    state_np = {f: np.asarray(v) for f, v in table.state.items()}
+    rng = np.random.default_rng(3)
+    slots, grads, _ = window_batch(ki, rng, key_hi=16)
+    want = oracle_window(state_np, slots, grads, access, mean=True)
+
+    dense_t = TpuTransfer(mesh)
+    dense_t.count_traffic = True
+    assert dense_t.window_expected_unique is None
+    got_d = dense_t.push_window(table.state, slots, grads, access,
+                                mean=True)
+    assert dense_t.traffic()["window_dense"] == 1
+
+    sparse_t = TpuTransfer(mesh)
+    sparse_t.count_traffic = True
+    sparse_t.window_expected_unique = 16.0
+    got_s = sparse_t.push_window(table.state, slots, grads, access,
+                                 mean=True)
+    tr = sparse_t.traffic()
+    assert tr["window_sparse"] == 1 and tr["window_dense"] == 0, tr
+    for f in access.fields:
+        np.testing.assert_allclose(np.asarray(got_d[f]), want[f],
+                                   rtol=1e-4, atol=1e-5, err_msg=f)
+        np.testing.assert_allclose(np.asarray(got_s[f]), want[f],
+                                   rtol=1e-4, atol=1e-5, err_msg=f)
+
+
+# -- hybrid hot/tail split ------------------------------------------------
+
+def test_hybrid_push_window_hot_split_parity(devices8):
+    """n_hot > 0: the window dedups once in the unified slot space, the
+    hot slice reconciles via the dense psum, the tail slice rides the
+    tpu window path — against the unified flatten-once oracle.  The
+    wire decision of the tail slice must be visible in the ledger."""
+    mesh = ps_mesh()
+    rng = np.random.default_rng(4)
+    keys = rng.choice(100_000, size=400, replace=False).astype(np.uint64)
+    ranks = np.arange(1, 401, dtype=np.float64)
+    counts = np.maximum((1e6 * ranks ** -1.0 / np.sum(ranks ** -1.0))
+                        .astype(np.int64), 1)[rng.permutation(400)]
+    part = HotColdPartition.from_counts(keys, counts, batch_rows=64)
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    ki = KeyIndex(8, 64, partition=part)
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS)
+    ki.lookup(keys)
+    assert table.n_hot > 0
+
+    W, B = 3, 64
+    slots = np.asarray(ki.lookup(keys[rng.integers(0, 400, W * B)]),
+                       np.int32).reshape(W, B)
+    slots[:, ::9] = -1
+    assert ((slots >= 0) & (slots < table.n_hot)).any()
+    assert (slots >= table.n_hot).any()
+    grads = {f: rng.normal(size=(W, B, DIM)).astype(np.float32)
+             for f in ("h", "v")}
+    uni_state = {f: table.unified_rows_host(f) for f in access.fields}
+    want = oracle_window(uni_state, slots, grads, access, mean=True)
+
+    t = HybridTransfer(mesh)
+    t.count_traffic = True
+    new = t.push_window(table.state, slots, grads, access, mean=True)
+    for f in access.fields:
+        got_uni = np.concatenate([np.asarray(new[hot_name(f)]),
+                                  np.asarray(new[f])])
+        np.testing.assert_allclose(got_uni, want[f], rtol=1e-5, atol=1e-6,
+                                   err_msg=f)
+    tr = t.traffic()
+    assert tr["window_sparse"] + tr["window_dense"] == 1, tr
+    assert tr["coalesced_rows_in"] >= tr["coalesced_rows_out"] > 0, tr
+    assert tr["hot_rows"] > 0 and tr["psum_bytes"] > 0, tr
+
+
+# -- overflow accounting --------------------------------------------------
+
+def test_push_window_overflow_preserved(devices8):
+    """Bucket overflow through the coalesced sparse path counts exactly
+    like the per-step push of the same flattened rows (dedup leaves the
+    all-distinct batch untouched, so the routed load is identical)."""
+    mesh = ps_mesh()
+    access = lr_access(0.1)
+    ki = KeyIndex(num_shards=8, capacity_per_shard=64)
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS)
+    keys, k = [], 0
+    while len(keys) < 24:       # all owned by shard 3 -> tiny buckets drop
+        if ki.shard_of(np.array([k], np.uint64))[0] == 3:
+            keys.append(k)
+        k += 1
+    flat = np.asarray(ki.lookup(np.array(keys, np.uint64)), np.int32)
+    grads_flat = {"val": np.ones((24, 1), np.float32)}
+
+    ref = TpuTransfer(mesh, bucket_capacity=2)
+    ref.push(table.state, flat, grads_flat, access)
+    want_dropped = ref.overflow_count()
+    assert want_dropped > 0
+
+    t = TpuTransfer(mesh, bucket_capacity=2)
+    t.count_traffic = True
+    t.push_window(table.state, flat.reshape(2, 12),
+                  {"val": grads_flat["val"].reshape(2, 12, 1)}, access)
+    assert t.overflow_count() == want_dropped
+    assert t.traffic()["window_sparse"] == 1
+
+    ample = TpuTransfer(mesh, bucket_capacity=24)
+    ample.push_window(table.state, flat.reshape(2, 12),
+                      {"val": grads_flat["val"].reshape(2, 12, 1)}, access)
+    assert ample.overflow_count() == 0
+
+
+# -- wire counters exist on every backend ---------------------------------
+
+@pytest.mark.parametrize("name", ["local", "xla", "tpu", "hybrid"])
+def test_traffic_counters_all_backends(name, devices8):
+    mesh = ps_mesh()
+    table, ki, access = make_table(mesh)
+    rng = np.random.default_rng(5)
+    slots, grads, _ = window_batch(ki, rng, W=2, B=64)
+    t = backend(name, mesh)
+    t.count_traffic = True
+    state = table.state if name in ("tpu", "hybrid") else {
+        f: jnp.asarray(np.asarray(v)) for f, v in table.state.items()}
+    t.push_window(state, slots, grads, access, mean=True)
+    tr = t.traffic()
+    for key in ("wire_bytes", "dispatches", "window_sparse",
+                "window_dense", "coalesced_rows_in", "coalesced_rows_out"):
+        assert key in tr, (name, tr)
+    assert tr["wire_bytes"] > 0 and tr["dispatches"] >= 1, (name, tr)
+
+
+# -- windowed AdaGrad envelope --------------------------------------------
+
+def test_windowed_adagrad_accumulator_envelope():
+    """The documented bounded-staleness envelope (sparse_table.py
+    docstring): one window advances the accumulator by (Σg)² instead of
+    Σ(g²) per step — within [0, W x per-step mass] by Cauchy-Schwarz,
+    reaching W x when the window's gradients align and 0 when they
+    cancel."""
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    W = 4
+    for case, scale in [("aligned", np.ones(W)),
+                        ("cancel", np.array([1.0, -1.0, 1.0, -1.0])),
+                        ("mixed", np.array([0.5, -0.2, 1.0, 0.3]))]:
+        g = np.stack([s * np.ones((1, DIM), np.float32) for s in scale])
+        slots = np.zeros((W, 1), np.int32)
+        zero = {f: np.zeros((4, DIM), np.float32)
+                for f in ("h", "v", "h2sum", "v2sum")}
+        win = LocalTransfer().push_window(
+            {f: v.copy() for f, v in zero.items()}, slots,
+            {"h": g}, access)
+        win_mass = float(np.asarray(win["h2sum"])[0].sum())
+        st = {f: v.copy() for f, v in zero.items()}
+        for i in range(W):
+            st = LocalTransfer().push(st, slots[i], {"h": g[i]}, access)
+        step_mass = float(np.asarray(st["h2sum"])[0].sum())
+        np.testing.assert_allclose(win_mass, float((g.sum(0) ** 2).sum()),
+                                   rtol=1e-6)
+        assert 0.0 <= win_mass <= W * step_mass + 1e-6, (case, win_mass,
+                                                         step_mass)
+        if case == "aligned":
+            np.testing.assert_allclose(win_mass, W * step_mass, rtol=1e-6)
+        if case == "cancel":
+            assert win_mass < 1e-6
+
+
+# -- word2vec end-to-end --------------------------------------------------
+
+def w2v_model(**overrides):
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla"},
+        "word2vec": {"len_vec": 16, "window": 2, "negative": 5,
+                     "sample": -1, "learning_rate": 0.05,
+                     "min_sentence_length": 2},
+        "server": {"initial_learning_rate": 0.3},
+        "worker": {"minibatch": 512},
+    })
+    for sec, kv in overrides.items():
+        for k, v in kv.items():
+            cfg.set(sec, k, v)
+    return Word2Vec(config=cfg)
+
+
+def test_w2v_push_window_training_parity(devices8):
+    """push_window=2 over the fused scan trains to the same loss
+    trajectory as the per-step path (within the bounded-staleness band —
+    the same 25% envelope the async/staleness suites use)."""
+    from swiftmpi_tpu.data.text import synthetic_corpus
+
+    corpus = synthetic_corpus(90, vocab_size=60, length=12, seed=8)
+    base = w2v_model(worker={"inner_steps": 4})
+    base_losses = base.train(corpus, niters=3, batch_size=64)
+    win = w2v_model(cluster={"transfer": "xla", "push_window": 2},
+                    worker={"inner_steps": 4})
+    win_losses = win.train(corpus, niters=3, batch_size=64)
+    assert win_losses[-1] < win_losses[0]
+    for a, b in zip(win_losses, base_losses):
+        assert abs(a - b) / b < 0.25, (win_losses, base_losses)
+
+
+def test_w2v_push_window_rejects_dense_logits(devices8):
+    """Dense (capacity-shaped) pushes have no deferred-window semantics;
+    the combination must fail loudly at trace time, not silently
+    de-coalesce."""
+    from swiftmpi_tpu.data.text import synthetic_corpus
+
+    corpus = synthetic_corpus(20, vocab_size=30, length=10, seed=9)
+    m = w2v_model(cluster={"transfer": "xla", "push_window": 2},
+                  worker={"inner_steps": 2},
+                  word2vec={"dense_logits": "1"})
+    with pytest.raises(ValueError, match="cannot coalesce dense"):
+        m.train(corpus, niters=1, batch_size=64)
