@@ -315,7 +315,11 @@ def _bench_w2v(device, timed_calls, built=None, inner_steps=None):
            # which NS rendering the model resolved ("gather"/"dense"/
            # "shared"/"sg"/"sg_shared") — A/B verdicts must never
            # compare numbers from mismatched renderings
-           "rendering": getattr(model, "resolved_rendering", None)}
+           "rendering": getattr(model, "resolved_rendering", None),
+           # pre-staged device arrays: zero host input work inside the
+           # timed region by construction (the train()-path cells
+           # report the measured split)
+           "host_stall_ms": 0.0, "stall_ms_per_step": 0.0}
     out.update(_roofline(
         device, dt / (timed_calls * n_inner),
         hbm_bytes=_w2v_step_bytes(model, batches[0].centers.shape[0])))
@@ -484,7 +488,7 @@ W2V_1M_VOCAB = 1_000_000
 
 
 def build_w2v_1m_model(device, stencil=False, hybrid=False,
-                       window_steps=1):
+                       window_steps=1, pipeline=0):
     """The 1M-vocab cell's model (BASELINE config #3 shape: demo.conf
     hyperparameters over a ~1M-word Zipf vocabulary / 1.3M-row table).
     ONE builder shared by the bench cell and the profiler ablation
@@ -508,7 +512,12 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
     ``window_steps=W``: window-coalesced push ([cluster] push_window) —
     W fused steps accumulate their pushes and exchange ONCE through the
     density-adaptive wire format.  The BENCH_ONLY=scale_window cell's
-    shape (window over the hybrid stencil+pool rendering)."""
+    shape (window over the hybrid stencil+pool rendering).
+
+    ``pipeline=K``: the asynchronous input pipeline ([worker] pipeline)
+    plus train()-path fusing ([worker] inner_steps = BENCH_SCAN) — the
+    BENCH_ONLY=scale_pipeline cell's shape, which drives the PUBLIC
+    train() loop instead of a pre-staged ``_build_multi_step``."""
     import jax
     import numpy as np
     from swiftmpi_tpu.cluster.cluster import Cluster
@@ -550,7 +559,16 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
         # grid halved the cap=262K gather in bf16)
         "server": {"initial_learning_rate": 0.7, "frag_num": 1000,
                    "dtype": os.environ.get("BENCH_DTYPE", "float32")},
-        "worker": {"minibatch": 5000},
+        "worker": {"minibatch": 5000,
+                   # scale_pipeline: the train()-path cell needs the
+                   # fused group length in config (the pre-staged cells
+                   # pass it to _build_multi_step directly) plus the
+                   # producer depth / dispatch watermark knobs
+                   **({"inner_steps": INNER_STEPS,
+                       "pipeline": int(pipeline),
+                       "dispatch_depth": os.environ.get(
+                           "BENCH_DISPATCH_DEPTH", "auto")}
+                      if pipeline else {})},
     })
     with jax.default_device(device):
         model = Word2Vec(
@@ -621,7 +639,10 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
            # self-describing: the fp32 and bf16 scale cells must be
            # distinguishable by content, not by stage/env metadata
            "dtype": os.environ.get("BENCH_DTYPE", "float32"),
-           "rendering": getattr(model, "resolved_rendering", None)}
+           "rendering": getattr(model, "resolved_rendering", None),
+           # pre-staged device arrays: zero host input work inside the
+           # timed region by construction (w2v_1m_pipeline measures it)
+           "host_stall_ms": 0.0, "stall_ms_per_step": 0.0}
     if stencil or hybrid:
         out["span"] = BATCH + 2 * model.window
     if hybrid:
@@ -659,6 +680,110 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
     out.update(_roofline(device, dt / (timed_calls * INNER_STEPS),
                          hbm_bytes=_w2v_step_bytes(model, B)))
     return out
+
+
+def _bench_w2v_1m_pipeline(device, timed_calls):
+    """Asynchronous input pipeline at 1M vocab over the full
+    window+hybrid stencil+pool composition, through the PUBLIC train()
+    path: a producer thread renders the stencil spans and eagerly
+    ``device_put``s them BENCH_PIPELINE (default 3) batches ahead, so
+    host rendering + H2D DMA overlap the previous group's compute.
+
+    Unlike the pre-staged ``_bench_w2v_1m`` cells (device arrays built
+    before the clock starts — zero host work by construction), this
+    cell's timed region includes rendering, transfer, fused-group
+    assembly and dispatch, which is exactly the overlap the pipeline
+    exists to buy.  The same model then re-runs the identical batch
+    stream with ``pipeline_depth = 0`` (same compiled program — the
+    knob only moves rendering between threads), so the cell carries its
+    own A/B: ``words_per_sec`` vs ``words_per_sec_nopipe`` and the
+    host-stall split on both sides.  Batches are synthetic fixed-shape
+    spans (every batch group-fuses; the rendering cost per batch is the
+    fresh RNG draw + the host stack)."""
+    import jax
+    import numpy as np
+    from swiftmpi_tpu.data.text import StencilBatch
+
+    V = W2V_1M_VOCAB
+    win = int(os.environ.get("BENCH_WINDOW", INNER_STEPS))
+    depth = int(os.environ.get("BENCH_PIPELINE", 3))
+    model, _ = build_w2v_1m_model(device, hybrid=True, window_steps=win,
+                                  pipeline=depth)
+    B = BATCH
+    W = model.window
+    n_batches = max(timed_calls, 1) * INNER_STEPS
+
+    class _SyntheticStencilStream:
+        """Fixed-shape stencil epoch, re-rendered per pass: the per-
+        batch numpy draws are the host rendering the producer thread
+        hides.  Fresh seed per epoch — this is a throughput A/B, not a
+        parity check (tests/test_input_pipeline.py owns parity)."""
+
+        def __init__(self):
+            self._seed = 0
+
+        def epoch_stencil(self, batch_size):
+            r = np.random.default_rng(self._seed)
+            self._seed += 1
+            S = batch_size + 2 * W
+            sent = np.arange(S, dtype=np.int32) // SENT_LEN
+            cpos = W + np.arange(batch_size, dtype=np.int32)
+            for _ in range(n_batches):
+                yield StencilBatch(
+                    tokens=r.integers(0, V, size=S).astype(np.int32),
+                    sent_id=sent, center_pos=cpos,
+                    half=r.integers(1, W + 1,
+                                    size=batch_size).astype(np.int32),
+                    n_words=int(batch_size))
+
+    batcher = _SyntheticStencilStream()
+    with jax.default_device(device):
+        # warm BOTH arms: the pipelined arm feeds committed
+        # NamedSharding arrays, the inline arm host numpy — each can
+        # trigger its own compile/layout variant, and an A/B where one
+        # side pays a compile inside the clock is a lie
+        model.train(batcher=batcher, niters=1, batch_size=B)
+        model.pipeline_depth = 0
+        model.train(batcher=batcher, niters=1, batch_size=B)
+        model.pipeline_depth = depth
+        model._tail_fuse_frozen = True
+        try:
+            t0 = time.perf_counter()
+            model.train(batcher=batcher, niters=1, batch_size=B)
+            dt_on = time.perf_counter() - t0
+            m_on = dict(model.train_metrics)
+            model.pipeline_depth = 0       # same program, inline input
+            t0 = time.perf_counter()
+            model.train(batcher=batcher, niters=1, batch_size=B)
+            dt_off = time.perf_counter() - t0
+            m_off = dict(model.train_metrics)
+        finally:
+            model._tail_fuse_frozen = False
+            model.pipeline_depth = depth
+    words = B * n_batches
+    pipe = m_on.get("pipeline") or {}
+    return {"words_per_sec": words / dt_on,
+            "words_per_sec_nopipe": words / dt_off,
+            "speedup_vs_off": round(dt_off / dt_on, 3),
+            # host-stall split on both sides of the A/B: the pipeline's
+            # win must show up as stall going to ~0, not as noise
+            "stall_ms_per_step": round(
+                m_on.get("stall_ms_per_step", 0.0), 3),
+            "stall_ms_per_step_nopipe": round(
+                m_off.get("stall_ms_per_step", 0.0), 3),
+            "host_stall_ms": round(m_on.get("host_stall_ms", 0.0), 1),
+            "host_stall_ms_nopipe": round(
+                m_off.get("host_stall_ms", 0.0), 1),
+            "device_ms": round(m_on.get("device_ms", 0.0), 1),
+            "queue_depth": int(pipe.get("peak_queue_depth", 0)),
+            "pipeline": depth,
+            "dispatch_depth": model.dispatch_depth,
+            "inner_steps": INNER_STEPS, "push_window": win,
+            "batch_size": B, "n_batches": n_batches,
+            "span": B + 2 * W, "vocab": V,
+            "capacity": model.table.capacity, "transfer": "hybrid",
+            "dtype": os.environ.get("BENCH_DTYPE", "float32"),
+            "rendering": getattr(model, "resolved_rendering", None)}
 
 
 def _write_corpus(corpus) -> str:
@@ -716,6 +841,22 @@ def _timed_epoch(model, vocab, tokens, offsets, batch_size=None):
     return dt, losses
 
 
+def _stall_fields(model):
+    """Host-stall split detail fields from the model's last train()
+    (utils.timers.Throughput): ride on every train()-path cell so the
+    artifact states which side of the step loop bounds the number —
+    input (rendering + H2D) or device (dispatch + compute)."""
+    tm = getattr(model, "train_metrics", None) or {}
+    out = {k: round(float(tm[k]), 3)
+           for k in ("host_stall_ms", "device_ms", "stall_ms_per_step")
+           if k in tm}
+    if tm.get("pipeline_depth"):
+        out["pipeline"] = int(tm["pipeline_depth"])
+        out["queue_depth"] = int(
+            (tm.get("pipeline") or {}).get("peak_queue_depth", 0))
+    return out
+
+
 def _bench_w2v_epoch(device, model):
     """END-TO-END epoch wall-clock through the PUBLIC train() path —
     the north star's literal metric (BASELINE.json: epoch wall-clock,
@@ -738,7 +879,7 @@ def _bench_w2v_epoch(device, model):
     # count — named distinctly so the two rates are never conflated
     return {"epoch_wall_s": dt,
             "corpus_tokens_per_sec": n_tokens / dt,
-            "corpus_tokens": n_tokens}
+            "corpus_tokens": n_tokens, **_stall_fields(model)}
 
 
 def _bench_w2v_epoch_fused(device, model, vocab, tokens, offsets,
@@ -864,7 +1005,8 @@ def _bench_w2v_text8(device):
     return {"epoch_wall_s": dt,
             "corpus_tokens_per_sec": n_tokens / dt,
             "corpus_tokens": n_tokens, "vocab": int(len(vocab.keys)),
-            "batch_size": mb, "loss": float(losses[-1])}
+            "batch_size": mb, "loss": float(losses[-1]),
+            **_stall_fields(m)}
 
 
 def _bench_w2v_100m(device):
@@ -939,7 +1081,8 @@ def _bench_w2v_100m(device):
             "loader_wall_s": round(load_s, 2),
             "corpus_write_s": round(write_s, 2),
             "corpus_bytes": corpus_bytes,
-            "local_steps": 4, "loss": float(losses[-1])}
+            "local_steps": 4, "loss": float(losses[-1]),
+            **_stall_fields(m)}
 
 
 def _bench_glove(device, timed_calls):
@@ -986,7 +1129,10 @@ def _bench_glove(device, timed_calls):
         dt = time.perf_counter() - t0
     out = {"cells_per_sec": B * INNER * timed_calls / dt,
            "step_ms": dt / (timed_calls * INNER) * 1e3,
-           "nnz": int(n), "loss": float(loss) / (B * INNER)}
+           "nnz": int(n), "loss": float(loss) / (B * INNER),
+           # pre-staged COO minibatches: zero host input work inside
+           # the timed region by construction
+           "host_stall_ms": 0.0, "stall_ms_per_step": 0.0}
     # HBM model per inner step: 2B focal/context rows pulled across two
     # fields each (w+b / wt+bt ≈ (d+1) floats), then pushed read-modify-
     # write with fp32 AdaGrad accumulators (4 row-passes) — same
@@ -1283,6 +1429,18 @@ def child_main(which: str) -> None:
         out["w2v_1m_window"] = _bench_w2v_1m(device, max(timed // 2, 1),
                                              hybrid=True,
                                              window_steps=win)
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
+    if os.environ.get("BENCH_ONLY") == "scale_pipeline":
+        # asynchronous input pipeline over the window+hybrid
+        # stencil+pool composition, through the PUBLIC train() path —
+        # the one scale cell whose timed region includes host
+        # rendering + H2D, with an in-cell pipeline-off A/B over the
+        # identical batch stream.  Own child + own key; never compared
+        # against the pre-staged scale cells (different timed surface)
+        out["w2v_1m_pipeline"] = _bench_w2v_1m_pipeline(
+            device, max(timed // 2, 1))
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
@@ -1671,6 +1829,7 @@ _SECONDARY_CELLS = (
     ("w2v_1m_stencil", "w2v_1m_stencil", "words_per_sec", "words/s"),
     ("w2v_1m_hybrid", "w2v_1m_hybrid", "words_per_sec", "words/s"),
     ("w2v_1m_window", "w2v_1m_window", "words_per_sec", "words/s"),
+    ("w2v_1m_pipeline", "w2v_1m_pipeline", "words_per_sec", "words/s"),
     ("w2v_text8_epoch_wall", "w2v_text8", "epoch_wall_s", "s"),
     ("w2v_100m_epoch_wall", "w2v_100m", "epoch_wall_s", "s"),
     ("transformer_lm", "tfm", "tokens_per_sec", "tokens/s"),

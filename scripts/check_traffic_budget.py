@@ -14,11 +14,17 @@ tolerance:
 
 Traffic metrics are lower-is-better wire/dispatch counters
 (``wire_bytes_per_step``, ``dispatches_per_step``,
-``dispatches_per_window``); cells without them (pure throughput cells)
-are skipped.  Exit codes: 0 within budget, 1 regression, 2 usage /
-unreadable input.  ``scripts/run_tier1.sh`` runs this advisorily when
-``BENCH_BASELINE``/``BENCH_CANDIDATE`` point at files — the tier-1
-verdict stays pytest's, but the regression is printed next to it.
+``dispatches_per_window``) plus the input pipeline's host-stall split
+(``stall_ms_per_step`` — the number the asynchronous input pipeline
+exists to hold at ~0); cells without them (pure throughput cells) are
+skipped.  Timing metrics carry an absolute noise floor: a stall
+"regression" of 60µs/step is scheduler jitter, not a lost overlap, so
+the gate only fires when the increase clears BOTH the relative
+tolerance and the floor.  Exit codes: 0 within budget, 1 regression,
+2 usage / unreadable input.  ``scripts/run_tier1.sh`` runs this
+advisorily when ``BENCH_BASELINE``/``BENCH_CANDIDATE`` point at files —
+the tier-1 verdict stays pytest's, but the regression is printed next
+to it.
 """
 
 from __future__ import annotations
@@ -30,9 +36,14 @@ import sys
 #: lower-is-better counters the budget covers, with the detail fields
 #: printed for context when a covered cell is reported
 TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
-                   "dispatches_per_window")
+                   "dispatches_per_window", "stall_ms_per_step")
 DETAIL_METRICS = ("window_sparse", "window_dense", "coalesce_ratio",
-                  "push_window")
+                  "push_window", "host_stall_ms", "queue_depth",
+                  "pipeline", "speedup_vs_off")
+#: absolute increase a metric must clear before it can regress: wall-
+#: clock metrics jitter run to run while the counter metrics are exact,
+#: so only the former get a floor (ms for the stall split)
+ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1}
 
 
 def load_cells(path: str) -> dict:
@@ -63,7 +74,13 @@ def compare(base: dict, cand: dict, tolerance: float,
             if b is None or c is None:
                 continue
             b, c = float(b), float(c)
+            if c - b <= ABS_NOISE_FLOOR.get(metric, 0.0):
+                continue
             if b <= 0:
+                # a zero baseline (e.g. a pre-staged cell's stall, or a
+                # pipelined stall measured at ~0) regresses on ANY
+                # above-floor increase — rel change is undefined there
+                regressions.append((cell, metric, b, c, float("inf")))
                 continue
             rel = (c - b) / b
             if rel > tolerance:

@@ -4,8 +4,9 @@
 # lines) and exits with pytest's return code.
 set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu python -m pytest tests/ -q -m 'not slow' --continue-on-collection-errors -p no:cacheprovider -p no:xdist -p no:randomly 2>&1 | tee /tmp/_t1.log; rc=${PIPESTATUS[0]}; echo DOTS_PASSED=$(grep -aE '^[.FEsx]+( *\[ *[0-9]+%\])?$' /tmp/_t1.log | tr -cd . | wc -c)
 # Advisory traffic-budget check: when both env vars name readable bench
-# JSONs, report wire_bytes/dispatches regressions next to the verdict
-# without changing the tier-1 exit code.
+# JSONs, report wire_bytes/dispatches regressions — and input-pipeline
+# stall_ms_per_step regressions past the absolute noise floor — next to
+# the verdict without changing the tier-1 exit code.
 if [ -n "$BENCH_BASELINE" ] && [ -n "$BENCH_CANDIDATE" ] && [ -r "$BENCH_BASELINE" ] && [ -r "$BENCH_CANDIDATE" ]; then
   echo "--- traffic budget (advisory) ---"
   python "$(dirname "$0")/check_traffic_budget.py" "$BENCH_BASELINE" "$BENCH_CANDIDATE" || echo "traffic budget ADVISORY FAILURE (tier-1 verdict unchanged)"

@@ -272,6 +272,16 @@ class PrefetchingCBOWBatcher(NativeCBOWBatcher):
         finally:
             lib.smtpu_prefetcher_free(p)
 
+    def epoch_stencil(self, batch_size: int) -> Iterator[StencilBatch]:
+        """The C++ prefetch executor covers only the per-pair wire
+        format; the stencil epoch gets the same overlap through the
+        Python-thread pipeline (io/pipeline.py) over the synchronous
+        native iterator — wire format and batch order unchanged."""
+        from swiftmpi_tpu.io.pipeline import PrefetchIterator
+        return PrefetchIterator(super().epoch_stencil(batch_size),
+                                depth=self.depth,
+                                name="native-stencil-prefetch")
+
 
 # ---- libSVM (io.cpp) ------------------------------------------------------
 

@@ -323,6 +323,26 @@ class CBOWBatcher:
         if nc:
             yield flush()
 
+    def epoch_prefetch(self, batch_size: int, depth: int = 4
+                       ) -> Iterator[CBOWBatch]:
+        """:meth:`epoch` through a background producer thread
+        (io/pipeline.py): rendering runs ``depth`` batches ahead while
+        the consumer computes.  Batch order and rng consumption are
+        identical to the synchronous epoch — the producer just runs
+        the same generator earlier."""
+        from swiftmpi_tpu.io.pipeline import PrefetchIterator
+        return PrefetchIterator(self.epoch(batch_size), depth=depth,
+                                name="cbow-epoch-prefetch")
+
+    def epoch_stencil_prefetch(self, batch_size: int, depth: int = 4
+                               ) -> Iterator[StencilBatch]:
+        """:meth:`epoch_stencil` through the same background producer
+        (identical wire format and order)."""
+        from swiftmpi_tpu.io.pipeline import PrefetchIterator
+        return PrefetchIterator(self.epoch_stencil(batch_size),
+                                depth=depth,
+                                name="cbow-stencil-prefetch")
+
 
 def synthetic_corpus(n_sentences: int, vocab_size: int, length: int = 20,
                      seed: int = 0, zipf: float = 1.2) -> List[List[int]]:

@@ -1,6 +1,9 @@
-"""Checkpoint I/O + resilience: text dumps, binary resume, elastic reshard,
-CRC-validated crash-safe checkpoints with a retained last-k window."""
+"""Checkpoint I/O + resilience + input pipeline: text dumps, binary
+resume, elastic reshard, CRC-validated crash-safe checkpoints with a
+retained last-k window, and the asynchronous prefetch pipeline."""
 
+from swiftmpi_tpu.io.pipeline import (PipelineError, PrefetchIterator,
+                                      device_put_transfer)
 from swiftmpi_tpu.io.checkpoint import (CheckpointCorruptError, atomic_savez,
                                         default_formatter, default_parser,
                                         dump_table_text,
@@ -14,4 +17,5 @@ __all__ = ["CheckpointCorruptError", "atomic_savez", "default_formatter",
            "default_parser", "dump_table_text",
            "find_latest_valid_checkpoint", "load_checkpoint",
            "load_table_text", "save_checkpoint", "verify_checkpoint",
-           "load_checkpoint_elastic", "train_with_resume"]
+           "load_checkpoint_elastic", "train_with_resume",
+           "PipelineError", "PrefetchIterator", "device_put_transfer"]

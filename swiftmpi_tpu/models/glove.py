@@ -118,6 +118,13 @@ class GloVe:
         lr = g("glove", "learning_rate", 0.05).to_float()
         self.minibatch = g("glove", "minibatch", 4096).to_int32()
         self.inner_steps = g("worker", "inner_steps", 1).to_int32()
+        # [worker] pipeline / dispatch_depth: same knobs as word2vec —
+        # K > 0 stages+transfers groups on a producer thread
+        # (io/pipeline.py); epoch permutations are still drawn on the
+        # consumer thread in epoch order, so results are identical
+        self.pipeline_depth = g("worker", "pipeline", 0).to_int32()
+        self.dispatch_depth = g("worker", "dispatch_depth",
+                                "auto").to_string()
         self.cluster = cluster or Cluster(self.config).initialize()
         self.access = glove_access(lr, self.len_vec)
         self.transfer = self.cluster.transfer
@@ -128,6 +135,9 @@ class GloVe:
         self._slot_of_vocab = None
         self._coo = None
         self._step = None
+        # per-train() observability: stall/device time split (+ the
+        # pipeline depth the run actually used) — see utils.timers
+        self.train_metrics: dict = {}
 
     # -- build: vocab + co-occurrence + table ------------------------------
     def build(self, sentences) -> "GloVe":
@@ -180,22 +190,27 @@ class GloVe:
         return jax.jit(multi, donate_argnums=(0,))
 
     # -- minibatch staging -------------------------------------------------
-    def stage(self, sel: np.ndarray, inner: int, B: int):
-        """COO selection -> device-ready ``(fs, cs, logx, fx)`` stacks
-        of shape (inner, B): the ONE definition of slot mapping and the
+    def stage_host(self, sel: np.ndarray, inner: int, B: int):
+        """COO selection -> host ``(fs, cs, logx, fx)`` stacks of shape
+        (inner, B): the ONE definition of slot mapping and the
         f(x) = min((x/x_max)^alpha, 1) weighting, shared by train() and
-        the benchmark cell so a weighting change can't silently fork."""
+        the benchmark cell so a weighting change can't silently fork.
+        Pure numpy — this is what the input pipeline's producer thread
+        runs off the critical path."""
         fi, ci, x = self._coo
         sov = np.asarray(self._slot_of_vocab)
         sel = np.resize(sel, inner * B)
         xs = x[sel]
-        fs = jnp.asarray(sov[fi[sel]].reshape(inner, B))
-        cs = jnp.asarray(sov[ci[sel]].reshape(inner, B))
-        lx = jnp.asarray(np.log(xs).reshape(inner, B))
-        fw = jnp.asarray(np.minimum((xs / self.x_max) ** self.alpha,
-                                    1.0).astype(np.float32)
-                         .reshape(inner, B))
-        return fs, cs, lx, fw
+        return (sov[fi[sel]].reshape(inner, B),
+                sov[ci[sel]].reshape(inner, B),
+                np.log(xs).reshape(inner, B),
+                np.minimum((xs / self.x_max) ** self.alpha,
+                           1.0).astype(np.float32).reshape(inner, B))
+
+    def stage(self, sel: np.ndarray, inner: int, B: int):
+        """Device-side ``stage_host`` (kept as the bench cell's API)."""
+        return tuple(jnp.asarray(f)
+                     for f in self.stage_host(sel, inner, B))
 
     # -- training ----------------------------------------------------------
     def train(self, sentences=None, niters: int = 1) -> List[float]:
@@ -213,31 +228,71 @@ class GloVe:
         rng = np.random.default_rng(self.seed)
         state = self.table.state
         losses = []
+        from swiftmpi_tpu.utils.timers import Throughput
+        meter = Throughput()
+        transfer_fn = None
+        if self.pipeline_depth > 0:
+            from swiftmpi_tpu.io.pipeline import device_put_transfer
+            sharding = jax.sharding.NamedSharding(
+                self.cluster.mesh, jax.sharding.PartitionSpec())
+            transfer_fn = device_put_transfer(sharding)
+
+        def staged_groups(order):
+            # the epoch permutation was already drawn (consumer thread,
+            # epoch order) — from here on the staging is pure numpy, so
+            # it can run ahead on the producer thread
+            for gstart in range(0, len(order), B * inner):
+                yield self.stage_host(order[gstart:gstart + B * inner],
+                                      inner, B)
+
         for it in range(niters):
             order = rng.permutation(n)
             # pad the tail by CYCLING the permutation (static shapes,
-            # via stage()'s np.resize — holds even when one fused
+            # via stage_host()'s np.resize — holds even when one fused
             # group exceeds n); repeats are extra stochastic samples
             # of real cells, and per-slot mean normalization keeps
             # their scale right
             n_groups = -(-n // (B * inner))
             order = np.resize(order, n_groups * B * inner)
             total = 0.0
-            for gstart in range(0, len(order), B * inner):
-                sel = order[gstart:gstart + B * inner]
-                state, loss = self._step(state,
-                                         *self.stage(sel, inner, B))
-                # the step donates the state buffers: reassign NOW, not
-                # after the loop, or an exception mid-epoch (staging
-                # error, KeyboardInterrupt) leaves self.table.state
-                # pointing at donated/deleted device buffers and a
-                # previously valid model can no longer save()
-                # (round-3 advisor)
-                self.table.state = state
-                total += float(loss)
+            groups = staged_groups(order)
+            pipe = None
+            if self.pipeline_depth > 0:
+                from swiftmpi_tpu.io.pipeline import PrefetchIterator
+                pipe = PrefetchIterator(groups,
+                                        depth=self.pipeline_depth,
+                                        transfer=transfer_fn)
+                groups = pipe
+            try:
+                groups = iter(groups)
+                while True:
+                    with meter.stalling():
+                        fields = next(groups, None)
+                    if fields is None:
+                        break
+                    state, loss = self._step(
+                        state, *(jnp.asarray(f) if not isinstance(
+                            f, jax.Array) else f for f in fields))
+                    # the step donates the state buffers: reassign NOW,
+                    # not after the loop, or an exception mid-epoch
+                    # (staging error, KeyboardInterrupt) leaves
+                    # self.table.state pointing at donated/deleted
+                    # device buffers and a previously valid model can
+                    # no longer save() (round-3 advisor)
+                    self.table.state = state
+                    total += float(loss)
+                    meter.record(B * inner)
+            finally:
+                if pipe is not None:
+                    pipe.close()
             mean_loss = total / len(order)
             losses.append(mean_loss)
             log.info("glove iter %d: %d cells  loss %.6f", it, n, mean_loss)
+        self.train_metrics = {
+            "host_stall_ms": meter.host_stall_ms(),
+            "device_ms": meter.device_ms(),
+            "stall_ms_per_step": meter.stall_ms_per_step(),
+            "pipeline_depth": self.pipeline_depth}
         return losses
 
     # -- outputs -----------------------------------------------------------

@@ -40,6 +40,9 @@ from swiftmpi_tpu.testing import faults
 from swiftmpi_tpu.models.transformer import (TransformerConfig, init_params,
                                              lm_loss, param_shardings)
 from swiftmpi_tpu.utils.logger import get_logger
+from swiftmpi_tpu.utils.pipeline import (DispatchWindow,
+                                         resolve_dispatch_bound)
+from swiftmpi_tpu.utils.timers import Throughput
 
 log = get_logger(__name__)
 
@@ -88,8 +91,16 @@ class Trainer:
         self.aux_weight = aux_weight
         self._step_fn = None
         # host-side step counter for the fault/observability bus: the
-        # device-side state.step would cost a sync per step to read
+        # device-side state.step would cost a sync per step to read.
+        # Counts CONSUMED steps — with the input pipeline on, batches a
+        # producer has rendered but the loop has not dispatched yet do
+        # not advance it, so fault plans and the hang watchdog keep
+        # their step semantics
         self._host_steps = 0
+        # host-stall vs device-time split: step() books its token
+        # reshard (the H2D transfer the pipeline hides) as stall
+        self.meter = Throughput()
+        self.pipeline_stats: dict = {}
 
     # -- state ------------------------------------------------------------
     def init_state(self, key) -> TrainState:
@@ -160,18 +171,69 @@ class Trainer:
             want = NamedSharding(self.mesh, P("data", None))
             if not (isinstance(tokens, jax.Array)
                     and tokens.sharding == want):
-                # reshard whatever we got so dp is never silently dropped;
-                # multi-process: host tokens are this process's LOCAL rows
-                # of the global batch (device_put would wrongly assume the
-                # same full value on every host)
-                if jax.process_count() > 1:
-                    tokens = jax.make_array_from_process_local_data(
-                        want, np.asarray(tokens))
-                else:
-                    tokens = jax.device_put(jnp.asarray(tokens), want)
+                # reshard whatever we got so dp is never silently
+                # dropped; booked as HOST STALL — this is the H2D
+                # transfer run() hides by pre-transferring on the
+                # producer thread (pre-transferred tokens skip this
+                # branch entirely).  Multi-process: host tokens are
+                # this process's LOCAL rows of the global batch
+                # (device_put would wrongly assume the same full value
+                # on every host)
+                with self.meter.stalling():
+                    if jax.process_count() > 1:
+                        tokens = jax.make_array_from_process_local_data(
+                            want, np.asarray(tokens))
+                    else:
+                        tokens = jax.device_put(jnp.asarray(tokens), want)
         params, opt_state, step, loss = self._step_fn(
             state.params, state.opt_state, state.step, tokens)
+        self.meter.record(int(np.prod(tokens.shape)))
         return TrainState(params, opt_state, step), loss
+
+    def run(self, state: TrainState, batches, pipeline: int = 0,
+            dispatch_depth="auto") -> Tuple[TrainState, list]:
+        """Consume an iterable of host token batches through ``step``.
+
+        ``pipeline=K`` (single-process, meshed) prefetches K batches on
+        a producer thread and eagerly ``device_put``s them with the
+        step's committed ``P("data", None)`` input sharding, so H2D DMA
+        overlaps the previous step's compute and ``step``'s reshard
+        branch is skipped.  Loss scalars stay on device; a
+        ``DispatchWindow`` (``dispatch_depth`` watermark) keeps the
+        number of in-flight donated steps bounded.  Batch order and
+        values are untouched, so ``pipeline=0`` is bit-identical.
+        Returns ``(state, losses)`` with ``losses`` still device
+        scalars — ``float()`` them after the epoch, not per step.
+        """
+        pipelined = (pipeline > 0 and self.mesh is not None
+                     and jax.process_count() == 1)
+        window = DispatchWindow(
+            resolve_dispatch_bound(dispatch_depth, pipelined=pipelined))
+        pipe = None
+        it = batches
+        if pipelined:
+            from swiftmpi_tpu.io.pipeline import (PrefetchIterator,
+                                                  device_put_transfer)
+            want = NamedSharding(self.mesh, P("data", None))
+            pipe = PrefetchIterator(it, depth=pipeline,
+                                    transfer=device_put_transfer(want))
+            it = pipe
+        losses = []
+        try:
+            it = iter(it)
+            while True:
+                with self.meter.stalling():
+                    tokens = next(it, None)
+                if tokens is None:
+                    break
+                state, loss = self.step(state, tokens)
+                losses.append(loss)
+                window.push(loss)
+        finally:
+            if pipe is not None:
+                pipe.close()
+                self.pipeline_stats = pipe.stats()
+        return state, losses
 
     # -- checkpoints (multihost-safe, atomic, CRC-validated) ---------------
     def save(self, state: TrainState, path: str, retain: int = 1) -> None:

@@ -59,7 +59,8 @@ from swiftmpi_tpu.testing import faults
 from swiftmpi_tpu.transfer import PushSpec
 from swiftmpi_tpu.utils.config import ConfigParser, global_config
 from swiftmpi_tpu.utils.logger import get_logger
-from swiftmpi_tpu.utils.pipeline import DispatchWindow
+from swiftmpi_tpu.utils.pipeline import (DispatchWindow,
+                                         resolve_dispatch_bound)
 from swiftmpi_tpu.utils.timers import Throughput
 
 log = get_logger(__name__)
@@ -83,19 +84,30 @@ class _LossAccum:
     ``bound`` feeds a utils.pipeline.DispatchWindow (default "auto":
     bound the async pipeline only on the emulated cpu mesh, where
     unbounded in-flight sharded programs CHECK-abort at collective
-    rendezvous — see that module's docstring for the failure mode)."""
+    rendezvous — see that module's docstring for the failure mode).
+
+    ``fold`` is the retention bound: the queue never holds more than
+    ``fold`` device scalars (an epoch of 10k tiny batches retains at
+    most ``fold``, not 10k — ``peak_queued`` makes that checkable).
+    The drain itself is non-blocking: the stacked-sum is just another
+    async dispatch."""
 
     _FOLD = 256
 
-    def __init__(self, bound="auto"):
+    def __init__(self, bound="auto", fold: int = _FOLD):
+        if fold < 2:
+            raise ValueError(f"_LossAccum fold must be >= 2, got {fold}")
         self._q = []
+        self._fold = fold
+        self.peak_queued = 0
         self._window = DispatchWindow(bound)
 
     def add(self, x) -> None:
         x = jnp.asarray(x, jnp.float32)
         self._q.append(x)
         self._window.push(x)
-        if len(self._q) >= self._FOLD:
+        self.peak_queued = max(self.peak_queued, len(self._q))
+        if len(self._q) >= self._fold:
             self._q = [jnp.stack(self._q).sum()]
 
     def total(self) -> float:
@@ -108,24 +120,33 @@ class _LossAccum:
         return float(jnp.stack(self._q).sum())
 
 
-def _stack_group(batches):
+def _stack_group_host(batches):
     """Stack a group of same-shape batches host-side (one contiguous H2D
-    transfer per field, not one per batch)."""
-    c = jnp.asarray(np.stack([np.asarray(b.centers) for b in batches]))
-    x = jnp.asarray(np.stack([np.asarray(b.contexts) for b in batches]))
-    m = jnp.asarray(np.stack([np.asarray(b.ctx_mask) for b in batches]))
-    return c, x, m
+    transfer per field, not one per batch).  Pure numpy — this is the
+    rendering work the input pipeline's producer thread runs off the
+    critical path."""
+    return (np.stack([np.asarray(b.centers) for b in batches]),
+            np.stack([np.asarray(b.contexts) for b in batches]),
+            np.stack([np.asarray(b.ctx_mask) for b in batches]))
+
+
+def _stack_group_host_stencil(batches):
+    """StencilBatch variant of ``_stack_group_host``.  Every stencil
+    batch is fixed-shape (span and center arrays are padded, only
+    ``n_words`` varies), so even epoch tails stack and fuse."""
+    return (np.stack([np.asarray(b.tokens) for b in batches]),
+            np.stack([np.asarray(b.sent_id) for b in batches]),
+            np.stack([np.asarray(b.center_pos) for b in batches]),
+            np.stack([np.asarray(b.half) for b in batches]))
+
+
+def _stack_group(batches):
+    return tuple(jnp.asarray(f) for f in _stack_group_host(batches))
 
 
 def _stack_group_stencil(batches):
-    """StencilBatch variant of ``_stack_group``.  Every stencil batch is
-    fixed-shape (span and center arrays are padded, only ``n_words``
-    varies), so even epoch tails stack and fuse."""
-    t = jnp.asarray(np.stack([np.asarray(b.tokens) for b in batches]))
-    s = jnp.asarray(np.stack([np.asarray(b.sent_id) for b in batches]))
-    c = jnp.asarray(np.stack([np.asarray(b.center_pos) for b in batches]))
-    h = jnp.asarray(np.stack([np.asarray(b.half) for b in batches]))
-    return t, s, c, h
+    return tuple(jnp.asarray(f)
+                 for f in _stack_group_host_stencil(batches))
 
 
 def _cbow_targets(slot_of_vocab, alias_prob, alias_idx, centers,
@@ -236,6 +257,21 @@ class Word2Vec:
         self.push_window_size = g("cluster", "push_window", 1).to_int32()
         if self.push_window_size < 1:
             raise ValueError("[cluster] push_window must be >= 1")
+        # [worker] pipeline: K > 0 turns on the asynchronous input
+        # pipeline (io/pipeline.py) — a producer thread renders batches
+        # K ahead and eagerly device_puts them so H2D overlaps compute.
+        # 0 (default) keeps the synchronous loop bit-identically: the
+        # producer owns no RNG and preserves batch order, so K only
+        # changes WHEN work happens, never what is computed.
+        self.pipeline_depth = g("worker", "pipeline", 0).to_int32()
+        if self.pipeline_depth < 0:
+            raise ValueError("[worker] pipeline must be >= 0")
+        # [worker] dispatch_depth: in-flight dispatch watermark
+        # (utils.pipeline.resolve_dispatch_bound).  "auto" = backend
+        # policy, tightened to a finite bound whenever the pipeline is
+        # on; an integer forces it; 0 = unbounded.
+        self.dispatch_depth = g("worker", "dispatch_depth",
+                                "auto").to_string()
         self.local_steps = g("word2vec", "local_steps", 1).to_int32()
         # "" /"snapshot" (bounded-staleness via local_steps) / "hogwild"
         # (genuinely unsynchronized per-device replicas, see
@@ -1276,6 +1312,51 @@ class Word2Vec:
         return apply_fn
 
     # -- training (word2vec.h:475-547) -------------------------------------
+    def _epoch_items(self, batcher, batch_size: int, stencil: bool,
+                     fuse: bool):
+        """Render one epoch into a stream of work items: ``('group',
+        host-stacked fields, [n_words...])`` for fuse groups and
+        ``('single', fields, n_words)`` otherwise.  Pure host-side
+        rendering — NO RNG (key splits stay with the consumer, in
+        consumption order) and no device calls — so the stream is
+        identical whether it is consumed inline or through the
+        prefetch pipeline: the determinism contract of
+        ``[worker] pipeline``."""
+        inner = self.inner_steps
+        group = []
+
+        def group_item():
+            n_words = [b.n_words for b in group]
+            fields = (_stack_group_host_stencil(group) if stencil
+                      else _stack_group_host(group))
+            return ("group", fields, n_words)
+
+        epoch_iter = (batcher.epoch_stencil(batch_size) if stencil
+                      else batcher.epoch(batch_size))
+        for batch in epoch_iter:
+            # every stencil batch is fixed-shape (padded span), so all
+            # of them group-fuse, tails included
+            if fuse and (stencil or len(batch.centers) == batch_size):
+                group.append(batch)
+                if len(group) == inner:
+                    yield group_item()
+                    group = []
+                continue
+            # odd-shaped batch: flush pending fused batches first so
+            # the update order matches the unfused loop
+            if group:
+                yield group_item()
+                group = []
+            if stencil:
+                fields = (batch.tokens, batch.sent_id,
+                          batch.center_pos, batch.half)
+            else:
+                fields = (batch.centers, batch.contexts,
+                          batch.ctx_mask)
+            yield ("single", fields, batch.n_words)
+        if group:                  # leftover partial group
+            yield group_item()
+
     def train(self, data=None, niters: int = 1,
               batch_size: Optional[int] = None,
               checkpoint_path: Optional[str] = None,
@@ -1381,6 +1462,36 @@ class Word2Vec:
         meter = Throughput()
         step_i = 0
         hogwild_dropped = 0
+        # -- input pipeline setup (tentpole: prefetch-rendered,
+        # pre-transferred batches).  The producer is gated to paths
+        # where it can own rendering wholesale: hogwild does its own
+        # grouping, and multi-process batches are global jax.Arrays
+        # already placed by DistributedBatcher.
+        pipelined = (self.pipeline_depth > 0 and not hogwild
+                     and nprocs == 1)
+        if self.pipeline_depth > 0 and not pipelined:
+            log.warning(
+                "[worker] pipeline=%d requested but %s — running the "
+                "synchronous input loop", self.pipeline_depth,
+                "hogwild groups its own batches" if hogwild
+                else "multi-process batches are already-placed global "
+                     "arrays")
+        dispatch_bound = resolve_dispatch_bound(self.dispatch_depth,
+                                                pipelined=pipelined)
+        transfer_fn = None
+        pipe_stats = None
+        if pipelined:
+            from swiftmpi_tpu.io.pipeline import (PrefetchIterator,
+                                                  device_put_transfer)
+            # committed replicated input sharding, captured HERE on the
+            # consumer thread: jax.default_device is thread-local and
+            # must never be consulted by the producer
+            input_sharding = jax.sharding.NamedSharding(
+                self.cluster.mesh, jax.sharding.PartitionSpec())
+            transfer_fn = device_put_transfer(input_sharding)
+            pipe_stats = {"produced": 0, "consumed": 0,
+                          "peak_queue_depth": 0, "stall_s": 0.0,
+                          "transfer_s": 0.0}
         for it in range(niters):
             # global step: cumulative across resumed runs, so a fault
             # plan's crash-at-step-k means "after k completed steps"
@@ -1400,23 +1511,14 @@ class Word2Vec:
                 # an on-device int32 accumulator would wrap at ~2.1e9
                 # target pairs, i.e. exactly the corpus sizes this
                 # optimization targets.
-                es_q, ec_q = _LossAccum(), _LossAccum(None)
-                group = []
+                es_q, ec_q = _LossAccum(dispatch_bound), _LossAccum(None)
 
-                def run_single(batch):
+                def run_single(fields, n_words):
                     nonlocal state, frozen, step_i
                     self._key, sub = jax.random.split(self._key)
-                    if stencil:
-                        args = (self._slot_of_vocab, self._alias_prob,
-                                self._alias_idx, _dev(batch.tokens),
-                                _dev(batch.sent_id),
-                                _dev(batch.center_pos),
-                                _dev(batch.half), sub)
-                    else:
-                        args = (self._slot_of_vocab, self._alias_prob,
-                                self._alias_idx, _dev(batch.centers),
-                                _dev(batch.contexts),
-                                _dev(batch.ctx_mask), sub)
+                    args = (self._slot_of_vocab, self._alias_prob,
+                            self._alias_idx,
+                            *(_dev(f) for f in fields), sub)
                     if sync:
                         state, es, ec = self._step(state, *args)
                         # the step donates (deletes) the input state
@@ -1439,9 +1541,9 @@ class Word2Vec:
                             frozen = state
                     es_q.add(es)
                     ec_q.add(ec)
-                    meter.record(batch.n_words)
+                    meter.record(n_words)
 
-                def run_group():
+                def run_group(fields, n_words):
                     # update ORDER is preserved either way: a group runs
                     # its batches sequentially inside one scan dispatch.
                     # Partial groups (the epoch tail) fuse too, via the
@@ -1450,46 +1552,64 @@ class Word2Vec:
                     # one-by-one pays ~5ms tunnel latency each (round-3
                     # verdict Weak #4).  A lone batch uses the already-
                     # compiled single step.
-                    nonlocal state, group
-                    fused = self._fused_for(len(group)) \
-                        if len(group) > 1 else None
+                    nonlocal state
+                    L = len(n_words)
+                    fused = self._fused_for(L) if L > 1 else None
                     if fused is None:
                         # lone batch, or an uncached tail length while
-                        # tail-fuse compiles are frozen (timed regions)
-                        for gb in group:
-                            run_single(gb)
-                        group = []
+                        # tail-fuse compiles are frozen (timed regions):
+                        # peel the stacked fields back into singles —
+                        # the producer never needs to know compile-cache
+                        # state, so the item stream stays deterministic
+                        for i in range(L):
+                            run_single(tuple(f[i] for f in fields),
+                                       n_words[i])
                         return
                     self._key, sub = jax.random.split(self._key)
-                    stacked = _stack_group_stencil(group) if stencil \
-                        else _stack_group(group)
                     state, es, ec = fused(
                         state, self._slot_of_vocab, self._alias_prob,
-                        self._alias_idx, *stacked, sub)
+                        self._alias_idx,
+                        *(_dev(f) for f in fields), sub)
                     self.table.state = state
                     es_q.add(es)
                     ec_q.add(ec)
-                    meter.record(sum(b.n_words for b in group))
-                    group = []
+                    # a fused group is ONE dispatch but L train steps;
+                    # stall_ms_per_step stays per-step across fuse modes
+                    meter.record(sum(n_words), steps=L)
 
-                epoch_iter = (batcher.epoch_stencil(batch_size)
-                              if stencil else batcher.epoch(batch_size))
-                for batch in epoch_iter:
-                    # every stencil batch is fixed-shape (padded span),
-                    # so all of them group-fuse, tails included
-                    if fuse and (stencil
-                                 or len(batch.centers) == batch_size):
-                        group.append(batch)
-                        if len(group) == self.inner_steps:
-                            run_group()
-                        continue
-                    # odd-shaped batch: flush pending fused batches
-                    # first so the update order matches the unfused loop
-                    if group:
-                        run_group()
-                    run_single(batch)
-                if group:                  # leftover partial group
-                    run_group()
+                items = self._epoch_items(batcher, batch_size, stencil,
+                                          fuse)
+                pipe = None
+                if pipelined:
+                    pipe = PrefetchIterator(
+                        items, depth=self.pipeline_depth,
+                        transfer=transfer_fn)
+                    items = pipe
+                try:
+                    items = iter(items)
+                    while True:
+                        # the stall clock covers exactly the input
+                        # wait: inline it times rendering + stacking,
+                        # pipelined it times empty-queue waits — one
+                        # meter for both, so host_stall_ms is directly
+                        # comparable across the two modes
+                        with meter.stalling():
+                            nxt = next(items, None)
+                        if nxt is None:
+                            break
+                        kind, fields, n_words = nxt
+                        if kind == "group":
+                            run_group(fields, n_words)
+                        else:
+                            run_single(fields, n_words)
+                finally:
+                    if pipe is not None:
+                        pipe.close()
+                        for k, v in pipe.stats().items():
+                            if k == "peak_queue_depth":
+                                pipe_stats[k] = max(pipe_stats[k], v)
+                            elif k != "depth":
+                                pipe_stats[k] += v
                 err_sum = es_q.total()
                 err_cnt = int(round(ec_q.total()))
             loss = err_sum / max(err_cnt, 1)
@@ -1514,7 +1634,16 @@ class Word2Vec:
         # hogwild drop bound is testable and the hybrid backend's
         # traffic counters ride along for bench detail fields
         self.train_metrics = {
-            "hogwild_skipped_tail_words": hogwild_dropped}
+            "hogwild_skipped_tail_words": hogwild_dropped,
+            # host-stall vs device-time split (utils.timers.Throughput):
+            # which side of the step loop is the bottleneck
+            "host_stall_ms": meter.host_stall_ms(),
+            "device_ms": meter.device_ms(),
+            "stall_ms_per_step": meter.stall_ms_per_step(),
+            "words_per_sec": meter.rate(),
+            "pipeline_depth": self.pipeline_depth if pipelined else 0}
+        if pipe_stats is not None:
+            self.train_metrics["pipeline"] = dict(pipe_stats)
         if hasattr(self.transfer, "traffic"):
             self.train_metrics["transfer_traffic"] = \
                 self.transfer.traffic()
@@ -1557,7 +1686,7 @@ class Word2Vec:
             self.table.state = state
             es_q.add(es)
             ec_q.add(ec)
-            meter.record(sum(b.n_words for b in buf))
+            meter.record(sum(b.n_words for b in buf), steps=len(buf))
             buf = []
         if buf:
             dropped += sum(b.n_words for b in buf)

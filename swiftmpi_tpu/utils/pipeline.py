@@ -43,3 +43,22 @@ class DispatchWindow:
 
     def clear(self) -> None:
         self._window.clear()
+
+
+def resolve_dispatch_bound(depth: Union[str, int, None],
+                           pipelined: bool = False) -> Union[str, int, None]:
+    """Resolve the ``[worker] dispatch_depth`` knob into a
+    ``DispatchWindow`` bound.
+
+    ``"auto"`` keeps the backend policy above — EXCEPT when the input
+    pipeline is on: with prefetched batches the consumer can dispatch
+    as fast as it renders nothing, so without a finite watermark async
+    dispatch outruns HBM (every in-flight program pins its donated
+    state copy + inputs).  Pipelined ``"auto"`` therefore bounds every
+    backend at ``AUTO_BOUND``.  An explicit integer (or ``0`` meaning
+    unbounded) always wins.
+    """
+    if depth == "auto" or depth is None:
+        return AUTO_BOUND if pipelined else "auto"
+    depth = int(depth)
+    return None if depth <= 0 else depth

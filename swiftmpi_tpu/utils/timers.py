@@ -54,22 +54,78 @@ class Error:
 
 
 class Throughput:
-    """Cumulative items/sec meter since construction or last reset()."""
+    """Cumulative items/sec meter since construction or last reset().
+
+    A single wall-clock rate hides WHICH side of the step loop is the
+    bottleneck, so the meter also splits elapsed time into **host
+    stall** (time the consumer spent waiting on input — rendering, H2D
+    transfer, an empty prefetch queue; reported via ``add_stall`` /
+    the ``stalling()`` context manager) and **device time** (everything
+    else: dispatch + on-device compute).  ``stats()`` packages the
+    split for train metrics and bench detail fields.
+    """
 
     def __init__(self):
         self._items = 0
+        self._steps = 0
+        self._stall_s = 0.0
         self._timer = Timer()
 
-    def record(self, n: int) -> None:
+    def record(self, n: int, steps: int = 1) -> None:
         self._items += n
+        self._steps += steps
+
+    def add_stall(self, seconds: float) -> None:
+        """Account ``seconds`` of host-side input stall."""
+        self._stall_s += seconds
+
+    def stalling(self):
+        """Context manager timing a host-stall region::
+
+            with meter.stalling():
+                batch = next(batches)
+        """
+        return _StallScope(self)
 
     def rate(self) -> float:
         dt = self._timer.elapsed()
         return self._items / dt if dt > 0 else 0.0
 
+    def host_stall_ms(self) -> float:
+        return self._stall_s * 1e3
+
+    def device_ms(self) -> float:
+        """Elapsed wall-clock minus host stall, in ms (clamped at 0)."""
+        return max(0.0, self._timer.elapsed() - self._stall_s) * 1e3
+
+    def stall_ms_per_step(self) -> float:
+        return self.host_stall_ms() / self._steps if self._steps else 0.0
+
+    def stats(self) -> Dict[str, float]:
+        return {"items": float(self._items),
+                "steps": float(self._steps),
+                "rate": self.rate(),
+                "host_stall_ms": self.host_stall_ms(),
+                "device_ms": self.device_ms(),
+                "stall_ms_per_step": self.stall_ms_per_step()}
+
     def reset(self) -> None:
         self._items = 0
+        self._steps = 0
+        self._stall_s = 0.0
         self._timer.restart()
+
+
+class _StallScope:
+    def __init__(self, meter: Throughput):
+        self._meter = meter
+
+    def __enter__(self):
+        self._t0 = time.monotonic()
+        return self
+
+    def __exit__(self, *exc):
+        self._meter.add_stall(time.monotonic() - self._t0)
 
 
 class Metrics:
