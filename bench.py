@@ -213,6 +213,30 @@ def _timed_steps(step, state, args, timed_calls, key):
     return state, time.perf_counter() - t0, float(es)
 
 
+def _latency_probe(step, state, args, calls, key, n_inner):
+    """Tail-latency probe run AFTER the throughput loop: per-call fenced
+    timings through StepTimer, so cells report p50/p95/p99 per step, not
+    just the mean.  Kept out of _timed_steps' timed region on purpose —
+    the per-call _fence serializes dispatch, which the throughput number
+    must never pay (BASELINE comparability).  Returns
+    (final_state, {"step_ms_p50": ..., "step_ms_p95": ...,
+    "step_ms_p99": ...}) with per-step ms (call time / n_inner)."""
+    import jax
+    from swiftmpi_tpu.utils.profiler import StepTimer
+
+    timer = StepTimer()
+    for _ in range(calls):
+        key, sub = jax.random.split(key)
+        timer.start()
+        state, es, ec = step(state, *args, sub)
+        _fence(state, es)
+        timer.stop()
+    scale = 1e3 / max(n_inner, 1)
+    return state, {"step_ms_p50": timer.p50 * scale,
+                   "step_ms_p95": timer.p95 * scale,
+                   "step_ms_p99": timer.p99 * scale}
+
+
 def _build_w2v(device, w2v_overrides=None, inner_steps=None, batch=None):
     import jax
     import jax.numpy as jnp
@@ -302,12 +326,16 @@ def _bench_w2v(device, timed_calls, built=None, inner_steps=None):
         state, dt, loss = _timed_steps(
             step, state, (sov, ap, ai, centers, contexts, masks),
             timed_calls, jax.random.key(0))
+        state, lat = _latency_probe(
+            step, state, (sov, ap, ai, centers, contexts, masks),
+            min(timed_calls, 16), jax.random.key(1), n_inner)
         # the step donates (deletes) its input buffers — which may BE the
         # model's own (device_put to the same device is a no-op); repoint
         # the model at the live final state so later benches can reuse it
         model.table.state = state
     out = {"words_per_sec": words_per_call * timed_calls / dt,
            "step_ms": dt / (timed_calls * n_inner) * 1e3,
+           **lat,
            "loss": loss,
            # self-describing shape: reduced-batch comparator cells must
            # be distinguishable from full-shape cells by content
@@ -633,8 +661,12 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
                       model._alias_idx) + batch_args)
         state, dt, _ = _timed_steps(step, state, args, timed_calls,
                                     jax.random.key(0))
+        state, lat = _latency_probe(step, state, args,
+                                    min(timed_calls, 16),
+                                    jax.random.key(1), INNER_STEPS)
     out = {"words_per_sec": B * INNER_STEPS * timed_calls / dt,
            "step_ms": dt / (timed_calls * INNER_STEPS) * 1e3,
+           **lat,
            "vocab": V, "capacity": model.table.capacity,
            # self-describing: the fp32 and bf16 scale cells must be
            # distinguishable by content, not by stage/env metadata
@@ -649,8 +681,10 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
         out["transfer"] = "hybrid"
         out["hot_head_rows"] = model.table.n_hot
         tr = model.transfer.traffic()
-        # counters accumulate over warmup AND timed executions
-        steps = max((WARMUP_CALLS + timed_calls) * INNER_STEPS, 1)
+        # counters accumulate over warmup, timed AND latency-probe
+        # executions
+        steps = max((WARMUP_CALLS + timed_calls + min(timed_calls, 16))
+                    * INNER_STEPS, 1)
         out["routed_rows_per_step"] = round(tr["routed_rows"] / steps, 1)
         out["hot_rows_per_step"] = round(tr["hot_rows"] / steps, 1)
         out["psum_bytes_per_step"] = round(tr["psum_bytes"] / steps, 1)
@@ -662,7 +696,8 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
     if window_steps > 1:
         out["push_window"] = int(window_steps)
         tr = model.transfer.traffic()
-        steps = max((WARMUP_CALLS + timed_calls) * INNER_STEPS, 1)
+        steps = max((WARMUP_CALLS + timed_calls + min(timed_calls, 16))
+                    * INNER_STEPS, 1)
         windows = max(steps // window_steps, 1)
         # the acceptance ratio the window cell exists to report: push
         # exchanges per coalescing window (per-step cells sit at one

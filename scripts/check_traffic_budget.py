@@ -12,6 +12,15 @@ tolerance:
     python scripts/check_traffic_budget.py base.json cand.json \
         --tolerance 0.05 --cells w2v_1m_window,w2v_1m_hybrid
 
+Either side may also be a **telemetry JSONL** from a live run
+(``obs.StepRecorder`` output, schema ``smtpu-telemetry/1``): the stream
+is aggregated to one cell named after its run (``run=word2vec`` ->
+cell ``word2vec``) with the same per-step metrics, so a production
+run's wire traffic can be gated against a bench baseline — or against
+yesterday's run — with the identical tolerance logic::
+
+    python scripts/check_traffic_budget.py baseline.json telemetry.jsonl
+
 Traffic metrics are lower-is-better wire/dispatch counters
 (``wire_bytes_per_step``, ``dispatches_per_step``,
 ``dispatches_per_window``) plus the input pipeline's host-stall split
@@ -46,7 +55,48 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "coalesce_ratio",
 ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1}
 
 
+def load_telemetry_cells(path: str) -> dict:
+    """Aggregate a StepRecorder JSONL into one bench-shaped cell keyed
+    by the run name.  Counters are summed across backends (the gate
+    budgets the run's total wire, not the split) and normalized by the
+    recorded step count; window decision totals ride along as detail."""
+    from telemetry_report import load, traffic_summary
+
+    doc = load(path)     # SystemExit(2) on unreadable/bad schema
+    t = traffic_summary(doc)
+    steps = max(t["steps"], 1)
+    wire = sum(m.get("wire_bytes", 0.0) for m in t["transfer"].values())
+    disp = sum(m.get("dispatches", 0.0) for m in t["transfer"].values())
+    cell: dict = {}
+    if wire:
+        cell["wire_bytes_per_step"] = wire / steps
+    if disp:
+        cell["dispatches_per_step"] = disp / steps
+    if "stall_ms_per_step" in t:
+        cell["stall_ms_per_step"] = t["stall_ms_per_step"]
+    for decision in ("window_sparse", "window_dense"):
+        total = sum(m.get(decision, 0.0) for m in t["transfer"].values())
+        if total:
+            cell[decision] = total
+    run = str(doc["meta"].get("run", "telemetry"))
+    return {run: cell} if cell else {}
+
+
+def _is_telemetry(path: str) -> bool:
+    """Sniff the first line for the StepRecorder schema tag — content,
+    not file extension, decides (bench caches are also .json)."""
+    try:
+        with open(path) as f:
+            head = json.loads(f.readline() or "null")
+        return isinstance(head, dict) and str(
+            head.get("schema", "")).startswith("smtpu-telemetry/")
+    except (OSError, ValueError):
+        return False
+
+
 def load_cells(path: str) -> dict:
+    if _is_telemetry(path):
+        return load_telemetry_cells(path)
     try:
         with open(path) as f:
             doc = json.load(f)

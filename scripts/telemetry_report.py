@@ -1,0 +1,302 @@
+#!/usr/bin/env python
+"""Run analyzer over a telemetry JSONL file (obs.StepRecorder output).
+
+Turns the raw ``smtpu-telemetry/1`` stream into the three questions an
+operator actually asks after a run:
+
+* **Where did the time go?**  Per-phase latency breakdown — p50/p95/p99
+  milliseconds for every ``phase_ms{phase=...}`` histogram (render, h2d,
+  input_wait, dispatch, window_dedup, checkpoint_save, ...), recomputed
+  from the bucket counts so the report works on a crashed run with no
+  summary line.
+* **What did the wire format decide?**  The window-coalesced push picks
+  sparse vs dense per window by measured density
+  (transfer/window.py); the per-step ``transfer/window_*`` counter
+  deltas reconstruct that decision sequence as a compressed timeline
+  (``steps 0-39: sparse  steps 40-47: dense ...``) — the artifact to
+  read when wire bytes regress.
+* **How much traffic?**  Cumulative ``transfer/*`` counters per backend
+  with per-step averages, plus the host-stall split from the training
+  samplers.
+
+Usage::
+
+    python scripts/telemetry_report.py telemetry.jsonl
+    python scripts/telemetry_report.py telemetry.jsonl --json  # machine
+    python scripts/telemetry_report.py telemetry.jsonl --phases-only
+
+Exit codes: 0 ok, 2 unreadable/empty/not-telemetry input.  No repo
+imports on purpose — the file is copied off the worker host and
+analyzed where the package is not installed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from typing import Dict, List, Optional, Tuple
+
+SCHEMA_PREFIX = "smtpu-telemetry/"
+
+
+# -- series names ---------------------------------------------------------
+def parse_series_key(key: str) -> Tuple[str, Dict[str, str]]:
+    """``name{k=v,k2=v2}`` -> (name, labels).  Mirrors
+    obs/registry.series_key (sorted label order is the writer's job)."""
+    if "{" not in key:
+        return key, {}
+    name, _, rest = key.partition("{")
+    labels = {}
+    for part in rest.rstrip("}").split(","):
+        if part:
+            k, _, v = part.partition("=")
+            labels[k] = v
+    return name, labels
+
+
+def _quantile(counts: List[int], bounds: List[float], q: float) -> float:
+    """Interpolated quantile from cumulative-free bucket counts; same
+    rule as obs/registry.quantile_from_buckets (overflow bucket clamps
+    to the top finite edge)."""
+    total = sum(counts)
+    if total == 0:
+        return 0.0
+    rank = q * total
+    seen = 0.0
+    for i, c in enumerate(counts):
+        if c == 0:
+            continue
+        if seen + c >= rank:
+            lo = bounds[i - 1] if i > 0 else 0.0
+            hi = bounds[i] if i < len(bounds) else bounds[-1]
+            frac = (rank - seen) / c
+            return lo + (hi - lo) * min(max(frac, 0.0), 1.0)
+        seen += c
+    return bounds[-1] if bounds else 0.0
+
+
+# -- load -----------------------------------------------------------------
+def load(path: str) -> dict:
+    """Parse the JSONL into {"meta", "steps": [...], "summary"|None}.
+    SystemExit(2) on unreadable / non-telemetry input."""
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if ln.strip()]
+    except OSError as e:
+        print(f"telemetry_report: cannot read {path}: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if not lines:
+        print(f"telemetry_report: {path} is empty", file=sys.stderr)
+        raise SystemExit(2)
+    try:
+        head = json.loads(lines[0])
+    except ValueError as e:
+        print(f"telemetry_report: {path}: bad JSON on line 1: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if not str(head.get("schema", "")).startswith(SCHEMA_PREFIX):
+        print(f"telemetry_report: {path} is not a telemetry stream "
+              f"(schema={head.get('schema')!r})", file=sys.stderr)
+        raise SystemExit(2)
+    steps, summary = [], None
+    for n, ln in enumerate(lines[1:], start=2):
+        try:
+            rec = json.loads(ln)
+        except ValueError as e:
+            print(f"telemetry_report: {path}: bad JSON on line {n}: {e}",
+                  file=sys.stderr)
+            raise SystemExit(2)
+        kind = rec.get("kind")
+        if kind == "step":
+            steps.append(rec)
+        elif kind == "summary":
+            summary = rec
+    return {"meta": head, "steps": steps, "summary": summary}
+
+
+# -- analyses -------------------------------------------------------------
+def phase_table(doc: dict) -> List[dict]:
+    """Aggregate every histogram across step records (bounds are emitted
+    once per key, on first appearance) and compute quantiles.  Covers
+    phase_ms plus any other histogram (health/probe_ms, bench step_ms)."""
+    acc: Dict[str, dict] = {}
+    for rec in doc["steps"]:
+        for key, h in (rec.get("hists") or {}).items():
+            a = acc.setdefault(key, {"counts": None, "bounds": None,
+                                     "n": 0, "sum": 0.0})
+            if h.get("bounds") is not None:
+                a["bounds"] = list(h["bounds"])
+            counts = h.get("counts") or []
+            if a["counts"] is None:
+                a["counts"] = list(counts)
+            else:
+                for i, c in enumerate(counts):
+                    a["counts"][i] += c
+            a["n"] += int(h.get("n", 0))
+            a["sum"] += float(h.get("sum", 0.0))
+    rows = []
+    for key in sorted(acc):
+        a = acc[key]
+        if not a["n"] or a["bounds"] is None:
+            continue
+        name, labels = parse_series_key(key)
+        rows.append({
+            "series": key,
+            "phase": labels.get("phase", name),
+            "n": a["n"],
+            "mean_ms": a["sum"] / a["n"],
+            "p50_ms": _quantile(a["counts"], a["bounds"], 0.50),
+            "p95_ms": _quantile(a["counts"], a["bounds"], 0.95),
+            "p99_ms": _quantile(a["counts"], a["bounds"], 0.99),
+            "total_ms": a["sum"],
+        })
+    rows.sort(key=lambda r: -r["total_ms"])
+    return rows
+
+
+def wire_timeline(doc: dict) -> List[dict]:
+    """Per-step sparse/dense decision runs, compressed.  A step's
+    decision is whichever ``transfer/window_*`` counter moved in its
+    record (both can move when multiple windows close in one record —
+    then the step is labeled ``mixed``)."""
+    runs: List[dict] = []
+    for rec in doc["steps"]:
+        decisions = set()
+        for key, delta in (rec.get("counters") or {}).items():
+            name, _ = parse_series_key(key)
+            if name.startswith("transfer/window_") and delta > 0:
+                decisions.add(name[len("transfer/window_"):])
+        if not decisions:
+            continue
+        label = decisions.pop() if len(decisions) == 1 else "mixed"
+        step = int(rec["step"])
+        if runs and runs[-1]["decision"] == label \
+                and runs[-1]["last"] == step - int(rec.get("steps", 1)):
+            runs[-1]["last"] = step
+            runs[-1]["windows"] += 1
+        else:
+            runs.append({"decision": label, "first": step, "last": step,
+                         "windows": 1})
+    return runs
+
+
+def traffic_summary(doc: dict) -> dict:
+    """Cumulative counters (prefer the summary line's authoritative
+    totals; fall back to summing step deltas for a crashed run) grouped
+    as transfer-per-backend / train / everything-else."""
+    if doc["summary"] is not None:
+        totals = dict(doc["summary"].get("counters") or {})
+        steps = int(doc["summary"].get("steps", 0))
+    else:
+        totals = {}
+        steps = 0
+        for rec in doc["steps"]:
+            steps += int(rec.get("steps", 1))
+            for key, delta in (rec.get("counters") or {}).items():
+                totals[key] = totals.get(key, 0.0) + delta
+    transfer: Dict[str, dict] = {}
+    train, other = {}, {}
+    for key, total in sorted(totals.items()):
+        name, labels = parse_series_key(key)
+        if name.startswith("transfer/"):
+            backend = labels.get("backend", "?")
+            transfer.setdefault(backend, {})[
+                name[len("transfer/"):]] = total
+        elif name.startswith("train/"):
+            train[name[len("train/"):]] = total
+        else:
+            other[key] = total
+    out = {"steps": steps, "transfer": transfer, "train": train,
+           "other": other}
+    if steps:
+        out["per_step"] = {
+            b: {k: v / steps for k, v in m.items()}
+            for b, m in transfer.items()}
+        stall = train.get("host_stall_ms_total")
+        if stall is not None:
+            out["stall_ms_per_step"] = stall / steps
+    return out
+
+
+def report(doc: dict, phases_only: bool = False) -> dict:
+    out = {"meta": {k: doc["meta"].get(k)
+                    for k in ("schema", "run", "rank", "ident", "pid")},
+           "phases": phase_table(doc)}
+    if not phases_only:
+        out["wire_timeline"] = wire_timeline(doc)
+        out["traffic"] = traffic_summary(doc)
+    return out
+
+
+# -- rendering ------------------------------------------------------------
+def _print_report(rep: dict) -> None:
+    m = rep["meta"]
+    print(f"run={m.get('run')} ident={m.get('ident')} "
+          f"schema={m.get('schema')}")
+    print()
+    print("phase latency (ms):")
+    if not rep["phases"]:
+        print("  (no histograms recorded — telemetry off or no spans "
+              "crossed a step boundary)")
+    else:
+        w = max(len(r["phase"]) for r in rep["phases"]) + 2
+        print(f"  {'phase'.ljust(w)}{'n':>7}{'mean':>9}{'p50':>9}"
+              f"{'p95':>9}{'p99':>9}{'total':>11}")
+        for r in rep["phases"]:
+            print(f"  {r['phase'].ljust(w)}{r['n']:>7}"
+                  f"{r['mean_ms']:>9.3f}{r['p50_ms']:>9.3f}"
+                  f"{r['p95_ms']:>9.3f}{r['p99_ms']:>9.3f}"
+                  f"{r['total_ms']:>11.1f}")
+    if "wire_timeline" in rep:
+        print()
+        print("wire-format decisions:")
+        if not rep["wire_timeline"]:
+            print("  (no window push counters — single-step push or "
+                  "traffic counting off)")
+        for run in rep["wire_timeline"]:
+            span = (f"step {run['first']}" if run["first"] == run["last"]
+                    else f"steps {run['first']}-{run['last']}")
+            print(f"  {span}: {run['decision']} "
+                  f"({run['windows']} record(s))")
+    if "traffic" in rep:
+        t = rep["traffic"]
+        print()
+        print(f"traffic over {t['steps']} step(s):")
+        for backend in sorted(t["transfer"]):
+            print(f"  backend={backend}:")
+            for k, v in sorted(t["transfer"][backend].items()):
+                per = t.get("per_step", {}).get(backend, {}).get(k)
+                extra = f"  ({per:,.1f}/step)" if per is not None else ""
+                print(f"    {k}: {v:,.0f}{extra}")
+        if t["train"]:
+            print("  train:")
+            for k, v in sorted(t["train"].items()):
+                print(f"    {k}: {v:,.1f}")
+        if "stall_ms_per_step" in t:
+            print(f"  stall_ms_per_step: {t['stall_ms_per_step']:.3f}")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-phase latency, wire-format timeline and "
+                    "traffic summary from a telemetry JSONL")
+    ap.add_argument("path", help="telemetry.jsonl from obs.StepRecorder")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the report as JSON instead of text")
+    ap.add_argument("--phases-only", action="store_true",
+                    help="only the per-phase latency table")
+    args = ap.parse_args(argv)
+
+    rep = report(load(args.path), phases_only=args.phases_only)
+    if args.json:
+        json.dump(rep, sys.stdout, indent=2)
+        print()
+    else:
+        _print_report(rep)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

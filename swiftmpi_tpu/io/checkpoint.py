@@ -26,6 +26,7 @@ from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
+from swiftmpi_tpu import obs
 from swiftmpi_tpu.cluster.bootstrap import host_array, is_writer
 from swiftmpi_tpu.parameter.sparse_table import (SparseTable, base_field,
                                                  hot_name)
@@ -411,38 +412,42 @@ def save_checkpoint(table: SparseTable, path: str,
     generations beyond ``retain - 1`` are pruned — so a checkpoint that
     lands corrupted (torn write, bit rot, injected fault) still leaves
     ``find_latest_valid_checkpoint`` an older valid file to rewind to."""
-    keys = np.fromiter(table.key_index.keys(), dtype=np.uint64,
-                       count=len(table.key_index))
-    slots = np.fromiter((table.key_index.slot(int(k)) for k in keys),
-                        dtype=np.int64, count=len(keys))
-    payload = {}
-    for f, v in table.state.items():
-        arr = host_array(v)
-        if arr.dtype.name == "bfloat16":
-            # np.savez has no bfloat16: it round-trips as raw '|V2' and
-            # load explodes.  fp32 is an exact superset of bf16, so
-            # upcast here and cast back at load — bit-identical.
-            arr = arr.astype(np.float32)
-        payload[f"field__{f}"] = arr
-    payload["keys"] = keys
-    payload["slots"] = slots
-    payload["num_shards"] = np.int64(table.key_index.num_shards)
-    payload["capacity_per_shard"] = np.int64(
-        table.key_index.capacity_per_shard)
-    # hybrid placement: the hot-head size travels with the checkpoint so
-    # load can refuse a table built under a different frequency split
-    # (the @hot field arrays are in the field__ payload like any other)
-    payload["n_hot"] = np.int64(table.n_hot)
-    for k, v in (extra or {}).items():
-        payload[f"extra__{k}"] = np.asarray(v)
-    if not is_writer():        # gather above was the collective part
-        return
-    dst = npz_path(path)
-    rotate_before_write(dst, retain)
-    # atomic: a crash mid-write must never clobber the last good
-    # checkpoint (it is the only thing auto-resume can rewind to)
-    atomic_savez(dst, payload)
-    prune_generations(dst, retain)
+    with obs.span("checkpoint_save"):
+        keys = np.fromiter(table.key_index.keys(), dtype=np.uint64,
+                           count=len(table.key_index))
+        slots = np.fromiter((table.key_index.slot(int(k)) for k in keys),
+                            dtype=np.int64, count=len(keys))
+        payload = {}
+        for f, v in table.state.items():
+            arr = host_array(v)
+            if arr.dtype.name == "bfloat16":
+                # np.savez has no bfloat16: it round-trips as raw '|V2' and
+                # load explodes.  fp32 is an exact superset of bf16, so
+                # upcast here and cast back at load — bit-identical.
+                arr = arr.astype(np.float32)
+            payload[f"field__{f}"] = arr
+        payload["keys"] = keys
+        payload["slots"] = slots
+        payload["num_shards"] = np.int64(table.key_index.num_shards)
+        payload["capacity_per_shard"] = np.int64(
+            table.key_index.capacity_per_shard)
+        # hybrid placement: the hot-head size travels with the checkpoint so
+        # load can refuse a table built under a different frequency split
+        # (the @hot field arrays are in the field__ payload like any other)
+        payload["n_hot"] = np.int64(table.n_hot)
+        for k, v in (extra or {}).items():
+            payload[f"extra__{k}"] = np.asarray(v)
+        if not is_writer():        # gather above was the collective part
+            return
+        dst = npz_path(path)
+        rotate_before_write(dst, retain)
+        # atomic: a crash mid-write must never clobber the last good
+        # checkpoint (it is the only thing auto-resume can rewind to)
+        atomic_savez(dst, payload)
+        prune_generations(dst, retain)
+        reg = obs.get_registry()
+        if reg.enabled:
+            reg.counter("checkpoint/saves").inc()
 
 
 def load_checkpoint(table: SparseTable, path: str,
@@ -452,6 +457,16 @@ def load_checkpoint(table: SparseTable, path: str,
     every array first and raises :class:`CheckpointCorruptError` instead
     of silently restoring damaged state — callers with a retention window
     catch it and rewind via ``find_latest_valid_checkpoint``."""
+    with obs.span("checkpoint_restore"):
+        extra = _load_checkpoint(table, path, verify)
+    reg = obs.get_registry()
+    if reg.enabled:
+        reg.counter("checkpoint/restores").inc()
+    return extra
+
+
+def _load_checkpoint(table: SparseTable, path: str,
+                     verify: bool) -> Dict[str, np.ndarray]:
     if verify:
         verify_checkpoint(path)
     with np.load(npz_path(path)) as z:

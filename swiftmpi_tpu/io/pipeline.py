@@ -50,6 +50,8 @@ import threading
 import time
 from typing import Any, Callable, Dict, Iterable, Iterator, Optional
 
+from swiftmpi_tpu import obs
+
 _DONE = object()          # producer sentinel: source exhausted
 _CLOSED = object()        # close() sentinel: wake a blocked consumer
 
@@ -94,12 +96,24 @@ class PrefetchIterator:
     # -- producer ----------------------------------------------------------
     def _produce(self) -> None:
         try:
-            for item in self._source:
+            src = self._source
+            while True:
                 if self._stop.is_set():
                     return
+                # "render" / "h2d" phase spans: the producer thread is
+                # exactly where batch rendering and eager H2D transfer
+                # happen, so the telemetry phases are measured here (the
+                # concurrent-write side of the registry's thread-safety
+                # contract)
+                with obs.span("render"):
+                    try:
+                        item = next(src)
+                    except StopIteration:
+                        break
                 if self._transfer is not None:
                     t0 = time.monotonic()
-                    item = self._transfer(item)
+                    with obs.span("h2d"):
+                        item = self._transfer(item)
                     self._transfer_s += time.monotonic() - t0
                 # bounded put that stays responsive to close(): a plain
                 # blocking put on a full queue would deadlock the join
@@ -110,6 +124,11 @@ class PrefetchIterator:
                         self._produced += 1
                         self._peak_depth = max(self._peak_depth,
                                                self._q.qsize())
+                        reg = obs.get_registry()
+                        if reg.enabled:
+                            reg.counter("pipeline/produced").inc()
+                            reg.gauge("pipeline/queue_depth").set(
+                                self._q.qsize())
                         break
                     except queue.Full:
                         continue
@@ -135,7 +154,8 @@ class PrefetchIterator:
         if self._stop.is_set():
             raise StopIteration
         t0 = time.monotonic()
-        item = self._q.get()
+        with obs.span("input_wait"):
+            item = self._q.get()
         self._stall_s += time.monotonic() - t0
         if item is _DONE or item is _CLOSED:
             # drain-order guarantee: _DONE lands after every real item
@@ -147,6 +167,9 @@ class PrefetchIterator:
             self.close()
             raise StopIteration
         self._consumed += 1
+        reg = obs.get_registry()
+        if reg.enabled:
+            reg.counter("pipeline/consumed").inc()
         return item
 
     # -- lifecycle ---------------------------------------------------------

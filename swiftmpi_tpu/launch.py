@@ -63,6 +63,10 @@ def _child_env(base: Dict[str, str], port: int, rank: int, nprocs: int,
     env = dict(base)
     env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
     env[ENV_NUM_PROCESSES] = str(nprocs)
+    # besides the jax.distributed rank, ENV_PROCESS_ID is the process
+    # identity every log line and telemetry record carries ("r<rank>",
+    # obs/identity.py) — interleaved supervisor output and per-rank
+    # telemetry.jsonl stay attributable after the fact
     env[ENV_PROCESS_ID] = str(rank)
     if cpu_devices:
         env["JAX_PLATFORMS"] = "cpu"

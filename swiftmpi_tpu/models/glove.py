@@ -230,6 +230,18 @@ class GloVe:
         losses = []
         from swiftmpi_tpu.utils.timers import Throughput
         meter = Throughput()
+        from swiftmpi_tpu import obs
+        tel_rec = obs.get_recorder()
+        owns_rec = tel_rec is None
+        if owns_rec:
+            tel_rec = obs.configure(self.config, run="glove")
+        if tel_rec is not None:
+            def _tel_sample(reg, _m=meter):
+                reg.counter("train/host_stall_ms_total").set_total(
+                    _m.host_stall_ms())
+                reg.counter("train/device_ms_total").set_total(
+                    _m.device_ms())
+            tel_rec.add_sampler(_tel_sample)
         transfer_fn = None
         if self.pipeline_depth > 0:
             from swiftmpi_tpu.io.pipeline import device_put_transfer
@@ -270,9 +282,10 @@ class GloVe:
                         fields = next(groups, None)
                     if fields is None:
                         break
-                    state, loss = self._step(
-                        state, *(jnp.asarray(f) if not isinstance(
-                            f, jax.Array) else f for f in fields))
+                    with obs.span("dispatch"):
+                        state, loss = self._step(
+                            state, *(jnp.asarray(f) if not isinstance(
+                                f, jax.Array) else f for f in fields))
                     # the step donates the state buffers: reassign NOW,
                     # not after the loop, or an exception mid-epoch
                     # (staging error, KeyboardInterrupt) leaves
@@ -282,6 +295,7 @@ class GloVe:
                     self.table.state = state
                     total += float(loss)
                     meter.record(B * inner)
+                    obs.record_step(inner)
             finally:
                 if pipe is not None:
                     pipe.close()
@@ -293,6 +307,9 @@ class GloVe:
             "device_ms": meter.device_ms(),
             "stall_ms_per_step": meter.stall_ms_per_step(),
             "pipeline_depth": self.pipeline_depth}
+        if owns_rec and tel_rec is not None:
+            tel_rec.close()
+            obs.uninstall_recorder()
         return losses
 
     # -- outputs -----------------------------------------------------------

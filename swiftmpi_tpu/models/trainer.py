@@ -185,9 +185,12 @@ class Trainer:
                             want, np.asarray(tokens))
                     else:
                         tokens = jax.device_put(jnp.asarray(tokens), want)
-        params, opt_state, step, loss = self._step_fn(
-            state.params, state.opt_state, state.step, tokens)
+        from swiftmpi_tpu import obs
+        with obs.span("dispatch"):
+            params, opt_state, step, loss = self._step_fn(
+                state.params, state.opt_state, state.step, tokens)
         self.meter.record(int(np.prod(tokens.shape)))
+        obs.record_step(1)
         return TrainState(params, opt_state, step), loss
 
     def run(self, state: TrainState, batches, pipeline: int = 0,
@@ -237,6 +240,11 @@ class Trainer:
 
     # -- checkpoints (multihost-safe, atomic, CRC-validated) ---------------
     def save(self, state: TrainState, path: str, retain: int = 1) -> None:
+        from swiftmpi_tpu import obs
+        with obs.span("checkpoint_save"):
+            self._save(state, path, retain)
+
+    def _save(self, state: TrainState, path: str, retain: int) -> None:
         flat, treedef = jax.tree.flatten(state.tree())
         # every process gathers (host_array is a collective); only the
         # writer touches the disk — and logs from the gathered copy, so no

@@ -47,6 +47,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
+from swiftmpi_tpu import obs
 from swiftmpi_tpu.cluster.cluster import Cluster
 from swiftmpi_tpu.data.text import (CBOWBatcher, Vocab, build_vocab,
                                     load_corpus)  # noqa: F401 (Vocab: API)
@@ -1462,6 +1463,23 @@ class Word2Vec:
         meter = Throughput()
         step_i = 0
         hogwild_dropped = 0
+        # telemetry plane ([worker] telemetry, obs/): reuse an outer
+        # recorder (bench harness, trainer) or own one for this call.
+        # The Throughput meter and transfer ledger keep their own
+        # cumulative state, so they bridge into the registry through a
+        # pre-snapshot sampler (set_total keeps the counters monotonic).
+        tel_rec = obs.get_recorder()
+        owns_rec = tel_rec is None
+        if owns_rec:
+            tel_rec = obs.configure(self.config, run="word2vec")
+        if tel_rec is not None:
+            def _tel_sample(reg, _m=meter):
+                reg.counter("train/host_stall_ms_total").set_total(
+                    _m.host_stall_ms())
+                reg.counter("train/device_ms_total").set_total(
+                    _m.device_ms())
+                reg.gauge("train/words_per_sec").set(_m.rate())
+            tel_rec.add_sampler(_tel_sample)
         # -- input pipeline setup (tentpole: prefetch-rendered,
         # pre-transferred batches).  The producer is gated to paths
         # where it can own rendering wholesale: hogwild does its own
@@ -1520,7 +1538,8 @@ class Word2Vec:
                             self._alias_idx,
                             *(_dev(f) for f in fields), sub)
                     if sync:
-                        state, es, ec = self._step(state, *args)
+                        with obs.span("dispatch"):
+                            state, es, ec = self._step(state, *args)
                         # the step donates (deletes) the input state
                         # buffers; repoint the table at the live ones
                         # immediately so an abnormal exit (raise, Ctrl-C)
@@ -1533,8 +1552,9 @@ class Word2Vec:
                         # immediately; snapshot refreshes every
                         # local_steps batches => bounded staleness.
                         grads_fn, apply_fn = self._step
-                        pushes, es, ec = grads_fn(frozen, *args)
-                        state = apply_fn(state, pushes)
+                        with obs.span("dispatch"):
+                            pushes, es, ec = grads_fn(frozen, *args)
+                            state = apply_fn(state, pushes)
                         self.table.state = state
                         step_i += 1
                         if step_i % self.local_steps == 0:
@@ -1542,6 +1562,7 @@ class Word2Vec:
                     es_q.add(es)
                     ec_q.add(ec)
                     meter.record(n_words)
+                    obs.record_step(1)
 
                 def run_group(fields, n_words):
                     # update ORDER is preserved either way: a group runs
@@ -1566,16 +1587,18 @@ class Word2Vec:
                                        n_words[i])
                         return
                     self._key, sub = jax.random.split(self._key)
-                    state, es, ec = fused(
-                        state, self._slot_of_vocab, self._alias_prob,
-                        self._alias_idx,
-                        *(_dev(f) for f in fields), sub)
+                    with obs.span("dispatch"):
+                        state, es, ec = fused(
+                            state, self._slot_of_vocab, self._alias_prob,
+                            self._alias_idx,
+                            *(_dev(f) for f in fields), sub)
                     self.table.state = state
                     es_q.add(es)
                     ec_q.add(ec)
                     # a fused group is ONE dispatch but L train steps;
                     # stall_ms_per_step stays per-step across fuse modes
                     meter.record(sum(n_words), steps=L)
+                    obs.record_step(L)
 
                 items = self._epoch_items(batcher, batch_size, stencil,
                                           fuse)
@@ -1645,8 +1668,13 @@ class Word2Vec:
         if pipe_stats is not None:
             self.train_metrics["pipeline"] = dict(pipe_stats)
         if hasattr(self.transfer, "traffic"):
+            # traffic() drains queued eager counts through _accum_wire,
+            # so the registry mirror is exact before the summary lands
             self.train_metrics["transfer_traffic"] = \
                 self.transfer.traffic()
+        if owns_rec and tel_rec is not None:
+            tel_rec.close()
+            obs.uninstall_recorder()
         return losses
 
     def _hogwild_epoch(self, batcher, batch_size: int, meter) -> tuple:
@@ -1687,6 +1715,7 @@ class Word2Vec:
             es_q.add(es)
             ec_q.add(ec)
             meter.record(sum(b.n_words for b in buf), steps=len(buf))
+            obs.record_step(len(buf))
             buf = []
         if buf:
             dropped += sum(b.n_words for b in buf)

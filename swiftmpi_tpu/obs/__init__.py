@@ -1,0 +1,195 @@
+"""Unified telemetry plane (ISSUE 6).
+
+One :class:`~swiftmpi_tpu.obs.registry.MetricsRegistry` for the whole
+process — the transfer wire ledgers, the ``Throughput`` meter, pipeline
+stats, fault events, checkpoint durations, and health probes all report
+here instead of keeping private counters.  A
+:class:`~swiftmpi_tpu.obs.recorder.StepRecorder` turns the registry into
+a per-step JSONL time-series; :func:`span` wraps host-side hot-path
+phases in ``profiler.annotate`` trace annotations AND a ``phase_ms``
+histogram under the same name, so the TensorBoard trace and the JSONL
+agree; :func:`named_scope` carries the same phase names into compiled
+code (host timing is meaningless inside jit — the named scope shows up
+in the device trace instead).
+
+Everything is gated by ``[worker] telemetry:`` (see :func:`configure`).
+The registry is process-global and created **disabled**: with telemetry
+off, every instrument write and every ``span()`` is a single branch —
+the measured-overhead test in tests/test_telemetry.py pins this down.
+
+Module-level state exists because instruments are written from layers
+with no config object in scope (transfer backends, the fault bus, the
+health probes).  Tests get a clean slate via :func:`reset_for_tests`
+(wired into tests/conftest.py); long-lived writers must therefore fetch
+the registry through :func:`get_registry` (or re-check identity against
+a cached reference) rather than caching it forever.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax
+
+from swiftmpi_tpu.obs.identity import process_ident, process_rank
+from swiftmpi_tpu.obs.recorder import SCHEMA, SCHEMA_V, StepRecorder
+from swiftmpi_tpu.obs.registry import (DEFAULT_BUCKETS_MS, MetricsRegistry,
+                                       parse_series_key,
+                                       quantile_from_buckets, series_key)
+from swiftmpi_tpu.utils import profiler
+
+__all__ = [
+    "DEFAULT_BUCKETS_MS", "MetricsRegistry", "StepRecorder", "SCHEMA",
+    "SCHEMA_V", "series_key", "parse_series_key", "quantile_from_buckets",
+    "process_ident", "process_rank", "get_registry", "set_enabled",
+    "reset_for_tests", "span", "named_scope", "configure",
+    "install_recorder", "uninstall_recorder", "get_recorder", "record_step",
+]
+
+#: named scope for *compiled* code — same phase names as :func:`span`,
+#: rendered into the device trace by XLA instead of timed on the host.
+named_scope = jax.named_scope
+
+_REGISTRY = MetricsRegistry(enabled=False)
+_RECORDER: Optional[StepRecorder] = None
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global registry (disabled unless telemetry is on)."""
+    return _REGISTRY
+
+
+def set_enabled(on: bool) -> MetricsRegistry:
+    _REGISTRY.enabled = bool(on)
+    return _REGISTRY
+
+
+def reset_for_tests() -> MetricsRegistry:
+    """Swap in a fresh disabled registry and drop any installed recorder.
+
+    Cached instrument handles bound to the old registry keep working but
+    write into the discarded object — hence writers re-check
+    ``get_registry()`` identity (see ``Transfer._obs_state``)."""
+    global _REGISTRY, _RECORDER
+    _REGISTRY = MetricsRegistry(enabled=False)
+    _RECORDER = None
+    return _REGISTRY
+
+
+# -- named spans ------------------------------------------------------------
+
+class _NullSpan:
+    """Returned when telemetry is off: a shared, stateless no-op."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    """Host span = TraceAnnotation + ``phase_ms{phase=<name>}`` sample."""
+
+    __slots__ = ("_hist", "_ann", "_t0")
+
+    def __init__(self, hist, name: str):
+        self._hist = hist
+        self._ann = profiler.annotate(name)
+
+    def __enter__(self):
+        self._ann.__enter__()
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt_ms = (time.perf_counter() - self._t0) * 1e3
+        self._ann.__exit__(*exc)
+        self._hist.observe(dt_ms)
+        return False
+
+
+def span(name: str):
+    """Named host-phase span: ``with obs.span("render"): ...``.
+
+    Telemetry off -> a shared no-op context (one branch, no allocation).
+    On -> a ``jax.profiler.TraceAnnotation`` plus a sample in the
+    ``phase_ms{phase=<name>}`` histogram, so the trace viewer and
+    ``telemetry_report.py`` see the same phase under the same name.
+    Only meaningful OUTSIDE jit — use :func:`named_scope` inside.
+    """
+    reg = _REGISTRY
+    if not reg.enabled:
+        return _NULL_SPAN
+    return _Span(reg.histogram("phase_ms", phase=name), name)
+
+
+# -- recorder install point -------------------------------------------------
+
+def install_recorder(rec: StepRecorder) -> StepRecorder:
+    """Make ``rec`` the recorder :func:`record_step` feeds.  Layers with
+    no config in scope (Trainer.step) report steps through the global."""
+    global _RECORDER
+    _RECORDER = rec
+    return rec
+
+
+def uninstall_recorder() -> Optional[StepRecorder]:
+    global _RECORDER
+    rec, _RECORDER = _RECORDER, None
+    return rec
+
+
+def get_recorder() -> Optional[StepRecorder]:
+    return _RECORDER
+
+
+def record_step(n: int = 1) -> None:
+    """Account ``n`` consumed train steps on the installed recorder (a
+    fused scan group counts its whole length).  No-op when none."""
+    rec = _RECORDER
+    if rec is not None:
+        rec.on_steps(n)
+
+
+# -- config gate ------------------------------------------------------------
+
+def configure(config, run: str = "run",
+              meta: Optional[dict] = None) -> Optional[StepRecorder]:
+    """Arm the telemetry plane from ``[worker]`` config.
+
+    Knobs (all under ``[worker]``):
+
+    * ``telemetry: 1``        — master switch (default 0 = everything off)
+    * ``telemetry_path:``     — JSONL sink (default ``telemetry.jsonl``;
+      empty string = ring buffer only, no file)
+    * ``telemetry_every: K``  — record every K consumed steps (default 1)
+    * ``telemetry_ring: N``   — ring-buffer retention (default 1024)
+    * ``telemetry_flush: N``  — JSONL write-buffer size (default 64)
+
+    Returns the installed :class:`StepRecorder`, or ``None`` when
+    telemetry is off.  The caller owns ``close()`` (or use it as a
+    context manager); close appends the summary line and uninstalls
+    nothing — :func:`uninstall_recorder` is explicit.
+    """
+    g = config.get_or
+    if not g("worker", "telemetry", 0).to_bool():
+        return None
+    set_enabled(True)
+    path = g("worker", "telemetry_path", "telemetry.jsonl").to_string()
+    rec = StepRecorder(
+        _REGISTRY,
+        path=path or None,
+        run=run,
+        ring=g("worker", "telemetry_ring", 1024).to_int32(),
+        flush_every=g("worker", "telemetry_flush", 64).to_int32(),
+        every=g("worker", "telemetry_every", 1).to_int32(),
+        meta=meta,
+    )
+    return install_recorder(rec)

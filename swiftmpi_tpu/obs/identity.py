@@ -1,0 +1,38 @@
+"""Process/rank identity for log lines and telemetry records.
+
+``launch.py`` gives every supervised child a rank via
+``SMTPU_PROCESS_ID`` (cluster/bootstrap.py).  Everything that emits an
+attributable line — the logger, the StepRecorder, fault events — tags it
+with ``r<rank>`` when launched, or ``p<pid>`` for a bare single process,
+so interleaved output from an 8-process cell stays attributable.
+
+Read the environment *per call*, never cached at import: tests
+monkeypatch ``SMTPU_PROCESS_ID`` and the supervisor re-execs children
+with fresh ranks after a restart.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from swiftmpi_tpu.cluster.bootstrap import ENV_PROCESS_ID
+
+
+def process_rank() -> Optional[int]:
+    """The launcher-assigned process rank, or None for a bare process."""
+    raw = os.environ.get(ENV_PROCESS_ID)
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def process_ident() -> str:
+    """``r<rank>`` under the launcher, ``p<pid>`` otherwise."""
+    rank = process_rank()
+    if rank is not None:
+        return f"r{rank}"
+    return f"p{os.getpid()}"

@@ -1,0 +1,193 @@
+"""Per-step telemetry time-series: registry deltas -> ring buffer -> JSONL.
+
+End-of-run scalars (``train_metrics``, ``Transfer.traffic()``) say *what*
+a run cost; auto-placement and wire-format audits need *when* — a live,
+queryable per-step series.  :class:`StepRecorder` snapshots the
+:class:`~swiftmpi_tpu.obs.registry.MetricsRegistry` once per recorded
+step, keeps the per-step **deltas** in a bounded ring buffer (long runs
+hold O(ring) memory, never O(steps)), and flushes every record to a
+schema-versioned JSONL file (``telemetry.jsonl``) alongside the run's
+other output.
+
+Record schema (one JSON object per line, ``"v": 1`` on every line):
+
+* ``kind: "meta"``   — first line: schema id, run name, rank/pid identity,
+  caller-supplied metadata.
+* ``kind: "step"``   — ``step`` (cumulative consumed steps), ``steps``
+  (steps covered by this record — a fused scan group records once for L
+  steps), ``t`` (seconds since recorder start), ``counters`` (deltas for
+  the series that moved), ``gauges`` (current values), ``hists``
+  (per-record bucket-count deltas; ``bounds`` ride along the first time a
+  series appears).
+* ``kind: "summary"`` — last line: cumulative counter totals, final
+  gauges, and p50/p95/p99 per histogram — so one-shot consumers (the
+  traffic-budget gate) never have to re-sum the deltas.
+
+Writes happen only on the recording thread (the training loop's consumer
+side); the registry itself is what the producer threads hit, and its
+snapshot is lock-consistent.  ``telemetry_every: K`` thins recording to
+every K-th step when per-step snapshots are too hot for a small step.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import deque
+from typing import Callable, Dict, List, Optional
+
+from swiftmpi_tpu.obs.identity import process_ident, process_rank
+from swiftmpi_tpu.obs.registry import (MetricsRegistry,
+                                       quantile_from_buckets)
+
+SCHEMA = "smtpu-telemetry/1"
+SCHEMA_V = 1
+
+
+class StepRecorder:
+    """Snapshot registry deltas per train step; flush JSONL.
+
+    ``ring`` bounds in-memory retention (a deque of the last N records);
+    ``flush_every`` bounds the write buffer; ``every`` thins recording.
+    ``samplers`` are callables ``fn(registry)`` invoked right before each
+    snapshot — the bridge for instruments that keep their own cumulative
+    state (the ``Throughput`` meter, ``PrefetchIterator.stats()``): they
+    ``set_total``/``gauge.set`` the registry from their internal counters
+    so the delta machinery sees them like any native series.
+    """
+
+    def __init__(self, registry: MetricsRegistry, path: Optional[str] = None,
+                 run: str = "run", ring: int = 1024, flush_every: int = 64,
+                 every: int = 1, meta: Optional[dict] = None):
+        if ring < 1:
+            raise ValueError(f"telemetry ring must be >= 1, got {ring}")
+        if every < 1:
+            raise ValueError(f"telemetry_every must be >= 1, got {every}")
+        self.registry = registry
+        self.path = path
+        self.run = run
+        self.every = int(every)
+        self._ring: deque = deque(maxlen=int(ring))
+        self._flush_every = max(1, int(flush_every))
+        self._samplers: List[Callable[[MetricsRegistry], None]] = []
+        self._buf: List[str] = []
+        self._file = None
+        self._closed = False
+        self._step_total = 0
+        self._steps_unrecorded = 0
+        self._records_written = 0
+        self._t0 = time.monotonic()
+        self._prev = registry.snapshot()
+        self._bounds_emitted = set()
+        self._meta = {"v": SCHEMA_V, "kind": "meta", "schema": SCHEMA,
+                      "run": run, "rank": process_rank(),
+                      "pid": os.getpid(), "ident": process_ident(),
+                      "ts": time.time(), **(meta or {})}
+        self._buf.append(json.dumps(self._meta, sort_keys=True))
+
+    # -- samplers ----------------------------------------------------------
+    def add_sampler(self, fn: Callable[[MetricsRegistry], None]) -> None:
+        """Register ``fn(registry)`` to run before every snapshot."""
+        self._samplers.append(fn)
+
+    # -- recording ---------------------------------------------------------
+    def on_steps(self, n: int = 1) -> None:
+        """Account ``n`` consumed train steps; records when the
+        ``every`` cadence is due.  Call from the consumer thread."""
+        if self._closed:
+            return
+        self._step_total += n
+        self._steps_unrecorded += n
+        if self._steps_unrecorded >= self.every:
+            self._record()
+
+    def _record(self) -> None:
+        for fn in self._samplers:
+            fn(self.registry)
+        cur = self.registry.snapshot()
+        d = MetricsRegistry.delta(self._prev, cur)
+        self._prev = cur
+        hists = {}
+        for k, h in d["hists"].items():
+            entry = {"n": h["n"], "sum": h["sum"], "counts": h["counts"]}
+            if k not in self._bounds_emitted:
+                entry["bounds"] = list(h["bounds"])
+                self._bounds_emitted.add(k)
+            hists[k] = entry
+        rec = {"v": SCHEMA_V, "kind": "step",
+               "step": self._step_total,
+               "steps": self._steps_unrecorded,
+               "t": time.monotonic() - self._t0,
+               "rank": self._meta["rank"], "ident": self._meta["ident"],
+               "counters": d["counters"], "gauges": d["gauges"],
+               "hists": hists}
+        self._steps_unrecorded = 0
+        self._ring.append(rec)
+        self._records_written += 1
+        if self.path:
+            self._buf.append(json.dumps(rec, sort_keys=True))
+            if len(self._buf) >= self._flush_every:
+                self.flush()
+
+    # -- read side ---------------------------------------------------------
+    def records(self) -> List[dict]:
+        """The ring buffer's current contents (most recent ``ring``
+        step records, oldest first)."""
+        return list(self._ring)
+
+    @property
+    def steps_recorded(self) -> int:
+        return self._step_total
+
+    # -- sinks -------------------------------------------------------------
+    def flush(self) -> None:
+        if not self.path or not self._buf:
+            self._buf = self._buf if self.path else []
+            return
+        if self._file is None:
+            self._file = open(self.path, "a")
+        self._file.write("\n".join(self._buf) + "\n")
+        self._file.flush()
+        self._buf = []
+
+    def close(self) -> None:
+        """Record any unrecorded tail steps, append the summary line, and
+        flush.  Idempotent."""
+        if self._closed:
+            return
+        if self._steps_unrecorded:
+            self._record()
+        for fn in self._samplers:
+            fn(self.registry)
+        snap = self.registry.snapshot()
+        summary = {"v": SCHEMA_V, "kind": "summary", "run": self.run,
+                   "rank": self._meta["rank"], "ident": self._meta["ident"],
+                   "steps": self._step_total,
+                   "elapsed_s": time.monotonic() - self._t0,
+                   "counters": snap["counters"], "gauges": snap["gauges"],
+                   "quantiles": {
+                       k: {"p50": quantile_from_buckets(
+                               h["bounds"], h["counts"], 0.50),
+                           "p95": quantile_from_buckets(
+                               h["bounds"], h["counts"], 0.95),
+                           "p99": quantile_from_buckets(
+                               h["bounds"], h["counts"], 0.99),
+                           "n": h["count"],
+                           "mean_ms": h["sum"] / h["count"]
+                           if h["count"] else 0.0}
+                       for k, h in snap["hists"].items()}}
+        self._closed = True
+        if self.path:
+            self._buf.append(json.dumps(summary, sort_keys=True))
+            self.flush()
+            if self._file is not None:
+                self._file.close()
+                self._file = None
+        self.summary = summary
+
+    def __enter__(self) -> "StepRecorder":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()

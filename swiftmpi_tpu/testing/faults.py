@@ -44,6 +44,16 @@ log = get_logger(__name__)
 
 ENV_FAULT_PLAN = "SMTPU_FAULT_PLAN"
 
+
+def _obs_count(name: str, **labels) -> None:
+    """Telemetry mirror for bus events (one branch when telemetry is
+    off).  Deferred import: obs must stay importable without the fault
+    machinery and vice versa."""
+    from swiftmpi_tpu import obs
+    reg = obs.get_registry()
+    if reg.enabled:
+        reg.counter(name, **labels).inc()
+
 _KINDS = ("crash", "hang", "corrupt_checkpoint", "kill")
 
 
@@ -185,6 +195,7 @@ class FaultPlan:
             if not f._armed():
                 continue
             f._record_fire()
+            _obs_count("faults/injected", kind=f.kind)
             if f.kind == "hang":
                 log.warning("fault injection: hanging %.1fs at step %d",
                             f.seconds, step)
@@ -205,6 +216,7 @@ class FaultPlan:
             if f.at_save is not None and self.saves_seen != f.at_save:
                 continue
             f._record_fire()
+            _obs_count("faults/injected", kind=f.kind)
             off = corrupt_file_bytes(path, f.nbytes, f.offset)
             log.warning("fault injection: corrupted %d bytes of %s at "
                         "offset %d (save #%d)", f.nbytes, path, off,
@@ -286,6 +298,7 @@ def remove_observer(fn: Callable[[str, object], None]) -> None:
 
 def step_event(step: int) -> None:
     """Training loops call this at the top of every step/iteration."""
+    _obs_count("faults/step_events")
     if _observers:
         for fn in list(_observers):
             fn("step", step)
@@ -296,6 +309,7 @@ def step_event(step: int) -> None:
 
 def checkpoint_event(path: str) -> None:
     """Checkpoint writers call this right after a checkpoint lands."""
+    _obs_count("faults/checkpoint_events")
     if _observers:
         for fn in list(_observers):
             fn("checkpoint", path)

@@ -52,6 +52,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from swiftmpi_tpu import obs
 from swiftmpi_tpu.parameter.access import AccessMethod
 from swiftmpi_tpu.parameter.sparse_table import TableState
 from swiftmpi_tpu.utils.config import ConfigParser
@@ -143,13 +144,34 @@ class Transfer:
                 "pending": []}
         return st
 
+    def _obs_inc(self, key: str, n) -> None:
+        """Mirror a ledger increment into the telemetry registry as
+        ``transfer/<key>{backend=<name>}``.  Telemetry off costs one
+        branch; handles are cached per instance and re-fetched if the
+        global registry was swapped (tests reset it)."""
+        reg = obs.get_registry()
+        if not reg.enabled:
+            return
+        cache = self.__dict__.get("_obs_cache")
+        if cache is None or cache[0] is not reg:
+            cache = self.__dict__["_obs_cache"] = (reg, {})
+        c = cache[1].get(key)
+        if c is None:
+            c = cache[1][key] = reg.counter("transfer/" + key,
+                                            backend=self.name)
+        c.inc(n)
+
     def _accum_wire(self, row_bytes, rows, ndisp: int = 1,
                     decision: Optional[str] = None) -> None:
         st = self._wire_state()
-        st["wire_bytes"] += int(rows) * int(row_bytes)
+        nbytes = int(rows) * int(row_bytes)
+        st["wire_bytes"] += nbytes
         st["dispatches"] += ndisp
+        self._obs_inc("wire_bytes", nbytes)
+        self._obs_inc("dispatches", ndisp)
         if decision:
             st["window_" + decision] += 1
+            self._obs_inc("window_" + decision, 1)
 
     def _record_exchange(self, rows, row_bytes: int,
                          decision: Optional[str] = None) -> None:
@@ -173,8 +195,11 @@ class Transfer:
         st = self._wire_state()
         st["coalesced_rows_in"] += int(rows_in)
         st["coalesced_rows_out"] += int(rows_out)
+        self._obs_inc("coalesced_rows_in", int(rows_in))
+        self._obs_inc("coalesced_rows_out", int(rows_out))
         if decision:
             st["window_" + decision] += 1
+            self._obs_inc("window_" + decision, 1)
 
     def _record_coalesce(self, rows_in, rows_out,
                          decision: Optional[str] = None) -> None:
@@ -196,7 +221,16 @@ class Transfer:
         eager scalars): ``wire_bytes``, ``dispatches``, and the window
         path's ``window_sparse``/``window_dense`` decision counts plus
         ``coalesced_rows_in``/``coalesced_rows_out`` (rows before/after
-        the per-window dedup)."""
+        the per-window dedup).
+
+        Reset semantics (contract for all backends, enforced by
+        tests/test_telemetry.py): every value is a **monotonically
+        non-decreasing total** over the Transfer instance's lifetime.
+        There is no reset method on purpose — a reader wanting
+        per-interval numbers snapshots twice and subtracts (exactly what
+        the telemetry StepRecorder does with the registry mirror of
+        these counters).  Calling this method never perturbs the
+        ledger."""
         jax.effects_barrier()
         st = self._wire_state()
         pending, st["pending"] = st["pending"], []
@@ -207,7 +241,14 @@ class Transfer:
     def traffic(self) -> Dict[str, int]:
         """Cumulative traffic counters; every backend reports at least
         the wire ledger so cross-backend goldens compare like with
-        like.  Backends with routed/hot paths extend this dict."""
+        like.  Backends with routed/hot paths extend this dict.
+
+        Same contract as :meth:`wire_traffic`: monotonic totals, no
+        reset, deltas are the caller's job.  The identical numbers are
+        mirrored live into the telemetry registry as
+        ``transfer/<key>{backend=<name>}`` counters (when telemetry is
+        on), so per-step deltas come from ``telemetry.jsonl`` without
+        ever calling this (and without its ``jax.effects_barrier``)."""
         return self.wire_traffic()
 
     def pull(self, state: TableState, slots, access: AccessMethod,

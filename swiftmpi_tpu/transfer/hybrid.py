@@ -113,6 +113,8 @@ class HybridTransfer(Transfer):
     def _accum_hot(self, psum_bytes: int, hot) -> None:
         self._hot_total += int(hot)
         self._psum_bytes_total += int(psum_bytes)
+        self._obs_inc("hot_rows", int(hot))
+        self._obs_inc("psum_bytes", int(psum_bytes))
 
     def _record_hot(self, hot, psum_bytes: int) -> None:
         cb = partial(self._accum_hot, int(psum_bytes))

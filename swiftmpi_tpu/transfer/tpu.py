@@ -135,6 +135,7 @@ class TpuTransfer(Transfer):
     def _accum_overflow(self, op: str, count) -> None:
         c = int(count)
         self._overflow_total += c
+        self._obs_inc("overflow_dropped", c)
         if self.debug_overflow and c:
             raise RuntimeError(
                 f"TpuTransfer.{op}: {c} request(s) overflowed "
@@ -166,7 +167,9 @@ class TpuTransfer(Transfer):
                 # by now these executions have long completed, so the
                 # int() materialization is not a pipeline stall
                 pending, self._overflow_pending = self._overflow_pending, []
-                self._overflow_total += sum(int(c) for c in pending)
+                drained = sum(int(c) for c in pending)
+                self._overflow_total += drained
+                self._obs_inc("overflow_dropped", drained)
 
     def overflow_count(self) -> int:
         """Total requests dropped by bucket overflow since construction
@@ -174,7 +177,9 @@ class TpuTransfer(Transfer):
         no capacity is set (overflow impossible by construction)."""
         jax.effects_barrier()
         pending, self._overflow_pending = self._overflow_pending, []
-        self._overflow_total += sum(int(c) for c in pending)
+        drained = sum(int(c) for c in pending)
+        self._overflow_total += drained
+        self._obs_inc("overflow_dropped", drained)
         total = self._overflow_total
         if self.metrics is not None:
             self.metrics.set("transfer_overflow_dropped", total)
@@ -183,6 +188,7 @@ class TpuTransfer(Transfer):
     # -- traffic accounting ------------------------------------------------
     def _accum_routed(self, count) -> None:
         self._routed_total += int(count)
+        self._obs_inc("routed_rows", int(count))
 
     def _record_routed(self, count) -> None:
         """Same tracer/eager discipline as :meth:`_record_overflow`."""
@@ -192,14 +198,18 @@ class TpuTransfer(Transfer):
             self._routed_pending.append(count)
             if len(self._routed_pending) >= 1024:
                 pending, self._routed_pending = self._routed_pending, []
-                self._routed_total += sum(int(c) for c in pending)
+                drained = sum(int(c) for c in pending)
+                self._routed_total += drained
+                self._obs_inc("routed_rows", drained)
 
     def routed_rows(self) -> int:
         """Total rows routed through all_to_all bucket routing since
         construction (counted only while ``count_traffic`` is set)."""
         jax.effects_barrier()
         pending, self._routed_pending = self._routed_pending, []
-        self._routed_total += sum(int(c) for c in pending)
+        drained = sum(int(c) for c in pending)
+        self._routed_total += drained
+        self._obs_inc("routed_rows", drained)
         if self.metrics is not None:
             self.metrics.set("transfer_routed_rows", self._routed_total)
         return self._routed_total
@@ -264,7 +274,9 @@ class TpuTransfer(Transfer):
             C = self.bucket_capacity or B
             req, order, so, idx = _bucketize(
                 slots_l, self.n, cap_per_shard, C)
-            got = jax.lax.all_to_all(req, self.axis, 0, 0, tiled=True)
+            # telemetry phase name carried into the device trace
+            with jax.named_scope("wire_exchange"):
+                got = jax.lax.all_to_all(req, self.axis, 0, 0, tiled=True)
             ok = got >= 0
             safe = jnp.where(ok, got, 0)
             out = {}
@@ -425,6 +437,7 @@ class TpuTransfer(Transfer):
         @partial(jax.shard_map, mesh=self.mesh,
                  in_specs=(bspec, grad_specs, bspec),
                  out_specs=(bspec, grad_specs, bspec), check_vma=False)
+        @jax.named_scope("window_dedup")
         def _dedup(slots_l, grads_l, counts_l):
             B = slots_l.shape[0]
             valid = slots_l >= 0
@@ -499,8 +512,10 @@ class TpuTransfer(Transfer):
                                   mode="drop")
                 # the ONE exchange of the window: tiled reduce-scatter
                 # lands each shard's summed slice on its owner directly
-                acc = jax.lax.psum_scatter(acc, self.axis,
-                                           scatter_dimension=0, tiled=True)
+                with jax.named_scope("wire_exchange"):
+                    acc = jax.lax.psum_scatter(acc, self.axis,
+                                               scatter_dimension=0,
+                                               tiled=True)
                 if self.dp_axis:
                     acc = jax.lax.psum(acc, self.dp_axis)
                 dense[f] = acc
@@ -513,7 +528,8 @@ class TpuTransfer(Transfer):
                     cplane = jax.lax.psum(cplane, self.dp_axis)
                 inv = (1.0 / jnp.maximum(cplane, 1.0))[:, None]
                 dense = {f: a * inv for f, a in dense.items()}
-            new_fields = access.apply_push(state_l, dense)
+            with jax.named_scope("apply"):
+                new_fields = access.apply_push(state_l, dense)
             out = dict(state_l)
             out.update(new_fields)
             return out
@@ -540,7 +556,12 @@ class TpuTransfer(Transfer):
             C = self.bucket_capacity or B
             req, order, so, idx = _bucketize(
                 slots_l, self.n, cap_per_shard, C)
-            got = jax.lax.all_to_all(req, self.axis, 0, 0, tiled=True)
+            # phase names match obs.span()/telemetry: the collectives are
+            # "wire_exchange", the owner-side access update is "apply" —
+            # host timing is meaningless inside jit, so the device trace
+            # carries the names instead (docs/ARCHITECTURE.md).
+            with jax.named_scope("wire_exchange"):
+                got = jax.lax.all_to_all(req, self.axis, 0, 0, tiled=True)
             ok = got >= 0
             # received (slot, grad) pairs -> dense per-shard grad sums;
             # untouched rows get exact zero and the access rule is a no-op.
@@ -585,8 +606,9 @@ class TpuTransfer(Transfer):
                 col_idx = jnp.clip(idx, 0, C - 1)
                 bucket = bucket.at[row_idx, col_idx].set(
                     g[order], mode="drop")
-                recv = jax.lax.all_to_all(bucket, self.axis, 0, 0,
-                                          tiled=True)
+                with jax.named_scope("wire_exchange"):
+                    recv = jax.lax.all_to_all(bucket, self.axis, 0, 0,
+                                              tiled=True)
                 if sparse_dcn:
                     # batch-proportional DCN traffic: every group's
                     # received pairs, applied by everyone identically
@@ -612,7 +634,8 @@ class TpuTransfer(Transfer):
                     inv = 1.0 / jnp.maximum(csum[:, :1], 1.0)
             if mean:
                 dense = {f: a * inv for f, a in dense.items()}
-            new_fields = access.apply_push(state_l, dense)
+            with jax.named_scope("apply"):
+                new_fields = access.apply_push(state_l, dense)
             out = dict(state_l)
             out.update(new_fields)
             if not counted:

@@ -84,6 +84,14 @@ def check_devices(devices=None, timeout_s: float = 30.0
     out = [r if r is not None
            else DeviceHealth(str(d), False, timeout_s, "probe timed out")
            for r, d in zip(results, devices)]
+    from swiftmpi_tpu import obs
+    reg = obs.get_registry()
+    if reg.enabled:
+        for h in out:
+            reg.counter("health/probe_ok" if h.ok
+                        else "health/probe_fail").inc()
+            if h.ok:
+                reg.histogram("health/probe_ms").observe(h.latency_s * 1e3)
     bad = [h for h in out if not h.ok]
     if bad:
         log.warning("unhealthy devices: %s",

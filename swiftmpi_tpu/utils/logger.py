@@ -12,10 +12,23 @@ import logging
 import os
 import sys
 
-_FORMAT = "%(levelname).1s%(asctime)s %(name)s] %(message)s"
+_FORMAT = "%(levelname).1s%(asctime)s %(ident)s %(name)s] %(message)s"
 _DATEFMT = "%m%d %H:%M:%S"
 
 _configured = False
+
+
+class _IdentFilter(logging.Filter):
+    """Stamp each record with the process identity (``r<rank>`` under
+    launch.py's supervisor, ``p<pid>`` standalone) so interleaved logs
+    from a multi-process cell stay attributable.  Resolved per record —
+    the supervisor re-execs children with fresh ranks and tests
+    monkeypatch the env, so nothing may be cached at configure time."""
+
+    def filter(self, record: logging.LogRecord) -> bool:
+        from swiftmpi_tpu.obs.identity import process_ident
+        record.ident = process_ident()
+        return True
 
 
 def get_logger(name: str = "swiftmpi_tpu") -> logging.Logger:
@@ -24,6 +37,7 @@ def get_logger(name: str = "swiftmpi_tpu") -> logging.Logger:
         level = os.environ.get("SWIFTMPI_TPU_LOGLEVEL", "INFO").upper()
         handler = logging.StreamHandler(sys.stderr)
         handler.setFormatter(logging.Formatter(_FORMAT, _DATEFMT))
+        handler.addFilter(_IdentFilter())
         root = logging.getLogger("swiftmpi_tpu")
         root.addHandler(handler)
         root.setLevel(level)

@@ -34,7 +34,12 @@ def annotate(name: str):
 
 
 class StepTimer:
-    """Wall-clock per-step stats with an explicit device barrier."""
+    """Wall-clock per-step stats with an explicit device barrier.
+
+    Keeps every sample (bench loops are a few hundred steps at most),
+    so percentiles are exact order statistics, not bucket
+    interpolations — this is the ground truth the registry histogram's
+    interpolated quantiles are validated against in tests."""
 
     def __init__(self):
         self._times: List[float] = []
@@ -50,12 +55,55 @@ class StepTimer:
         self._times.append(dt)
         return dt
 
+    def __len__(self) -> int:
+        return len(self._times)
+
     @property
     def mean(self) -> float:
         return sum(self._times) / len(self._times) if self._times else 0.0
 
-    @property
-    def p50(self) -> float:
+    def percentile(self, q: float) -> float:
+        """Exact order-statistic percentile (``q`` in [0, 1]), linear
+        interpolation between adjacent samples — numpy's default rule,
+        without pulling in an array round-trip per call."""
         if not self._times:
             return 0.0
-        return sorted(self._times)[len(self._times) // 2]
+        xs = sorted(self._times)
+        if len(xs) == 1:
+            return xs[0]
+        pos = q * (len(xs) - 1)
+        lo = int(pos)
+        hi = min(lo + 1, len(xs) - 1)
+        return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+    @property
+    def p50(self) -> float:
+        return self.percentile(0.50)
+
+    @property
+    def p95(self) -> float:
+        return self.percentile(0.95)
+
+    @property
+    def p99(self) -> float:
+        return self.percentile(0.99)
+
+    def summary_ms(self) -> dict:
+        """Mean/p50/p95/p99 in milliseconds — the bench-cell latency
+        fields (mean-only latency hides tail regressions; the p99 is
+        what a serving SLO would gate on)."""
+        return {"mean": self.mean * 1e3, "p50": self.p50 * 1e3,
+                "p95": self.p95 * 1e3, "p99": self.p99 * 1e3}
+
+    def publish(self, name: str = "step_ms", **labels) -> None:
+        """Feed every recorded sample into the telemetry registry's
+        ``<name>{labels}`` histogram (no-op when telemetry is off), so
+        bench latency distributions land in the same sink as training
+        phase timings."""
+        from swiftmpi_tpu import obs
+        reg = obs.get_registry()
+        if not reg.enabled:
+            return
+        h = reg.histogram(name, **labels)
+        for dt in self._times:
+            h.observe(dt * 1e3)

@@ -25,17 +25,20 @@ jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 
+from swiftmpi_tpu import obs
 from swiftmpi_tpu.utils import reset_global_config, reset_global_random
 
 
 @pytest.fixture(autouse=True)
 def _clean_globals():
-    """Each test starts with fresh config/RNG singletons."""
+    """Each test starts with fresh config/RNG/telemetry singletons."""
     reset_global_config()
     reset_global_random()
+    obs.reset_for_tests()
     yield
     reset_global_config()
     reset_global_random()
+    obs.reset_for_tests()
 
 
 @pytest.fixture
