@@ -821,6 +821,128 @@ def _bench_w2v_1m_pipeline(device, timed_calls):
             "rendering": getattr(model, "resolved_rendering", None)}
 
 
+def _bench_w2v_1m_fused(device, timed_calls):
+    """In-cell pallas-vs-xla A/B of the fused stencil-gather kernel
+    (ops/pallas_stencil.py) at the 1M-vocab stencil shape.  Both arms
+    build through the SAME builder (``build_w2v_1m_model(stencil=True)``)
+    so the compiled batch/table shapes are identical; the
+    ``SMTPU_STENCIL_FUSED`` override pins the data-plane branch per arm
+    (1 = fused Pallas kernel, 0 = the XLA pull -> span-gather ->
+    masked-sum chain) and is restored afterwards.  Each arm is warmed by
+    ``_timed_steps``' warmup calls before its clock starts, and parity
+    is measured pipeline-off by construction (pre-staged device arrays,
+    one fused group per arm from the pristine identical-seed init): the
+    final table states must agree within the window-AdaGrad envelope
+    |a-b| <= 1e-5 + 1e-3*|a| — the kernel changes only the context
+    reduction order (matmul vs ordered adds), which AdaGrad's
+    state-dependent scaling can amplify across the fused group, and the
+    absolute floor keeps barely-touched rows (init magnitude ~1/d) from
+    dominating a pure relative test.  On the chip the cell records
+    the measured ``stencil_fused`` calibration verdict, so
+    ``[cluster] data_plane: auto`` resolves from this cell's numbers;
+    a pallas-arm failure is caught and recorded as a losing verdict
+    with the error string (the cell still reports its xla arm)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from swiftmpi_tpu.ops import calibration
+
+    PARITY_ENVELOPE = 1e-3
+    V = W2V_1M_VOCAB
+    out = {"vocab": V, "dtype": os.environ.get("BENCH_DTYPE", "float32")}
+    batch_args = None
+    parity, arms = {}, {}
+    B = W = S = cap = None
+    for arm, flag in (("xla", "0"), ("pallas", "1")):
+        prev = os.environ.get("SMTPU_STENCIL_FUSED")
+        os.environ["SMTPU_STENCIL_FUSED"] = flag
+        try:
+            model, rng = build_w2v_1m_model(device, stencil=True)
+            with jax.default_device(device):
+                step = model._build_multi_step(INNER_STEPS)
+                B, W = BATCH, model.window
+                S, cap = B + 2 * W, model.table.capacity
+                if batch_args is None:
+                    # one synthetic stream-span batch, reused verbatim
+                    # by the second arm (identical inputs, not just
+                    # identical distribution)
+                    tokens = jnp.asarray(
+                        rng.integers(0, V, size=(INNER_STEPS, S)),
+                        jnp.int32)
+                    sent_id = jnp.broadcast_to(
+                        jnp.arange(S, dtype=jnp.int32) // SENT_LEN,
+                        (INNER_STEPS, S))
+                    center_pos = jnp.broadcast_to(
+                        W + jnp.arange(B, dtype=jnp.int32),
+                        (INNER_STEPS, B))
+                    half = jnp.asarray(
+                        rng.integers(1, W + 1, size=(INNER_STEPS, B)),
+                        jnp.int32)
+                    batch_args = (tokens, sent_id, center_pos, half)
+                args = tuple(jax.device_put(x, device) for x in
+                             (model._slot_of_vocab, model._alias_prob,
+                              model._alias_idx) + batch_args)
+
+                def fresh_state():
+                    # the step donates its state; every use needs its
+                    # own copy of the identical-seed init
+                    return {f: jax.device_put(jnp.array(v), device)
+                            for f, v in model.table.state.items()}
+
+                try:
+                    pstate, _, _ = step(fresh_state(), *args,
+                                        jax.random.key(7))
+                    parity[arm] = {f: np.asarray(v)
+                                   for f, v in pstate.items()}
+                    _, dt, _ = _timed_steps(step, fresh_state(), args,
+                                            timed_calls,
+                                            jax.random.key(0))
+                    arms[arm] = dt / (timed_calls * INNER_STEPS) * 1e3
+                except Exception as e:
+                    if arm == "xla":
+                        raise      # baseline must run; only the pallas
+                    out["pallas_error"] = (f"{type(e).__name__}: "
+                                           f"{str(e)[:200]}")
+        finally:
+            if prev is None:
+                os.environ.pop("SMTPU_STENCIL_FUSED", None)
+            else:
+                os.environ["SMTPU_STENCIL_FUSED"] = prev
+    if len(parity) == 2:
+        m = 0.0
+        for f in parity["xla"]:
+            a, b = parity["xla"][f], parity["pallas"][f]
+            # normalized against the envelope: <= 1.0 passes
+            m = max(m, float(np.max(
+                np.abs(a - b) / (1e-5 + PARITY_ENVELOPE * np.abs(a)))))
+        out["parity_score"] = round(m, 4)
+        out["parity_ok"] = bool(m <= 1.0)
+    out["xla_step_ms"] = round(arms["xla"], 3)
+    out["words_per_sec_xla"] = B * 1e3 / arms["xla"]
+    if "pallas" in arms:
+        out["pallas_step_ms"] = round(arms["pallas"], 3)
+        out["speedup"] = round(arms["xla"] / arms["pallas"], 3)
+    # headline words/s is the winning arm — the cell exists to show the
+    # A/B, so both arms ride along unconditionally above
+    best = min(arms.values())
+    out.update({"words_per_sec": B * 1e3 / best, "step_ms": round(best, 3),
+                "span": S, "capacity": cap,
+                "rendering": getattr(model, "resolved_rendering", None)})
+    if calibration.on_tpu():
+        if "pallas" in arms:
+            calibration.ab_verdict(
+                "stencil_fused", arms["xla"], arms["pallas"],
+                correct=bool(out.get("parity_ok")),
+                shape=f"cap={cap} d=100 B={B} W={W} fp32",
+                extra={"cell": "w2v_1m_fused",
+                       "parity_score": out.get("parity_score")})
+        else:
+            calibration.ab_verdict(
+                "stencil_fused", arms["xla"],
+                error=out.get("pallas_error", "pallas arm did not run"))
+    return out
+
+
 def _write_corpus(corpus) -> str:
     """Token corpus -> temp text file (caller unlinks).  tolist +
     map(str): several-fold cheaper than per-token str(int(x)) at text8
@@ -1467,6 +1589,18 @@ def child_main(which: str) -> None:
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
+    if os.environ.get("BENCH_ONLY") == "scale_fused":
+        # on-chip Pallas data plane A/B at 1M vocab: the fused stencil-
+        # gather kernel vs the XLA chain, both arms inside ONE cell
+        # (same builder -> same compiled shapes, both warmed), parity
+        # checked from identical-seed inits.  Own child + own key;
+        # records the measured stencil_fused calibration verdict that
+        # resolves [cluster] data_plane: auto
+        out["w2v_1m_fused"] = _bench_w2v_1m_fused(device,
+                                                  max(timed // 2, 1))
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
     if os.environ.get("BENCH_ONLY") == "scale_pipeline":
         # asynchronous input pipeline over the window+hybrid
         # stencil+pool composition, through the PUBLIC train() path —
@@ -1865,6 +1999,7 @@ _SECONDARY_CELLS = (
     ("w2v_1m_hybrid", "w2v_1m_hybrid", "words_per_sec", "words/s"),
     ("w2v_1m_window", "w2v_1m_window", "words_per_sec", "words/s"),
     ("w2v_1m_pipeline", "w2v_1m_pipeline", "words_per_sec", "words/s"),
+    ("w2v_1m_fused", "w2v_1m_fused", "words_per_sec", "words/s"),
     ("w2v_text8_epoch_wall", "w2v_text8", "epoch_wall_s", "s"),
     ("w2v_100m_epoch_wall", "w2v_100m", "epoch_wall_s", "s"),
     ("transformer_lm", "tfm", "tokens_per_sec", "tokens/s"),

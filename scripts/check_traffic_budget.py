@@ -45,14 +45,16 @@ import sys
 #: lower-is-better counters the budget covers, with the detail fields
 #: printed for context when a covered cell is reported
 TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
-                   "dispatches_per_window", "stall_ms_per_step")
+                   "dispatches_per_window", "stall_ms_per_step",
+                   "kernel_ms")
 DETAIL_METRICS = ("window_sparse", "window_dense", "coalesce_ratio",
                   "push_window", "host_stall_ms", "queue_depth",
                   "pipeline", "speedup_vs_off")
 #: absolute increase a metric must clear before it can regress: wall-
 #: clock metrics jitter run to run while the counter metrics are exact,
-#: so only the former get a floor (ms for the stall split)
-ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1}
+#: so only the former get a floor (ms for the stall split; kernel_ms is
+#: a microbench mean over many reps, tighter than one stall sample)
+ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1, "kernel_ms": 0.05}
 
 
 def load_telemetry_cells(path: str) -> dict:
@@ -60,7 +62,7 @@ def load_telemetry_cells(path: str) -> dict:
     by the run name.  Counters are summed across backends (the gate
     budgets the run's total wire, not the split) and normalized by the
     recorded step count; window decision totals ride along as detail."""
-    from telemetry_report import load, traffic_summary
+    from telemetry_report import load, phase_table, traffic_summary
 
     doc = load(path)     # SystemExit(2) on unreadable/bad schema
     t = traffic_summary(doc)
@@ -79,7 +81,17 @@ def load_telemetry_cells(path: str) -> dict:
         if total:
             cell[decision] = total
     run = str(doc["meta"].get("run", "telemetry"))
-    return {run: cell} if cell else {}
+    cells = {run: cell} if cell else {}
+    # kernel microbench streams (obs.micro.MicroTelemetry): every
+    # ``micro/<name>`` phase becomes its own cell keyed ``run/<name>``
+    # with the lower-is-better kernel_ms mean, so two microbench runs
+    # diff cell by cell like bench JSONs
+    for row in phase_table(doc):
+        phase = row["phase"]
+        if phase.startswith("micro/"):
+            cells[f"{run}/{phase[len('micro/'):]}"] = {
+                "kernel_ms": row["mean_ms"]}
+    return cells
 
 
 def _is_telemetry(path: str) -> bool:

@@ -23,6 +23,32 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(
 import numpy as np  # noqa: E402
 
 
+class _NullTelemetry:
+    def cell(self, *a, **k):
+        pass
+
+    def close(self):
+        pass
+
+
+#: ``--telemetry PATH`` swaps in obs.micro.MicroTelemetry so the cells
+#: land as schema-versioned JSONL (smtpu-telemetry/1) that
+#: telemetry_report.py / check_traffic_budget.py can diff like any
+#: other run; default is print-only, zero overhead
+MT = _NullTelemetry()
+
+
+def _init_telemetry(argv, run="gather_micro"):
+    global MT
+    if "--telemetry" in argv:
+        path = argv[argv.index("--telemetry") + 1]
+        from swiftmpi_tpu.obs.micro import MicroTelemetry
+        import jax
+        MT = MicroTelemetry(path, run=run,
+                            meta={"device": str(jax.devices()[0])})
+        print(f"telemetry -> {path}", flush=True)
+
+
 def timeit(fn, *args, reps=16):
     import jax
     out = fn(*args)
@@ -115,6 +141,8 @@ def main(ab=True):
                 gb = N * d * table.dtype.itemsize / 1e9
                 print(f"gather  cap={cap:7d} d={d} {table.dtype.name:9s}"
                       f" {ms:7.2f} ms  {gb / ms * 1e3:6.1f} GB/s", flush=True)
+                MT.cell(f"gather/cap{cap}_d{d}_{table.dtype.name}", ms,
+                        gbps=gb / ms * 1e3)
 
         # scatter-add and sort+segment paths at d=100 fp32
         d = 100
@@ -125,6 +153,7 @@ def main(ab=True):
         ms = timeit(scat, table, idx, g) * 1e3
         print(f"scatter+ cap={cap:7d} d={d} float32   {ms:7.2f} ms",
               flush=True)
+        MT.cell(f"scatter/cap{cap}_d{d}_float32", ms)
 
         def sort_seg(i, g):
             order = jnp.argsort(i)
@@ -248,6 +277,7 @@ def pallas_ab():
     gb = N * 100 * 4 / 1e9
     print(f"xla gather    (fp32, cap={cap}): {xla_ms:7.2f} ms  "
           f"{gb / xla_ms * 1e3:6.1f} GB/s", flush=True)
+    MT.cell("xla_gather/cap17314_d100_fp32", xla_ms)
     if not fits_vmem(tf32):
         return
     # try both kernel variants: Mosaic may reject the vectorized
@@ -279,6 +309,7 @@ def pallas_ab():
             print(f"pallas vmem gather[{tag}] (fp32, cap={cap}): "
                   f"{ms:7.2f} ms  {gb / ms * 1e3:6.1f} GB/s  "
                   f"correct={correct}", flush=True)
+            MT.cell(f"pallas_gather/{tag}", ms, correct=float(correct))
             variants[tag] = {"correct": correct, "ms": round(ms, 3),
                              "method": method, "idx_block": blk}
         except Exception as e:
@@ -304,12 +335,94 @@ def pallas_ab():
                                extra={"variants": variants})
 
 
+def stencil_ab(B=16_384, W=4, d=100, cap=1_300_001):
+    """Fused stencil-gather kernel (ops/pallas_stencil.py) vs the XLA
+    pull->span-gather->masked-sum chain at the 1M-vocab stencil bench
+    shape — records the ``stencil_fused`` verdict that resolves the
+    ``[cluster] data_plane:`` knob.  Off-chip the kernel runs in
+    interpret mode: correctness is recorded (``record_interpret``) but
+    never a performance verdict."""
+    import jax
+    import jax.numpy as jnp
+
+    from swiftmpi_tpu.ops import calibration
+    from swiftmpi_tpu.ops.pallas_stencil import (fits_vmem,
+                                                 fused_stencil_gather,
+                                                 stencil_window_inputs)
+
+    rng = np.random.default_rng(0)
+    S = B + 2 * W
+    shape = f"cap={cap} d={d} B={B} W={W} fp32"
+    print(f"stencil A/B device: {jax.devices()[0]}  ({shape})",
+          flush=True)
+    table = jnp.asarray(rng.standard_normal((cap, d)), jnp.float32)
+    # synthetic stream-span batch shaped like the bench cell: affine
+    # centers over the span, sentence blocks, random dynamic radii
+    sent_np = (np.arange(S) // 64).astype(np.int32)
+    slots_np = rng.integers(0, cap, S).astype(np.int32)
+    cp_np = (W + np.arange(B)).astype(np.int32)
+    half_np = rng.integers(1, W + 1, B).astype(np.int32)
+    sent_id = jnp.asarray(sent_np)
+    slots = jnp.asarray(slots_np)
+    cp = jnp.asarray(cp_np)
+    half = jnp.asarray(half_np)
+    offsets = jnp.concatenate([jnp.arange(-W, 0), jnp.arange(1, W + 1)])
+
+    def xla_chain(tbl, sl, si, c, hf):
+        v_span = jnp.take(tbl, jnp.clip(sl, 0, cap - 1), axis=0)
+        v_span = jnp.where((sl >= 0)[:, None], v_span, 0.0)
+        ctx_idx = c[:, None] + offsets[None, :]
+        ci = jnp.clip(ctx_idx, 0, S - 1)
+        mask = ((ctx_idx >= 0) & (ctx_idx < S)
+                & (si[ci] == si[c][:, None])
+                & (jnp.abs(offsets)[None, :] <= hf[:, None]))
+        return jnp.sum(v_span[ci] * mask[..., None], axis=1)
+
+    xla_ms = timeit(jax.jit(lambda *a: xla_chain(*a).sum()),
+                    table, slots, sent_id, cp, half) * 1e3
+    print(f"xla stencil chain : {xla_ms:7.2f} ms", flush=True)
+    MT.cell("stencil/xla_chain", xla_ms)
+    if not fits_vmem(S, B, d, 4, W):
+        print("fused stencil: span does not fit VMEM budget", flush=True)
+        return
+    lo, wmask = stencil_window_inputs(sent_id, cp, half, W)
+    try:
+        want = np.asarray(jax.jit(xla_chain)(table, slots, sent_id,
+                                             cp, half))
+        got = np.asarray(fused_stencil_gather(table, slots, lo, wmask))
+        correct = bool(np.allclose(got, want, rtol=1e-4, atol=1e-4))
+        if calibration.on_tpu():
+            fused = jax.jit(lambda t, s, l, w:
+                            fused_stencil_gather(t, s, l, w).sum())
+            p_ms = timeit(fused, table, slots, lo, wmask) * 1e3
+            print(f"pallas fused stencil: {p_ms:7.2f} ms  "
+                  f"correct={correct}", flush=True)
+            MT.cell("stencil/pallas_fused", p_ms, correct=float(correct))
+            calibration.ab_verdict("stencil_fused", xla_ms, p_ms,
+                                   correct, shape=shape)
+        else:
+            print(f"pallas fused stencil (interpret): correct={correct}",
+                  flush=True)
+            calibration.record_interpret("stencil_fused", correct,
+                                         shape=shape)
+    except Exception as e:
+        msg = f"{type(e).__name__}: {str(e)[:200]}"
+        print(f"pallas fused stencil: UNSUPPORTED ({msg})", flush=True)
+        calibration.ab_verdict("stencil_fused", xla_ms, error=msg)
+
+
 if __name__ == "__main__":
+    _init_telemetry(sys.argv)
     if "--ab-only" in sys.argv:
         pallas_ab()
+        stencil_ab()
+    elif "--stencil-ab" in sys.argv:
+        stencil_ab()
     elif "--dense-only" in sys.argv:
         dense_cells()
     elif "--locality-only" in sys.argv:
         locality_cells()
     else:
         main(ab="--no-ab" not in sys.argv)
+        stencil_ab()
+    MT.close()

@@ -11,4 +11,8 @@ if [ -n "$BENCH_BASELINE" ] && [ -n "$BENCH_CANDIDATE" ] && [ -r "$BENCH_BASELIN
   echo "--- traffic budget (advisory) ---"
   python "$(dirname "$0")/check_traffic_budget.py" "$BENCH_BASELINE" "$BENCH_CANDIDATE" || echo "traffic budget ADVISORY FAILURE (tier-1 verdict unchanged)"
 fi
+# Advisory calibration staleness check: verdicts recorded under another
+# jaxlib/libtpu stack no longer steer data-plane gates — say so next to
+# the verdict (exit code unchanged; the CLI always exits 0).
+timeout -k 5 60 env JAX_PLATFORMS=cpu python -m swiftmpi_tpu.ops.calibration --stale-check 2>/dev/null || true
 exit $rc

@@ -19,6 +19,31 @@ import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
 
+class _NullTelemetry:
+    def cell(self, *a, **k):
+        pass
+
+    def close(self):
+        pass
+
+
+#: ``--telemetry PATH`` swaps in obs.micro.MicroTelemetry so the cells
+#: land as schema-versioned JSONL (smtpu-telemetry/1) that
+#: telemetry_report.py / check_traffic_budget.py can diff like any
+#: other run; default is print-only, zero overhead
+MT = _NullTelemetry()
+
+
+def _init_telemetry(argv, run="scatter_micro"):
+    global MT
+    if "--telemetry" in argv:
+        path = argv[argv.index("--telemetry") + 1]
+        from swiftmpi_tpu.obs.micro import MicroTelemetry
+        MT = MicroTelemetry(path, run=run,
+                            meta={"device": str(jax.devices()[0])})
+        print(f"telemetry -> {path}", flush=True)
+
+
 def timeit(fn, *a, reps=16):
     out = fn(*a)
     float(np.asarray(jax.tree_util.tree_leaves(out)[0]).reshape(-1)[0])
@@ -132,6 +157,7 @@ def replica_ab():
     xla_ms = timeit(fscat, gi, g1)
     print(f"xla fused scatter (x101 -> 17314)      : {xla_ms:7.2f} ms",
           flush=True)
+    MT.cell("xla_scatter/cap17314_w101_fp32", xla_ms)
     nchk = 16384
     want = np.asarray(jnp.zeros((capw, d + 1), jnp.float32)
                       .at[gi[:nchk]].add(g1[:nchk]))
@@ -147,6 +173,7 @@ def replica_ab():
                     gi, g1, lane)
         print(f"replica-{R} scatter: {ms:7.2f} ms  correct={ok}",
               flush=True)
+        MT.cell(f"replica_scatter/R{R}", ms, correct=float(ok))
         if ok:
             cells[R] = ms
     if cells:
@@ -172,6 +199,7 @@ def pallas_ab():
     xla_ms = timeit(fscat, gi, g1)
     print(f"xla fused scatter (x101 -> 17314)      : {xla_ms:7.2f} ms",
           flush=True)
+    MT.cell("xla_scatter/cap17314_w101_fp32", xla_ms)
     if not fits_vmem(capw, d + 1):
         return
     try:
@@ -185,6 +213,8 @@ def pallas_ab():
         p_ms = timeit(pscat, gi, g1)
         print(f"pallas vmem scatter (x101 -> 17314+1)  : {p_ms:7.2f} ms"
               f"  correct={correct}", flush=True)
+        MT.cell("pallas_scatter/cap17314_w101_fp32", p_ms,
+                correct=float(correct))
         calibration.ab_verdict("vmem_scatter", xla_ms, p_ms, correct,
                                shape=f"cap={capw} w={d+1} fp32 N={Nw}")
     except Exception as e:
@@ -194,12 +224,79 @@ def pallas_ab():
                                error=f"{type(e).__name__}: {str(e)[:200]}")
 
 
+def ring_ab(C=4096, width=101):
+    """DMA ring exchange (ops/pallas_ring.py) vs ``lax.all_to_all`` at
+    the push bucket shape — records the ``ring_push`` verdict that
+    resolves the ``[cluster] data_plane:`` knob for TpuTransfer's wire
+    exchange.  Needs a multi-device mesh to measure anything real: on a
+    single chip the ring degenerates and only a warning is printed; off
+    the chip the kernel runs its interpret-mode discharge path and the
+    parity result is recorded via ``record_interpret``."""
+    from swiftmpi_tpu.ops import calibration
+    from swiftmpi_tpu.ops.pallas_ring import ring_exchange, ring_supported
+    from swiftmpi_tpu.utils import jax_compat  # noqa: F401 (jax.shard_map)
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    devices = jax.devices()
+    n = len(devices)
+    on_tpu = calibration.on_tpu()
+    if on_tpu and n < 2:
+        print("ring A/B: needs a multi-chip mesh (1 device visible) — "
+              "no verdict recorded", flush=True)
+        return
+    mesh = Mesh(np.asarray(devices), ("x",))
+    shape = f"n={n} C={C} w={width} fp32"
+    print(f"ring A/B device: {devices[0]}  ({shape})", flush=True)
+    # per-device view is (n, C, width): n bucket blocks bound for the n
+    # shards — the exact operand TpuTransfer hands its wire exchange
+    x = jnp.asarray(np.random.default_rng(0).standard_normal(
+        (n, n, C, width)), jnp.float32)
+
+    def run(exchange):
+        f = jax.shard_map(
+            exchange, mesh=mesh, in_specs=P("x"), out_specs=P("x"),
+            check_vma=False)
+        return jax.jit(lambda a: f(a).sum())
+
+    a2a_fn = run(lambda b: jax.lax.all_to_all(b[0], "x", 0, 0,
+                                              tiled=True)[None])
+    ring_fn = run(lambda b: ring_exchange(b[0], "x", n)[None])
+    want = np.asarray(x).reshape(n, n, C, width).transpose(1, 0, 2, 3)
+    got = np.asarray(jax.shard_map(
+        lambda b: ring_exchange(b[0], "x", n)[None], mesh=mesh,
+        in_specs=P("x"), out_specs=P("x"), check_vma=False)(x))
+    correct = bool(np.allclose(got, want, rtol=1e-6, atol=1e-6))
+    if on_tpu:
+        a2a_ms = timeit(a2a_fn, x)
+        ring_ms = timeit(ring_fn, x)
+        print(f"all_to_all bucket exchange : {a2a_ms:7.2f} ms", flush=True)
+        print(f"pallas ring bucket exchange: {ring_ms:7.2f} ms  "
+              f"correct={correct}", flush=True)
+        MT.cell("ring/all_to_all", a2a_ms)
+        MT.cell("ring/pallas", ring_ms, correct=float(correct))
+        calibration.ab_verdict("ring_push", a2a_ms, ring_ms, correct,
+                               shape=shape)
+    else:
+        print(f"pallas ring exchange (interpret): correct={correct}",
+              flush=True)
+        calibration.record_interpret("ring_push", correct, shape=shape)
+    if not ring_supported(mesh, "x"):
+        print("ring A/B: WARNING — ring_supported probe failed on this "
+              "mesh despite the A/B above", flush=True)
+
+
 if __name__ == "__main__":
+    _init_telemetry(sys.argv)
     if "--ab-only" in sys.argv:
         pallas_ab()
         replica_ab()
+        ring_ab()
+    elif "--ring-ab" in sys.argv:
+        ring_ab()
     else:
         exploratory_cells()
         if "--no-ab" not in sys.argv:
             pallas_ab()
             replica_ab()
+            ring_ab()
+    MT.close()

@@ -52,6 +52,7 @@ from swiftmpi_tpu.cluster.cluster import Cluster
 from swiftmpi_tpu.data.text import (CBOWBatcher, Vocab, build_vocab,
                                     load_corpus)  # noqa: F401 (Vocab: API)
 from swiftmpi_tpu.io.checkpoint import dump_table_text, load_table_text
+from swiftmpi_tpu.ops import pallas_stencil
 from swiftmpi_tpu.ops.sampling import (build_unigram_alias, sample_alias,
                                        sample_alias_slots)
 from swiftmpi_tpu.ops.sigmoid import sigmoid_clipped
@@ -290,6 +291,10 @@ class Word2Vec:
             else jnp.float32
 
         self.cluster = cluster or Cluster(self.config).initialize()
+        # [cluster] data_plane (read by Cluster.initialize): steers the
+        # stencil step's neu1 between the XLA gather->mask->sum chain
+        # and the fused Pallas stencil kernel (ops/pallas_stencil.py)
+        self.data_plane = getattr(self.cluster, "data_plane", "auto")
         self.access = w2v_access(server_lr, self.len_vec,
                                  param_dtype=self.param_dtype)
         self._capacity_per_shard = capacity_per_shard
@@ -1021,6 +1026,8 @@ class Word2Vec:
         alpha = self.alpha
         d = self.len_vec
         K = self.shared_pool if shared else self.negative
+        data_plane = self.data_plane
+        p_itemsize = jnp.dtype(self.param_dtype).itemsize
 
         offsets = jnp.concatenate(
             [jnp.arange(-W, 0), jnp.arange(1, W + 1)])      # (2W,)
@@ -1028,12 +1035,9 @@ class Word2Vec:
         def stencil_parts(state, slot_of_vocab, tokens, sent_id,
                           center_pos, half):
             S = tokens.shape[0]
+            B = center_pos.shape[0]
             span_valid = sent_id >= 0
             span_slots = jnp.where(span_valid, slot_of_vocab[tokens], -1)
-            # THE gather this rendering exists for: ≤ B + 2W unique rows
-            v_span = transfer.pull(
-                state, span_slots, access, fields=("v",)
-            )["v"].astype(jnp.float32)                       # (S, d)
             row_valid = center_pos >= 0
             cp = jnp.clip(center_pos, 0, S - 1)
             centers = tokens[cp]                             # (B,) vocab
@@ -1044,6 +1048,25 @@ class Word2Vec:
                         & (sent_id[ci] == sent_id[cp][:, None])
                         & (jnp.abs(offsets)[None, :] <= half[:, None])
                         & row_valid[:, None])
+            # data_plane routing (trace-time static): the fused Pallas
+            # kernel collapses pull + span gather + masked sum into one
+            # call over the raw table (xla transfer only — the hybrid
+            # split has no single table array for "v"); same
+            # contribution set, matmul reduction order.
+            if (transfer.name == "xla"
+                    and pallas_stencil.use_fused_stencil(
+                        S, B, d, p_itemsize, W, mode=data_plane)):
+                lo, wmask = pallas_stencil.stencil_window_inputs(
+                    sent_id, center_pos, half, W)
+                with jax.named_scope("pallas_gather_stencil"):
+                    neu1 = pallas_stencil.fused_stencil_gather(
+                        state["v"], span_slots, lo, wmask)
+                return (span_slots, centers, c_slots, ci, ctx_mask,
+                        neu1)
+            # THE gather this rendering exists for: ≤ B + 2W unique rows
+            v_span = transfer.pull(
+                state, span_slots, access, fields=("v",)
+            )["v"].astype(jnp.float32)                       # (S, d)
             v_ctx = v_span[ci]        # span-local gather, not HBM rows
             neu1 = jnp.sum(v_ctx * ctx_mask[..., None], axis=1)
             return span_slots, centers, c_slots, ci, ctx_mask, neu1

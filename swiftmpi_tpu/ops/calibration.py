@@ -28,11 +28,14 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import threading
 from typing import Optional
 
 _LOCK = threading.Lock()
 _CACHE: Optional[dict] = None
+_STACK: Optional[dict] = None
+_STALE_WARNED: set = set()
 
 
 def _path() -> str:
@@ -55,14 +58,94 @@ def _load() -> dict:
     return _CACHE
 
 
+def stack_key() -> dict:
+    """The software stack a verdict was measured under: jaxlib and
+    libtpu versions.  A kernel's win/loss (or even its lowerability —
+    see the recorded ``taa``/``take`` Mosaic rejections) can flip
+    across compiler releases, so the stack is part of a verdict's
+    identity just like the device kind already in the key.  Resolved
+    without initializing a JAX backend, so the ``--stale-check`` CLI
+    stays cheap enough for ``run_tier1.sh``."""
+    global _STACK
+    if _STACK is None:
+        try:
+            import jaxlib
+            jl = getattr(jaxlib, "__version__", "unknown")
+        except Exception:
+            jl = "unknown"
+        lt = "none"
+        try:
+            from importlib import metadata
+            for dist in ("libtpu", "libtpu-nightly"):
+                try:
+                    lt = metadata.version(dist)
+                    break
+                except metadata.PackageNotFoundError:
+                    continue
+        except Exception:
+            lt = "unknown"
+        _STACK = {"jaxlib": jl, "libtpu": lt}
+    return dict(_STACK)
+
+
+def _stale_reason(verdict: dict) -> Optional[str]:
+    """Why a verdict must not steer a gate on this stack, or None."""
+    got = verdict.get("stack")
+    if not isinstance(got, dict):
+        return "recorded without a stack stamp (pre-stamp format)"
+    cur = stack_key()
+    diffs = [f"{k} {got.get(k, '?')} -> {cur[k]}"
+             for k in cur if got.get(k) != cur[k]]
+    if diffs:
+        return "recorded on a different stack: " + ", ".join(diffs)
+    return None
+
+
 def lookup(name: str, platform: str) -> Optional[dict]:
-    """Most recent verdict for (kernel, platform), or None."""
-    return _load().get(f"{name}:{platform}")
+    """Most recent verdict for (kernel, platform), or None.
+
+    A verdict recorded under a different jaxlib/libtpu stack (or
+    before stamps existed) is rejected with a loud re-calibrate
+    message: the device kind in the key already pins the chip, and the
+    stamp pins the compiler — a stale A/B result must never silently
+    steer a data-plane gate."""
+    key = f"{name}:{platform}"
+    verdict = _load().get(key)
+    if verdict is None:
+        return None
+    reason = _stale_reason(verdict)
+    if reason is not None:
+        if key not in _STALE_WARNED:
+            _STALE_WARNED.add(key)
+            print(f"calibration: STALE verdict ignored for {key} "
+                  f"({reason}) — RE-CALIBRATE via "
+                  f"scripts/gather_micro.py --ab-only and "
+                  f"scripts/scatter_micro.py --ab-only",
+                  file=sys.stderr, flush=True)
+        return None
+    return verdict
+
+
+def stale_keys() -> list:
+    """``[(key, reason)]`` for every stored verdict this stack must
+    reject — the ``run_tier1.sh`` advisory and the ``--stale-check``
+    CLI read this without going through per-gate lookups."""
+    out = []
+    for key, verdict in sorted(_load().items()):
+        if not isinstance(verdict, dict):
+            continue
+        reason = _stale_reason(verdict)
+        if reason is not None:
+            out.append((key, reason))
+    return out
 
 
 def record(name: str, platform: str, verdict: dict) -> None:
-    """Persist a verdict; merges with existing file under a lock."""
+    """Persist a verdict stamped with the current jaxlib/libtpu stack;
+    merges with the existing file under a lock."""
     global _CACHE
+    verdict = dict(verdict)
+    verdict.setdefault("stack", stack_key())
     with _LOCK:
         path = _path()
         try:
@@ -109,6 +192,7 @@ def reset_cache() -> None:
     """Drop the in-process memo (tests; or after an external write)."""
     global _CACHE
     _CACHE = None
+    _STALE_WARNED.clear()
 
 
 def device_key() -> str:
@@ -173,7 +257,8 @@ def ab_verdict(name: str, xla_ms: float, pallas_ms: float = None,
 
 # every Pallas kernel behind a measurement gate; pallas_status walks
 # this list so a new kernel cannot silently count as validated
-_PALLAS_KERNELS = ("vmem_gather", "vmem_scatter", "replica_scatter")
+_PALLAS_KERNELS = ("vmem_gather", "vmem_scatter", "replica_scatter",
+                   "stencil_fused", "ring_push")
 
 #: pseudo device-kind for interpret-mode (off-chip) oracle runs — a
 #: correctness exercise, never a performance verdict
@@ -259,3 +344,61 @@ def gated(name: str, env_var: str, fits: bool,
         return False
     verdict = lookup(name, device_key())
     return bool(verdict and verdict.get("win"))
+
+
+#: legal values of the ``[cluster] data_plane:`` knob
+DATA_PLANE_MODES = ("auto", "pallas", "xla")
+
+
+def data_plane_gated(mode: str, name: str, env_var: str, fits: bool,
+                     manual: bool = False) -> bool:
+    """Resolve the ``[cluster] data_plane:`` knob for one kernel.
+
+    The per-process env var stays the strongest signal (it is the
+    experiment/test override, exactly as for the other gates); below
+    it, ``xla`` pins the knob off, ``pallas`` forces the kernel on for
+    any shape that fits (an explicit operator decision — no verdict
+    required), and ``auto`` defers to the measured-verdict policy in
+    :func:`gated`, so absent a recorded on-chip win the XLA path
+    stays."""
+    if mode not in DATA_PLANE_MODES:
+        raise ValueError(
+            f"[cluster] data_plane must be one of {DATA_PLANE_MODES}, "
+            f"got {mode!r}")
+    if os.environ.get(env_var) is not None:
+        return gated(name, env_var, fits, manual=manual)
+    if mode == "xla":
+        return False
+    if mode == "pallas":
+        return bool(fits)
+    return gated(name, env_var, fits, manual=manual)
+
+
+def main(argv=None) -> int:
+    """``python -m swiftmpi_tpu.ops.calibration --stale-check``: print
+    an advisory staleness report for the verdict file.  Always exits 0
+    — run_tier1.sh prints this next to the pytest verdict without ever
+    changing it."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    path = _path()
+    if not os.path.exists(path):
+        print(f"calibration: no verdict file at {path}")
+        return 0
+    stale = stale_keys()
+    total = len([v for v in _load().values() if isinstance(v, dict)])
+    if not stale:
+        print(f"calibration: {total} verdict(s) at {path} match the "
+              f"current stack {stack_key()}")
+        return 0
+    print(f"calibration ADVISORY: {len(stale)}/{total} verdict(s) at "
+          f"{path} are STALE on this stack {stack_key()} — gates fall "
+          f"back to the XLA path; re-calibrate on-chip via "
+          f"scripts/gather_micro.py --ab-only and "
+          f"scripts/scatter_micro.py --ab-only:")
+    for key, reason in stale:
+        print(f"  {key}: {reason}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

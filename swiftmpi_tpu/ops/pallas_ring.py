@@ -1,0 +1,135 @@
+"""Pallas TPU kernel: DMA ring exchange for the sparse push buckets.
+
+``TpuTransfer._build_push`` ships each device's per-home-shard request
+ids and (grads | count) buckets with two dense ``jax.lax.all_to_all``
+calls.  On an ICI ring that is a synchronous, XLA-scheduled exchange;
+SNIPPETS.md [1] and Near-Optimal Sparse Allreduce (PAPERS.md) show the
+alternative: stream each bucket to its home shard directly with
+``pltpu.make_async_remote_copy`` steps so the NIC-side DMA engines
+overlap all n-1 transfers instead of round-tripping through one fused
+collective.
+
+``ring_exchange(x, axis, n)`` is a drop-in for
+``jax.lax.all_to_all(x, axis, 0, 0, tiled=True)`` on a (n, C, ...)
+operand inside ``shard_map``: block j of the result is the block this
+device received from device j.  Schedule: the local block is copied
+VMEM-locally; then, at ring step s = 1..n-1, this device RDMA-sends
+block ``(my_id + s) % n`` of its operand into slot ``my_id`` of the
+receiver's output — every device sends to distance-s neighbor at step
+s, so each step is a pure ring shift and the n-1 steps saturate both
+ICI directions.  All sends start before any wait (the per-step DMA
+semaphore pairs keep completion accounting exact).
+
+Device addressing uses scalar ``DeviceIdType.LOGICAL`` ids — the mesh
+must be 1-D over ``axis`` (``use_ring_push`` refuses otherwise), which
+keeps the logical id equal to the axis index on chip and is the only
+form the interpret-mode discharge rule supports, so the 8-device CPU
+parity tests exercise the identical kernel.
+
+Routing: ``use_ring_push`` resolves the ``[cluster] data_plane:`` knob
+via ``calibration.data_plane_gated`` (kernel name ``ring_push``, env
+``SMTPU_RING_PUSH``) — absent a measured on-chip win on a real
+multi-chip mesh, the ``all_to_all`` wire exchange stays.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from swiftmpi_tpu.ops import calibration
+
+
+def _ring_kernel(n: int, my_id_ref, x_ref, out_ref, send_sem, recv_sem):
+    my_id = my_id_ref[0]
+    # local block: straight VMEM copy, no wire
+    out_ref[pl.ds(my_id, 1)] = x_ref[pl.ds(my_id, 1)]
+
+    def step(s):
+        dst = jax.lax.rem(my_id + s, n)
+        return pltpu.make_async_remote_copy(
+            src_ref=x_ref.at[pl.ds(dst, 1)],
+            dst_ref=out_ref.at[pl.ds(my_id, 1)],
+            send_sem=send_sem.at[s - 1],
+            recv_sem=recv_sem.at[s - 1],
+            device_id=dst,
+            device_id_type=pltpu.DeviceIdType.LOGICAL,
+        )
+
+    # start all n-1 sends, then wait all: the DMA engines overlap the
+    # transfers; per-step semaphores keep each send/recv pair exact
+    for s in range(1, n):
+        step(s).start()
+    for s in range(1, n):
+        step(s).wait()
+
+
+@functools.partial(jax.jit, static_argnames=("axis", "n", "interpret"))
+def ring_exchange(x: jax.Array, axis: str, n: int,
+                  interpret: bool | None = None) -> jax.Array:
+    """``jax.lax.all_to_all(x, axis, 0, 0, tiled=True)`` by DMA ring.
+
+    ``x`` is this device's (n, C, ...) operand under ``shard_map``
+    (first axis indexed by destination device); the result's block j is
+    the block received from device j.  ``n`` must equal the size of
+    ``axis`` and the mesh must be 1-D (see module docstring)."""
+    if x.shape[0] != n:
+        raise ValueError(
+            f"ring_exchange: leading dim {x.shape[0]} != axis size {n}")
+    if interpret is None:
+        interpret = not calibration.on_tpu()
+    my_id = jax.lax.axis_index(axis).reshape((1,)).astype(jnp.int32)
+    return pl.pallas_call(
+        functools.partial(_ring_kernel, n),
+        in_specs=[pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.ANY)],
+        out_specs=pl.BlockSpec(memory_space=pltpu.ANY),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+        scratch_shapes=[pltpu.SemaphoreType.DMA((max(n - 1, 1),)),
+                        pltpu.SemaphoreType.DMA((max(n - 1, 1),))],
+        compiler_params=pltpu.TPUCompilerParams(collective_id=0),
+        interpret=interpret,
+    )(my_id, x)
+
+
+def use_ring_push(n: int, single_axis: bool, mode: str = "auto") -> bool:
+    """Should the push wire exchange route through the DMA ring?
+    Requires a real exchange (n > 1) and a 1-D mesh over the shard
+    axis (``single_axis`` — LOGICAL device ids equal axis indices only
+    there; the hybrid data x shard mesh keeps ``all_to_all``).  Above
+    that, the ``[cluster] data_plane:`` knob / ``SMTPU_RING_PUSH`` env
+    resolution is the shared measured-verdict policy (``manual=True``:
+    the caller is inside ``shard_map``, operands are device-local)."""
+    fits = n > 1 and single_axis
+    return calibration.data_plane_gated(
+        mode, "ring_push", "SMTPU_RING_PUSH", fits, manual=True)
+
+
+def ring_supported(mesh, axis: str) -> bool:
+    """Capability probe: can the ring kernel actually run on this
+    mesh/backend (interpret discharge on CPU, Mosaic on chip)?  Runs a
+    tiny exchange under ``shard_map`` and reports success — the parity
+    tests and call sites use this to skip rather than crash on
+    environments whose pallas build lacks remote-DMA support."""
+    try:
+        from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (shim)
+        n = mesh.shape[axis]
+        if n < 2:
+            return False
+        from jax.sharding import PartitionSpec as P
+
+        @functools.partial(jax.shard_map, mesh=mesh, in_specs=P(axis),
+                           out_specs=P(axis), check_vma=False)
+        def tiny(x):
+            return ring_exchange(x[0], axis, n)[None]
+
+        x = jnp.arange(n * n * 8, dtype=jnp.float32).reshape(n, n, 8)
+        want = jax.jit(tiny)(x)
+        ref = x.reshape(n, n, 8).transpose(1, 0, 2)
+        return bool(jnp.allclose(want.reshape(n, n, 8), ref))
+    except Exception:
+        return False

@@ -1,0 +1,198 @@
+"""Pallas TPU kernel: fused span gather + sliding-window context sum.
+
+The stencil w2v step (PR 2) pulls the span's unique rows and then
+builds each center's context sum with a gather->mask->sum XLA chain:
+``v_span = pull(span_slots)``, ``v_ctx = v_span[ctx_idx]`` (a (B, 2W)
+row gather re-reading every span row ~2W times), then a masked
+reduction.  On chip that chain is three HBM-traffic passes over data
+that is only ``S = B + 2W`` unique rows of width d — ~6.6MB at the 1M
+bench shape, comfortably VMEM-resident.
+
+``fused_stencil_gather`` is the CBOW inner loop as ONE kernel:
+
+* **Phase A** (first grid step only): double-buffered per-row
+  HBM->VMEM DMA of the ≤ B+2W unique span rows, addressed by SMEM
+  scalars — the ``loop`` addressing idiom from ``pallas_gather.py``,
+  the one form chip round 3 proved Mosaic lowers (vector-value index
+  extraction and equal-shape ``take_along_axis`` are both rejected).
+  The span scratch persists across grid steps.
+* **Phase B** (every grid step, ``block_b`` centers at a time): for
+  each center b, one dynamic ref slice ``vspan[lo[b] : lo[b]+2W+1]``
+  and a (1, 2W+1) x (2W+1, d) mask-row matmul produce the context sum.
+  Sentence boundaries, per-row dynamic window radius ``half``, the
+  ``off != 0`` center exclusion and pad rows are all carried by the
+  precomputed window mask — the kernel itself is branch-free.
+
+The window mask lives in the *window frame* (positions ``lo[b]..
+lo[b]+2W``) rather than the offset frame the XLA path uses;
+:func:`stencil_window_inputs` builds it from the stream-span batch and
+is shared by the call site (models/word2vec.py) and the parity tests.
+Contributions are identical set-for-set to the XLA chain; only the
+floating-point reduction order differs (matmul vs ordered adds).
+
+Routing: ``use_fused_stencil`` resolves the ``[cluster] data_plane:``
+knob through ``calibration.data_plane_gated`` — absent a measured
+on-chip win recorded by the ``w2v_1m_fused`` bench cell or
+``scripts/gather_micro.py --stencil-ab``, the XLA chain stays.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from swiftmpi_tpu.ops import calibration
+
+#: in-flight row-DMA depth of the phase-A span stage
+_NBUF = 4
+#: default centers per grid step (bounds the neu1/wmask VMEM blocks)
+_DEF_BLOCK_B = 2048
+
+
+def _stencil_kernel(slots_ref, lo_ref, wmask_ref, table_ref,
+                    neu1_ref, vspan_ref, sems):
+    S = vspan_ref.shape[0]
+    cap = table_ref.shape[0]
+    K = wmask_ref.shape[1]            # 2W + 1
+    nbuf = min(_NBUF, S)
+
+    def row_copy(i, slot):
+        return pltpu.make_async_copy(
+            table_ref.at[pl.ds(slot, 1), :],
+            vspan_ref.at[pl.ds(i, 1), :],
+            sems.at[i % nbuf])
+
+    def start(i):
+        # clip keeps pad slots (-1) defined; pad rows are never read
+        # unmasked (their wmask column is 0 for every center)
+        row_copy(i, jnp.clip(slots_ref[i], 0, cap - 1)).start()
+
+    @pl.when(pl.program_id(0) == 0)
+    def _stage_span():
+        # double-buffered: keep nbuf row DMAs in flight, wait in order
+        for i in range(nbuf):
+            start(i)
+
+        def body(i, _):
+            row_copy(i, jnp.clip(slots_ref[i], 0, cap - 1)).wait()
+
+            @pl.when(i + nbuf < S)
+            def _():
+                start(i + nbuf)
+            return 0
+
+        jax.lax.fori_loop(0, S, body, 0)
+
+    def center(b, _):
+        lo = jnp.clip(lo_ref[b], 0, S - K)
+        win = vspan_ref[pl.ds(lo, K), :].astype(jnp.float32)   # (K, d)
+        m = wmask_ref[pl.ds(b, 1), :]                          # (1, K)
+        neu1_ref[pl.ds(b, 1), :] = jnp.dot(
+            m, win, preferred_element_type=jnp.float32)
+        return 0
+
+    jax.lax.fori_loop(0, neu1_ref.shape[0], center, 0)
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "block_b"))
+def fused_stencil_gather(table: jax.Array, slots: jax.Array,
+                         lo: jax.Array, wmask: jax.Array,
+                         interpret: bool | None = None,
+                         block_b: int = _DEF_BLOCK_B) -> jax.Array:
+    """Fused ``sum_k wmask[b,k] * table[slots[lo[b]+k]]`` -> (B, d) f32.
+
+    ``table`` stays in HBM (ANY); only the (S, d) span scratch, one
+    (block_b, d) output block and one (block_b, 2W+1) mask block are
+    VMEM-resident — callers check :func:`fits_vmem` first.  ``slots``
+    is the span's slot ids (pad rows -1), ``lo``/``wmask`` come from
+    :func:`stencil_window_inputs`.
+    """
+    S = slots.shape[0]
+    B = lo.shape[0]
+    d = table.shape[1]
+    K = wmask.shape[1]
+    if interpret is None:
+        interpret = not calibration.on_tpu()
+    bb = min(block_b, B)
+    pad = (-B) % bb
+    if pad:
+        lo = jnp.concatenate([lo, jnp.zeros((pad,), lo.dtype)])
+        wmask = jnp.concatenate(
+            [wmask, jnp.zeros((pad, K), wmask.dtype)])
+    out = pl.pallas_call(
+        _stencil_kernel,
+        grid=((B + pad) // bb,),
+        in_specs=[
+            pl.BlockSpec((S,), lambda i: (0,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bb,), lambda i: (i,), memory_space=pltpu.SMEM),
+            pl.BlockSpec((bb, K), lambda i: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),
+        ],
+        out_specs=pl.BlockSpec((bb, d), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((B + pad, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((S, d), table.dtype),
+                        pltpu.SemaphoreType.DMA((min(_NBUF, S),))],
+        interpret=interpret,
+    )(slots, lo, wmask, table)
+    return out[:B]
+
+
+def stencil_window_inputs(sent_id: jax.Array, center_pos: jax.Array,
+                          half: jax.Array, window: int):
+    """Window-frame inputs ``(lo, wmask)`` for the fused kernel, from
+    the stream-span batch (XLA ops, traced into the step).
+
+    ``lo[b]`` anchors a fixed (2W+1)-row window inside the span so the
+    kernel's ref slice is always in-bounds; ``wmask[b, k]`` is 1 iff
+    span position ``lo[b] + k`` is a true context of center b — same
+    offset/sentence/radius/pad conditions as the XLA chain's
+    ``ctx_mask``, re-expressed in window coordinates.  Every true
+    contribution (|off| <= half <= W, same sentence, in-span) lands in
+    the window exactly once: lo = clip(cp - W, 0, S - 2W - 1) keeps
+    ``cp - lo`` within [0, 2W] for any in-span context index.
+    """
+    S = sent_id.shape[0]
+    K = 2 * window + 1
+    row_valid = center_pos >= 0
+    cp = jnp.clip(center_pos, 0, S - 1)
+    lo = jnp.clip(cp - window, 0, max(S - K, 0)).astype(jnp.int32)
+    k = jnp.arange(K, dtype=jnp.int32)
+    j = lo[:, None] + k[None, :]                    # (B, K) span pos
+    off = j - cp[:, None]
+    sid_c = jnp.take(sent_id, cp)
+    wmask = ((off != 0)
+             & (jnp.abs(off) <= half[:, None])
+             & (jnp.take(sent_id, j.reshape(-1)).reshape(j.shape)
+                == sid_c[:, None])
+             & row_valid[:, None])
+    return lo, wmask.astype(jnp.float32)
+
+
+def fits_vmem(S: int, B: int, d: int, itemsize: int = 4,
+              window: int = 4, block_b: int = _DEF_BLOCK_B,
+              budget_bytes: int = 12 << 20) -> bool:
+    """Conservative VMEM check: the (S, d) span scratch plus one
+    (block_b, d) f32 output block and one (block_b, 2W+1) f32 mask
+    block under ~12MB (headroom of the ~16MB/core) — the table itself
+    never leaves HBM."""
+    bb = min(block_b, B)
+    span = S * d * itemsize
+    blk = bb * d * 4 + bb * (2 * window + 1) * 4
+    return span + blk <= budget_bytes
+
+
+def use_fused_stencil(S: int, B: int, d: int, itemsize: int,
+                      window: int, mode: str = "auto") -> bool:
+    """Should the stencil step route neu1 through the fused kernel?
+    ``mode`` is the ``[cluster] data_plane:`` knob; the per-process
+    ``SMTPU_STENCIL_FUSED`` env var overrides it (tests/experiments),
+    and ``auto`` requires a recorded on-chip win for this device kind
+    (``manual=True``: the operands are already per-device local under
+    the stencil step's single-device or shard_map context)."""
+    return calibration.data_plane_gated(
+        mode, "stencil_fused", "SMTPU_STENCIL_FUSED",
+        fits_vmem(S, B, d, itemsize, window), manual=True)

@@ -51,10 +51,12 @@ class HybridTransfer(Transfer):
 
     def __init__(self, mesh: Mesh, axis: str = SHARD_AXIS,
                  bucket_capacity: Optional[int] = None,
-                 debug_overflow: bool = False):
+                 debug_overflow: bool = False,
+                 data_plane: str = "auto"):
         self.mesh = mesh
         self.axis = axis
-        self.tail = TpuTransfer(mesh, axis, bucket_capacity, debug_overflow)
+        self.tail = TpuTransfer(mesh, axis, bucket_capacity, debug_overflow,
+                                data_plane=data_plane)
         self._hot_push_cache: Dict = {}
         self._hot_total = 0
         self._psum_bytes_total = 0

@@ -36,7 +36,8 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
 from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
-from swiftmpi_tpu.ops import calibration, pallas_gather, pallas_scatter
+from swiftmpi_tpu.ops import (calibration, pallas_gather, pallas_ring,
+                              pallas_scatter)
 from swiftmpi_tpu.parameter.key_index import window_wire_format
 from swiftmpi_tpu.transfer.api import Transfer, grad_row_bytes
 
@@ -83,7 +84,8 @@ class TpuTransfer(Transfer):
 
     def __init__(self, mesh: Mesh, axis: str = SHARD_AXIS,
                  bucket_capacity: Optional[int] = None,
-                 debug_overflow: bool = False):
+                 debug_overflow: bool = False,
+                 data_plane: str = "auto"):
         """``bucket_capacity``: per-destination request slots; defaults to
         the full local batch (no overflow possible).  Smaller values cut
         all_to_all volume ~proportionally but drop overflow requests —
@@ -95,10 +97,21 @@ class TpuTransfer(Transfer):
         readable via :meth:`overflow_count` (and mirrored into ``metrics``
         if one is attached).  With ``debug_overflow=True`` each call
         synchronously checks the count and raises — slow, but turns silent
-        training corruption into an immediate failure."""
+        training corruption into an immediate failure.
+
+        ``data_plane``: the ``[cluster] data_plane:`` knob (``auto`` /
+        ``pallas`` / ``xla``) steering the push wire exchange between
+        ``all_to_all`` and the Pallas DMA ring
+        (ops/pallas_ring.py) — resolved per measured calibration
+        verdict by :func:`pallas_ring.use_ring_push`."""
         self.mesh = mesh
         self.axis = axis
         self.n = int(mesh.shape[axis])
+        if data_plane not in calibration.DATA_PLANE_MODES:
+            raise ValueError(
+                f"data_plane must be one of "
+                f"{calibration.DATA_PLANE_MODES}, got {data_plane!r}")
+        self.data_plane = data_plane
         # hybrid multi-host mesh (ps_mesh(hybrid=True)): a leading data
         # axis across processes/DCN.  Each data group holds a full table
         # replica and routes requests over its own shard axis (ICI); the
@@ -547,6 +560,19 @@ class TpuTransfer(Transfer):
         out_specs = (state_specs, P()) if counted else state_specs
 
         dp = int(self.mesh.shape[self.dp_axis]) if self.dp_axis else 1
+        # wire-exchange routing, resolved at trace time: the Pallas DMA
+        # ring replaces both all_to_all rounds when the data_plane knob
+        # / measured ring_push verdict says so (1-D mesh only — see
+        # ops/pallas_ring.py on LOGICAL device ids)
+        use_ring = pallas_ring.use_ring_push(
+            self.n, self.dp_axis is None, self.data_plane)
+
+        def _wire_exchange(x, ring=None):
+            if use_ring if ring is None else ring:
+                with jax.named_scope("pallas_ring_push"):
+                    return pallas_ring.ring_exchange(x, self.axis, self.n)
+            with jax.named_scope("wire_exchange"):
+                return jax.lax.all_to_all(x, self.axis, 0, 0, tiled=True)
 
         @partial(jax.shard_map, mesh=self.mesh,
                  in_specs=(state_specs, bspec, grad_specs),
@@ -557,11 +583,11 @@ class TpuTransfer(Transfer):
             req, order, so, idx = _bucketize(
                 slots_l, self.n, cap_per_shard, C)
             # phase names match obs.span()/telemetry: the collectives are
-            # "wire_exchange", the owner-side access update is "apply" —
+            # "wire_exchange" (or "pallas_ring_push" when the DMA ring
+            # is routed), the owner-side access update is "apply" —
             # host timing is meaningless inside jit, so the device trace
             # carries the names instead (docs/ARCHITECTURE.md).
-            with jax.named_scope("wire_exchange"):
-                got = jax.lax.all_to_all(req, self.axis, 0, 0, tiled=True)
+            got = _wire_exchange(req)
             ok = got >= 0
             # received (slot, grad) pairs -> dense per-shard grad sums;
             # untouched rows get exact zero and the access rule is a no-op.
@@ -606,9 +632,13 @@ class TpuTransfer(Transfer):
                 col_idx = jnp.clip(idx, 0, C - 1)
                 bucket = bucket.at[row_idx, col_idx].set(
                     g[order], mode="drop")
-                with jax.named_scope("wire_exchange"):
-                    recv = jax.lax.all_to_all(bucket, self.axis, 0, 0,
-                                              tiled=True)
+                # the width-1 counts bucket always rides all_to_all: its
+                # bytes are noise next to the d-wide grad buckets, and
+                # inv-scaling ring-fed grad sums by a ring-fed counts
+                # column trips an XLA reshape CHECK during the interpret
+                # discharge (jaxlib 0.4.x, array.h new_num_elements)
+                recv = _wire_exchange(
+                    bucket, ring=use_ring and f != "__counts__")
                 if sparse_dcn:
                     # batch-proportional DCN traffic: every group's
                     # received pairs, applied by everyone identically

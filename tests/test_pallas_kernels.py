@@ -394,3 +394,86 @@ def test_interpret_exercise_upgrades_marker(monkeypatch, tmp_path):
     assert calibration.pallas_status(
         "TPU v5 lite") == "validated: win (vmem_scatter)"
     calibration.reset_cache()
+
+
+def test_calibration_stack_stamp_and_staleness(monkeypatch, tmp_path,
+                                               capsys):
+    """Verdict identity includes the software stack: record() stamps
+    jaxlib/libtpu, and lookup() rejects — loudly, once per key — any
+    verdict recorded without a stamp or under a different stack, while
+    a current-stack verdict keeps resolving."""
+    import json
+
+    from swiftmpi_tpu.ops import calibration
+
+    path = tmp_path / "c.json"
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(path))
+    calibration.reset_cache()
+
+    # record() stamps the current stack into the persisted verdict
+    calibration.record("ring_push", "TPU v5 lite",
+                       {"win": True, "pallas_ms": 1.0, "xla_ms": 2.0})
+    raw = json.loads(path.read_text())
+    assert raw["ring_push:TPU v5 lite"]["stack"] == calibration.stack_key()
+
+    # externally-written file: one pre-stamp entry, one foreign-stack
+    # entry, one current-stack entry
+    raw["stencil_fused:TPU v4"] = {
+        "win": True, "pallas_ms": 1.0, "xla_ms": 2.0}
+    raw["vmem_gather:TPU v4"] = {
+        "win": True, "pallas_ms": 1.0, "xla_ms": 2.0,
+        "stack": {"jaxlib": "0.0.1", "libtpu": "none"}}
+    path.write_text(json.dumps(raw))
+    calibration.reset_cache()
+
+    assert calibration.lookup("stencil_fused", "TPU v4") is None
+    err = capsys.readouterr().err
+    assert "RE-CALIBRATE" in err and "stencil_fused:TPU v4" in err
+    assert "pre-stamp" in err
+    # the warning fires once per key, not per lookup
+    assert calibration.lookup("stencil_fused", "TPU v4") is None
+    assert "RE-CALIBRATE" not in capsys.readouterr().err
+
+    assert calibration.lookup("vmem_gather", "TPU v4") is None
+    err = capsys.readouterr().err
+    assert "RE-CALIBRATE" in err and "different stack" in err
+    assert "jaxlib 0.0.1" in err
+
+    # the current-stack verdict still steers gates
+    assert calibration.lookup("ring_push", "TPU v5 lite")["win"]
+
+    stale = dict(calibration.stale_keys())
+    assert set(stale) == {"stencil_fused:TPU v4", "vmem_gather:TPU v4"}
+    calibration.reset_cache()
+
+
+def test_calibration_stale_check_cli(monkeypatch, tmp_path, capsys):
+    """`python -m swiftmpi_tpu.ops.calibration --stale-check` is the
+    run_tier1.sh advisory: exit 0 always, ADVISORY text only when some
+    verdict is stale on this stack."""
+    import json
+
+    from swiftmpi_tpu.ops import calibration
+
+    path = tmp_path / "c.json"
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(path))
+    calibration.reset_cache()
+
+    assert calibration.main(["--stale-check"]) == 0
+    assert "no verdict file" in capsys.readouterr().out
+
+    calibration.record("ring_push", "TPU v5 lite",
+                       {"win": True, "pallas_ms": 1.0, "xla_ms": 2.0})
+    calibration.reset_cache()
+    assert calibration.main(["--stale-check"]) == 0
+    assert "match the current stack" in capsys.readouterr().out
+
+    raw = json.loads(path.read_text())
+    raw["stencil_fused:TPU v4"] = {"win": True}
+    path.write_text(json.dumps(raw))
+    calibration.reset_cache()
+    assert calibration.main(["--stale-check"]) == 0
+    out = capsys.readouterr().out
+    assert "ADVISORY" in out and "1/2" in out
+    assert "stencil_fused:TPU v4" in out
+    calibration.reset_cache()

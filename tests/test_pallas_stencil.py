@@ -1,0 +1,293 @@
+"""Fused stencil-gather kernel tests (ops/pallas_stencil.py, interpret
+mode on CPU): parity against a sequential numpy oracle — sentence
+boundaries, dynamic window radii, pad rows, epoch-tail partial spans —
+window-frame mask equivalence to the XLA offset-frame chain, the
+VMEM/knob routing, and end-to-end w2v step/train parity with the kernel
+forced on via SMTPU_STENCIL_FUSED (the on-chip A/B lives in
+scripts/gather_micro.py --stencil-ab and the w2v_1m_fused bench cell).
+"""
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from swiftmpi_tpu.data.text import CBOWBatcher, build_vocab  # noqa: E402
+from swiftmpi_tpu.models.word2vec import Word2Vec  # noqa: E402
+from swiftmpi_tpu.ops import calibration  # noqa: E402
+from swiftmpi_tpu.ops.pallas_stencil import (fits_vmem,  # noqa: E402
+                                             fused_stencil_gather,
+                                             stencil_window_inputs,
+                                             use_fused_stencil)
+from swiftmpi_tpu.utils import ConfigParser  # noqa: E402
+
+
+def _np_context_sums(table, slots, sent_id, center_pos, half):
+    """Sequential oracle: for each valid center, the sum of span rows at
+    true context positions (same sentence, 0 < |off| <= half) — the
+    contract both the XLA chain and the fused kernel must satisfy."""
+    S = len(slots)
+    out = np.zeros((len(center_pos), table.shape[1]), np.float32)
+    for b, cp in enumerate(center_pos):
+        cp = int(cp)
+        if cp < 0:
+            continue
+        for j in range(max(cp - int(half[b]), 0),
+                       min(cp + int(half[b]) + 1, S)):
+            if j == cp or sent_id[j] != sent_id[cp]:
+                continue
+            out[b] += table[max(int(slots[j]), 0)]
+    return out
+
+
+def _synthetic_span(rng, S, B, W, cap, n_pad_rows=5, n_pad_centers=9):
+    """A stream-span batch with short sentences (boundary masking), a
+    padded span tail (sent_id -1 / slot -1) and padded centers
+    (center_pos -1 / half 0) — every sentinel the wire format defines."""
+    n_valid = S - n_pad_rows
+    slots = np.full(S, -1, np.int32)
+    slots[:n_valid] = rng.integers(0, cap, n_valid)
+    sent_id = np.full(S, -1, np.int32)
+    sent_id[:n_valid] = np.arange(n_valid, dtype=np.int32) // 7
+    n_words = B - n_pad_centers
+    center_pos = np.full(B, -1, np.int32)
+    center_pos[:n_words] = rng.integers(0, n_valid, n_words)
+    half = np.zeros(B, np.int32)
+    half[:n_words] = rng.integers(1, W + 1, n_words)
+    return slots, sent_id, center_pos, half
+
+
+@pytest.mark.parametrize("W,B,d,block_b", [(2, 50, 8, 16), (4, 96, 20, 96)])
+def test_fused_stencil_matches_numpy_oracle(W, B, d, block_b):
+    """Kernel parity vs the sequential oracle, including a block_b that
+    does not divide B (the padded-grid path) and one that equals it."""
+    rng = np.random.default_rng(3)
+    S, cap = B + 2 * W, 211
+    table = rng.standard_normal((cap, d)).astype(np.float32)
+    slots, sent_id, center_pos, half = _synthetic_span(rng, S, B, W, cap)
+    lo, wmask = stencil_window_inputs(
+        jnp.asarray(sent_id), jnp.asarray(center_pos),
+        jnp.asarray(half), W)
+    got = np.asarray(fused_stencil_gather(
+        jnp.asarray(table), jnp.asarray(slots), lo, wmask,
+        block_b=block_b))
+    want = _np_context_sums(table, slots, sent_id, center_pos, half)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_stencil_bf16_table():
+    """bf16 storage rows: the kernel upcasts the window to f32 before
+    the mask matmul, so the result is the f32 sum of bf16 rows."""
+    rng = np.random.default_rng(8)
+    W, B, d = 2, 32, 16
+    S, cap = B + 2 * W, 97
+    table = rng.standard_normal((cap, d)).astype(np.float32)
+    slots, sent_id, center_pos, half = _synthetic_span(rng, S, B, W, cap)
+    t16 = jnp.asarray(table, jnp.bfloat16)
+    lo, wmask = stencil_window_inputs(
+        jnp.asarray(sent_id), jnp.asarray(center_pos),
+        jnp.asarray(half), W)
+    got = np.asarray(fused_stencil_gather(
+        t16, jnp.asarray(slots), lo, wmask, block_b=16))
+    assert got.dtype == np.float32
+    want = _np_context_sums(np.asarray(t16, np.float32), slots, sent_id,
+                            center_pos, half)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+
+
+def test_fused_stencil_epoch_tail_batch():
+    """A REAL batcher epoch-tail batch (n_words < B, padded span): the
+    kernel must zero every padded center and match the oracle on the
+    real ones — the exact batch shape the w2v step sees at epoch end."""
+    rng = np.random.default_rng(0)
+    p = 1.0 / np.arange(1, 31)
+    p /= p.sum()
+    sents = [list(map(int, rng.choice(np.arange(1, 31), size=9, p=p)))
+             for _ in range(12)]
+    vocab = build_vocab(sents)
+    W, B = 2, 256
+    batches = list(CBOWBatcher(sents, vocab, W, seed=5).epoch_stencil(B))
+    tail = batches[-1]
+    assert 0 < tail.n_words < B
+    cap = int(tail.tokens.max()) + 1
+    table = rng.standard_normal((cap, 12)).astype(np.float32)
+    lo, wmask = stencil_window_inputs(
+        jnp.asarray(tail.sent_id), jnp.asarray(tail.center_pos),
+        jnp.asarray(tail.half), W)
+    got = np.asarray(fused_stencil_gather(
+        jnp.asarray(table), jnp.asarray(tail.tokens.astype(np.int32)),
+        lo, wmask, block_b=64))
+    want = _np_context_sums(table, tail.tokens, tail.sent_id,
+                            tail.center_pos, tail.half)
+    np.testing.assert_allclose(got, want, rtol=1e-5, atol=1e-5)
+    assert (got[tail.n_words:] == 0).all()
+
+
+def test_window_mask_matches_offset_frame():
+    """Frame-change equivalence: scatter both masks into dense (B, S)
+    center-x-span indicators — the window-frame mask must mark exactly
+    the contributions the XLA chain's offset-frame ctx_mask marks,
+    each exactly once (the 'lands in the window exactly once' claim)."""
+    rng = np.random.default_rng(5)
+    S, B, W = 40, 34, 3
+    sent_id = (np.arange(S, dtype=np.int32) // 6)
+    center_pos = np.arange(W, W + B, dtype=np.int32)
+    half = rng.integers(1, W + 1, B).astype(np.int32)
+    lo, wmask = stencil_window_inputs(
+        jnp.asarray(sent_id), jnp.asarray(center_pos),
+        jnp.asarray(half), W)
+    lo, wmask = np.asarray(lo), np.asarray(wmask)
+    offsets = np.concatenate([np.arange(-W, 0), np.arange(1, W + 1)])
+    ctx_idx = center_pos[:, None] + offsets[None, :]
+    ci = np.clip(ctx_idx, 0, S - 1)
+    off_mask = ((ctx_idx >= 0) & (ctx_idx < S)
+                & (sent_id[ci] == sent_id[center_pos][:, None])
+                & (np.abs(offsets)[None, :] <= half[:, None]))
+    dense_off = np.zeros((B, S))
+    dense_win = np.zeros((B, S))
+    for b in range(B):
+        for k in range(2 * W):
+            if off_mask[b, k]:
+                dense_off[b, ci[b, k]] += 1
+        for k in range(2 * W + 1):
+            if wmask[b, k]:
+                dense_win[b, lo[b] + k] += 1
+    np.testing.assert_array_equal(dense_win, dense_off)
+
+
+def test_fits_vmem_bounds():
+    # the 1M bench stencil shape fits in both storage widths; a span
+    # that is itself larger than VMEM never routes
+    assert fits_vmem(16384 + 8, 16384, 100, 4, 4)
+    assert fits_vmem(16384 + 8, 16384, 100, 2, 4)
+    assert not fits_vmem(1 << 20, 1 << 20, 100, 4, 4)
+
+
+def test_use_fused_stencil_gate(monkeypatch, tmp_path):
+    """[cluster] data_plane knob resolution: env override strongest,
+    then xla=off / pallas=on-if-fits / auto=measured-verdict policy."""
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(tmp_path / "c.json"))
+    calibration.reset_cache()
+    shape = (100, 64, 8, 4, 2)              # S, B, d, itemsize, W: fits
+    monkeypatch.delenv("SMTPU_STENCIL_FUSED", raising=False)
+    assert not use_fused_stencil(*shape, mode="auto")   # cpu, no verdict
+    assert not use_fused_stencil(*shape, mode="xla")
+    assert use_fused_stencil(*shape, mode="pallas")     # operator pin
+    assert not use_fused_stencil(1 << 20, 1 << 20, 100, 4, 4,
+                                 mode="pallas")         # doesn't fit
+    monkeypatch.setenv("SMTPU_STENCIL_FUSED", "1")
+    assert use_fused_stencil(*shape, mode="xla")        # env beats knob
+    monkeypatch.setenv("SMTPU_STENCIL_FUSED", "0")
+    assert not use_fused_stencil(*shape, mode="pallas")
+    monkeypatch.delenv("SMTPU_STENCIL_FUSED", raising=False)
+    with pytest.raises(ValueError):
+        use_fused_stencil(*shape, mode="bogus")
+    # a recorded on-chip win flips auto for that device kind only
+    monkeypatch.setattr(calibration, "on_tpu", lambda: True)
+    monkeypatch.setattr(calibration, "device_key", lambda: "TPU v5 lite")
+    calibration.record("stencil_fused", "TPU v5 lite",
+                       {"win": True, "pallas_ms": 1.0, "xla_ms": 2.0})
+    assert use_fused_stencil(*shape, mode="auto")
+    monkeypatch.setattr(calibration, "device_key", lambda: "TPU v4")
+    assert not use_fused_stencil(*shape, mode="auto")
+    calibration.reset_cache()
+
+
+# -- end-to-end: the word2vec stencil step with the kernel forced on ------
+
+
+def _corpus(seed=3):
+    rng = np.random.default_rng(seed)
+    p = 1.0 / np.arange(1, 31)
+    p /= p.sum()
+    return [list(map(int, rng.choice(np.arange(1, 31), size=12, p=p)))
+            for _ in range(40)]
+
+
+def _stencil_model():
+    cfg = ConfigParser().update({
+        "cluster": {"server_num": 2, "transfer": "xla"},
+        "word2vec": {"len_vec": 16, "window": 2, "negative": 5,
+                     "sample": -1, "learning_rate": 0.05,
+                     "min_sentence_length": 2, "stencil": 1},
+        "server": {"initial_learning_rate": 0.3},
+        "worker": {"minibatch": 512},
+    })
+    return Word2Vec(config=cfg)
+
+
+def test_w2v_fused_step_matches_xla(monkeypatch, devices8):
+    """One donated stencil step with the fused kernel forced on vs the
+    XLA chain — full batch AND padded epoch-tail batch: identical
+    contribution sets, so loss and post-step state agree to fp32
+    reassociation tolerance (the only difference is reduction order)."""
+    sents = _corpus()
+    for B in (24, 512):
+        results = {}
+        for flag in ("0", "1"):
+            monkeypatch.setenv("SMTPU_STENCIL_FUSED", flag)
+            m = _stencil_model()
+            m.build(sents)
+            step = m._build_step()
+            assert m.resolved_rendering == "stencil"
+            batch = next(iter(CBOWBatcher(
+                sents, m.vocab, m.window, m.sample,
+                seed=13).epoch_stencil(B)))
+            if B == 512:
+                assert batch.n_words < B
+            state = {f: jnp.array(v) for f, v in m.table.state.items()}
+            state, es, ec = step(
+                state, m._slot_of_vocab, m._alias_prob, m._alias_idx,
+                jnp.asarray(batch.tokens), jnp.asarray(batch.sent_id),
+                jnp.asarray(batch.center_pos), jnp.asarray(batch.half),
+                jax.random.key(11))
+            results[flag] = (float(es), int(ec),
+                             {f: np.asarray(v) for f, v in state.items()})
+        es0, ec0, st0 = results["0"]
+        es1, ec1, st1 = results["1"]
+        assert ec0 == ec1
+        assert es0 == pytest.approx(es1, rel=1e-5)
+        for f in st0:
+            np.testing.assert_allclose(st1[f], st0[f], rtol=1e-4,
+                                       atol=1e-6, err_msg=f"B={B} {f}")
+
+
+def test_w2v_fused_train_matches_xla(monkeypatch, devices8):
+    """3 epochs through the public train() path, fused vs XLA: same
+    seed, same batch stream, same per-step keys — the loss trajectories
+    must coincide to reassociation tolerance."""
+    sents = _corpus()
+    losses = {}
+    for flag in ("0", "1"):
+        monkeypatch.setenv("SMTPU_STENCIL_FUSED", flag)
+        m = _stencil_model()
+        losses[flag] = m.train(sents, niters=3, batch_size=64)
+    assert losses["1"][-1] < losses["1"][0]
+    np.testing.assert_allclose(losses["1"], losses["0"], rtol=1e-4)
+
+
+@pytest.mark.slow
+def test_stencil_ab_cell_records_verdict(monkeypatch, tmp_path):
+    """The `gather_micro --stencil-ab` cell end-to-end at reduced
+    shape (the chip-session lane, excluded from tier-1): runs the A/B
+    — measured ms on-chip, interpret parity off-chip — and records a
+    stack-stamped verdict under the right device kind."""
+    import os
+    import sys
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    sys.path.insert(0, os.path.join(repo, "scripts"))
+    import gather_micro
+
+    from swiftmpi_tpu.ops import calibration
+
+    monkeypatch.setenv("SMTPU_CALIBRATION", str(tmp_path / "c.json"))
+    calibration.reset_cache()
+    gather_micro.stencil_ab(B=256, W=4, d=32, cap=4096)
+    kind = (calibration.device_key() if calibration.on_tpu()
+            else calibration.INTERPRET_KIND)
+    v = calibration.lookup("stencil_fused", kind)
+    assert v is not None
+    assert v["stack"] == calibration.stack_key()
+    calibration.reset_cache()
