@@ -821,6 +821,116 @@ def _bench_w2v_1m_pipeline(device, timed_calls):
             "rendering": getattr(model, "resolved_rendering", None)}
 
 
+def _bench_serve_qps(device, streams=None):
+    """Train-while-serving cell (serve/): a demo-shape w2v trains
+    through the PUBLIC train() path with the snapshot publisher armed
+    ([serve] every) while ``streams`` (default 4, BENCH_SERVE_STREAMS)
+    concurrent query threads — each with its OWN EmbeddingReader over
+    the shared publisher — issue Zipf-distributed batched reads plus a
+    periodic on-device top-k.  The cell reports aggregate qps and the
+    pooled p50/p99 per-query latency, the combined front/hot hit ratio,
+    how many snapshot versions the trainer published, and the pull-side
+    wire ledger (transfer/pull_*) for the training loop that ran
+    underneath.  Both the reader path and the train step are warmed
+    before the clock starts; the timed region is the genuinely
+    concurrent train + serve phase (this is a contention measurement,
+    not a quiet-device microbench)."""
+    import threading
+    import jax
+    import numpy as np
+    from swiftmpi_tpu.cluster.cluster import Cluster
+    from swiftmpi_tpu.data.text import synthetic_corpus
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+    from swiftmpi_tpu.serve import EmbeddingReader
+    from swiftmpi_tpu.utils import ConfigParser
+
+    streams = streams or int(os.environ.get("BENCH_SERVE_STREAMS", 4))
+    every = int(os.environ.get("BENCH_SERVE_EVERY", 4))
+    topk = int(os.environ.get("BENCH_SERVE_TOPK", 10))
+    rows_per_query = 64
+    niters = int(os.environ.get("BENCH_SERVE_ITERS", 3))
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla", "server_num": 1},
+        "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
+                     "sample": 1e-5, "learning_rate": 0.05},
+        "server": {"initial_learning_rate": 0.7, "frag_num": 1000,
+                   "dtype": os.environ.get("BENCH_DTYPE", "float32")},
+        "worker": {"minibatch": 5000},
+        "serve": {"every": every, "depth": 2},
+    })
+    with jax.default_device(device):
+        model = Word2Vec(
+            config=cfg, cluster=Cluster(cfg, devices=[device]).initialize())
+        corpus = synthetic_corpus(SENTENCES, VOCAB, SENT_LEN, seed=11)
+        model.build(corpus)
+        model.transfer.count_traffic = True
+        # warm arm 1: compile the train step AND publish first snapshots
+        model.train(corpus, niters=1)
+    pub = model.serving_publisher()
+    keys = model.vocab.keys
+    p = model.vocab.counts.astype(np.float64)
+    p /= p.sum()
+    # warm arm 2: reader + topk jit, off the clock
+    warm = EmbeddingReader(pub, field="v")
+    warm.read(keys[:rows_per_query])
+    warm.topk(keys[:4], k=topk)
+
+    stop = threading.Event()
+    readers = [EmbeddingReader(pub, field="v") for _ in range(streams)]
+
+    def query_stream(idx):
+        r = readers[idx]
+        rng = np.random.default_rng(1000 + idx)
+        i = 0
+        while not stop.is_set():
+            qk = rng.choice(keys, size=rows_per_query, p=p)
+            if i % 16 == 15:
+                r.topk(qk[:4], k=topk)
+            else:
+                r.read(qk)
+            i += 1
+
+    with jax.default_device(device):
+        threads = [threading.Thread(target=query_stream, args=(i,),
+                                    daemon=True) for i in range(streams)]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        model.train(corpus, niters=niters)
+        stop.set()
+        for t in threads:
+            t.join(timeout=30)
+        dt = time.perf_counter() - t0
+    lat = np.sort(np.concatenate(
+        [np.asarray(r._lat_ms, np.float64) for r in readers]))
+    queries = int(sum(r.stats["queries"] for r in readers))
+    hits = sum(r.stats["hot_hits"] + r.stats["front_hits"]
+               for r in readers)
+    served = hits + sum(r.stats["tail_misses"] for r in readers)
+    hit_ratio = hits / max(served, 1)
+    tr = model.transfer.traffic()
+    steps = pub.train_step
+
+    def q(arr, frac):
+        return float(arr[min(int(frac * len(arr)), len(arr) - 1)]) \
+            if len(arr) else 0.0
+    return {"qps": round(queries / dt, 1),
+            "p50_ms": round(q(lat, 0.50), 3),
+            "serve_p99_ms": round(q(lat, 0.99), 3),
+            "hit_ratio": round(hit_ratio, 4),
+            "serve_miss_ratio": round(1.0 - hit_ratio, 4),
+            "streams": streams, "queries": queries,
+            "rows_per_query": rows_per_query,
+            "snapshots": pub.version,
+            "staleness_bound_steps": every, "topk": topk,
+            "train_iters": niters, "train_steps": steps,
+            "pull_rows": int(tr.get("pull_rows", 0)),
+            "pull_bytes_per_step": round(
+                tr.get("pull_bytes", 0) / max(steps, 1), 1),
+            "vocab": VOCAB,
+            "dtype": os.environ.get("BENCH_DTYPE", "float32")}
+
+
 def _bench_w2v_1m_fused(device, timed_calls):
     """In-cell pallas-vs-xla A/B of the fused stencil-gather kernel
     (ops/pallas_stencil.py) at the 1M-vocab stencil shape.  Both arms
@@ -1601,6 +1711,16 @@ def child_main(which: str) -> None:
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
+    if os.environ.get("BENCH_ONLY") == "serve":
+        # train-while-serving cell: concurrent query streams over the
+        # snapshot publisher while the PUBLIC train() path runs — the
+        # serving plane's qps / p50 / p99 / hit-ratio measurement (own
+        # child: the contention phase must not share a process with
+        # other timed cells)
+        out["serve_qps"] = _bench_serve_qps(device)
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
     if os.environ.get("BENCH_ONLY") == "scale_pipeline":
         # asynchronous input pipeline over the window+hybrid
         # stencil+pool composition, through the PUBLIC train() path —
@@ -1762,6 +1882,8 @@ _SHAPE_ENV = ("BENCH_BATCH", "BENCH_SCAN", "BENCH_ONLY", "BENCH_DTYPE",
               "BENCH_TFM_DMODEL", "BENCH_TFM_LAYERS",
               "BENCH_TFM_REMAT_POLICY", "BENCH_EPOCH_FUSED",
               "BENCH_SCALE_SHARED", "BENCH_LR_EPOCHS",
+              "BENCH_SERVE_STREAMS", "BENCH_SERVE_EVERY",
+              "BENCH_SERVE_TOPK", "BENCH_SERVE_ITERS",
               # kernel-gate forces (chip_session's nopallas stage) and
               # the verdict-file relocation: a gates-off or
               # experimental-verdict archive is NOT a canonical

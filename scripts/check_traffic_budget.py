@@ -43,18 +43,29 @@ import json
 import sys
 
 #: lower-is-better counters the budget covers, with the detail fields
-#: printed for context when a covered cell is reported
+#: printed for context when a covered cell is reported.  The serving
+#: plane's serve_qps cell gates on its tail latency (serve_p99_ms) and
+#: on hit-ratio REGRESSION via the lower-is-better complement
+#: serve_miss_ratio; pull_bytes_per_step budgets the pull-side wire
+#: ledger the same way wire_bytes_per_step budgets pushes.
 TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
                    "dispatches_per_window", "stall_ms_per_step",
-                   "kernel_ms")
+                   "kernel_ms", "serve_p99_ms", "serve_miss_ratio",
+                   "pull_bytes_per_step")
 DETAIL_METRICS = ("window_sparse", "window_dense", "coalesce_ratio",
                   "push_window", "host_stall_ms", "queue_depth",
-                  "pipeline", "speedup_vs_off")
+                  "pipeline", "speedup_vs_off", "qps", "p50_ms",
+                  "hit_ratio", "streams", "snapshots",
+                  "staleness_bound_steps")
 #: absolute increase a metric must clear before it can regress: wall-
 #: clock metrics jitter run to run while the counter metrics are exact,
 #: so only the former get a floor (ms for the stall split; kernel_ms is
-#: a microbench mean over many reps, tighter than one stall sample)
-ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1, "kernel_ms": 0.05}
+#: a microbench mean over many reps, tighter than one stall sample;
+#: serve_p99_ms is one tail sample under deliberate train/serve
+#: contention — the stall gate's 0.1ms convention applies; a
+#: miss-ratio wiggle under 1 point is query-stream sampling noise)
+ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1, "kernel_ms": 0.05,
+                   "serve_p99_ms": 0.1, "serve_miss_ratio": 0.01}
 
 
 def load_telemetry_cells(path: str) -> dict:
@@ -74,6 +85,9 @@ def load_telemetry_cells(path: str) -> dict:
         cell["wire_bytes_per_step"] = wire / steps
     if disp:
         cell["dispatches_per_step"] = disp / steps
+    pull = sum(m.get("pull_bytes", 0.0) for m in t["transfer"].values())
+    if pull:
+        cell["pull_bytes_per_step"] = pull / steps
     if "stall_ms_per_step" in t:
         cell["stall_ms_per_step"] = t["stall_ms_per_step"]
     for decision in ("window_sparse", "window_dense"):

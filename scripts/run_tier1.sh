@@ -10,6 +10,15 @@ set -o pipefail; rm -f /tmp/_t1.log; timeout -k 10 870 env JAX_PLATFORMS=cpu pyt
 if [ -n "$BENCH_BASELINE" ] && [ -n "$BENCH_CANDIDATE" ] && [ -r "$BENCH_BASELINE" ] && [ -r "$BENCH_CANDIDATE" ]; then
   echo "--- traffic budget (advisory) ---"
   python "$(dirname "$0")/check_traffic_budget.py" "$BENCH_BASELINE" "$BENCH_CANDIDATE" || echo "traffic budget ADVISORY FAILURE (tier-1 verdict unchanged)"
+  # Serving-plane gate over the same files: p99 query latency +
+  # hit-ratio regression on the serve_qps cell (0.1ms / 1pt noise
+  # floors — check_traffic_budget.ABS_NOISE_FLOOR).  Only runs when
+  # both sides actually carry the cell, so bench files from before the
+  # serving plane never turn the advisory line into exit-2 noise.
+  if grep -q '"serve_qps"' "$BENCH_BASELINE" && grep -q '"serve_qps"' "$BENCH_CANDIDATE"; then
+    echo "--- serve budget (advisory) ---"
+    python "$(dirname "$0")/check_traffic_budget.py" --cells serve_qps "$BENCH_BASELINE" "$BENCH_CANDIDATE" || echo "serve budget ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
 fi
 # Advisory calibration staleness check: verdicts recorded under another
 # jaxlib/libtpu stack no longer steer data-plane gates — say so next to

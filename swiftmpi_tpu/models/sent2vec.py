@@ -63,6 +63,11 @@ class Sent2Vec:
         self._key = jax.random.key(seed ^ 0xD0C)
         self._infer = None
         self.error = Error()
+        # serving plane: attach a serve.SnapshotPublisher and
+        # infer_sentences() publishes the finished sentence vectors as a
+        # {"sent": (S, d)} snapshot keyed by sentence id — the top-k
+        # query path then answers nearest-sentence queries
+        self.serve_publisher = None
 
     # -- the jitted inference kernel ---------------------------------------
     def _build_infer(self):
@@ -133,8 +138,13 @@ class Sent2Vec:
 
     # -- driver (sent2vec.cpp:37-104) --------------------------------------
     def infer_sentences(self, lines: List[str], niters: int = 10,
-                        tokenize_mode: str = "int"
+                        tokenize_mode: str = "int", snapshot=None
                         ) -> List[Tuple[int, np.ndarray]]:
+        """``snapshot``: a serve.TableSnapshot of the word table — when
+        given, inference reads h/v and the key→slot map from that frozen
+        published view instead of the live table, so it can run
+        concurrently with a training loop (bounded staleness, never a
+        torn mid-push state)."""
         wm = self.word_model
         if wm.vocab is None:
             raise RuntimeError(
@@ -142,6 +152,14 @@ class Sent2Vec:
                 "dump via build_word_model_from_dump()")
         if self._infer is None:
             self._infer = self._build_infer()
+        if snapshot is not None:
+            h_table, v_table = (snapshot.tail_array("h"),
+                                snapshot.tail_array("v"))
+            slot_of_vocab = jnp.asarray(
+                snapshot.lookup(wm.vocab.keys), jnp.int32)
+        else:
+            h_table, v_table = wm.table.state["h"], wm.table.state["v"]
+            slot_of_vocab = wm._slot_of_vocab
         prob, alias = build_unigram_alias(wm.vocab.counts)
         # All-OOV lines are skipped entirely, like the reference skips
         # unparseable lines (sent2vec.cpp:71-74) — no garbage vectors.
@@ -182,13 +200,13 @@ class Sent2Vec:
             for i, (_, t) in enumerate(chunk):
                 vocab_pos[i, :len(t)] = t
                 mask[i, :len(t)] = True
-            slots = np.asarray(wm._slot_of_vocab)[vocab_pos]
+            slots = np.asarray(slot_of_vocab)[vocab_pos]
             self._key, sub = jax.random.split(self._key)
             vecs, err = self._infer(
-                wm.table.state["h"], wm.table.state["v"],
+                h_table, v_table,
                 jnp.asarray(slots), jnp.asarray(mask),
                 jnp.asarray(prob), jnp.asarray(alias),
-                wm._slot_of_vocab, jnp.asarray(vocab_pos),
+                slot_of_vocab, jnp.asarray(vocab_pos),
                 niters, sub)
             queued.append((chunk, vecs, err))
             while len(queued) >= MAX_IN_FLIGHT:
@@ -197,6 +215,15 @@ class Sent2Vec:
             drain_one()
         log.info("sent2vec: %d sentences, error %.5f",
                  len(out), self.error.norm())
+        if self.serve_publisher is not None and out:
+            # publish the finished sentence vectors as a snapshot keyed
+            # by sentence id — serve.query answers nearest-sentence
+            # queries over it exactly like word neighbors
+            self.serve_publisher.publish(
+                {"sent": np.stack([v for _, v in out])},
+                keys=np.array([s for s, _ in out], np.uint64),
+                slots=np.arange(len(out), dtype=np.int64),
+                meta={"query_field": "sent"})
         return out
 
     def write(self, results, path: str) -> None:

@@ -101,6 +101,10 @@ class Trainer:
         # reshard (the H2D transfer the pipeline hides) as stall
         self.meter = Throughput()
         self.pipeline_stats: dict = {}
+        # serving plane: attach a serve.SnapshotPublisher here and
+        # step() publishes a params-only snapshot every K steps (dense
+        # params carry no key map — readers use the pytree directly)
+        self.serve_publisher = None
 
     # -- state ------------------------------------------------------------
     def init_state(self, key) -> TrainState:
@@ -191,7 +195,10 @@ class Trainer:
                 state.params, state.opt_state, state.step, tokens)
         self.meter.record(int(np.prod(tokens.shape)))
         obs.record_step(1)
-        return TrainState(params, opt_state, step), loss
+        out = TrainState(params, opt_state, step)
+        if self.serve_publisher is not None:
+            self.serve_publisher.on_steps(out.params, n=1)
+        return out, loss
 
     def run(self, state: TrainState, batches, pipeline: int = 0,
             dispatch_depth="auto") -> Tuple[TrainState, list]:

@@ -290,6 +290,16 @@ class Word2Vec:
         self.param_dtype = jnp.bfloat16 if dtype_s == "bfloat16" \
             else jnp.float32
 
+        # [serve] every: publish a bounded-staleness serving snapshot of
+        # the table every N consumed train steps (serve/snapshot.py);
+        # 0 (default) = serving plane off.  [serve] depth bounds how many
+        # published generations the publisher itself keeps referenced.
+        self.serve_every = g("serve", "every", 0).to_int32()
+        self.serve_depth = g("serve", "depth", 2).to_int32()
+        if self.serve_every < 0:
+            raise ValueError("[serve] every must be >= 0")
+        self.serve_publisher = None
+
         self.cluster = cluster or Cluster(self.config).initialize()
         # [cluster] data_plane (read by Cluster.initialize): steers the
         # stencil step's neu1 between the XLA gather->mask->sum chain
@@ -1480,6 +1490,11 @@ class Word2Vec:
             from swiftmpi_tpu.data.distributed import DistributedBatcher
             if not isinstance(batcher, DistributedBatcher):
                 batcher = DistributedBatcher(batcher, self.cluster.mesh)
+        # serving plane ([serve] every, serve/): arm the snapshot
+        # publisher so concurrent EmbeddingReaders can pull bounded-
+        # staleness views while this loop trains
+        if self.serve_every > 0:
+            self.serving_publisher()
         state = self.table.state
         frozen = state   # stale snapshot for the async mode
         losses = []
@@ -1543,6 +1558,9 @@ class Word2Vec:
                     batcher, batch_size, meter)
                 hogwild_dropped += it_dropped
                 state = self.table.state
+                # hogwild groups its own dispatches; publish at epoch
+                # granularity (the mode's natural consistency point)
+                self._serve_on_steps(1)
             else:
                 # Per-batch loss scalars are QUEUED as device arrays
                 # and fetched once at epoch end: a float(es) per batch
@@ -1586,6 +1604,7 @@ class Word2Vec:
                     ec_q.add(ec)
                     meter.record(n_words)
                     obs.record_step(1)
+                    self._serve_on_steps(1)
 
                 def run_group(fields, n_words):
                     # update ORDER is preserved either way: a group runs
@@ -1622,6 +1641,7 @@ class Word2Vec:
                     # stall_ms_per_step stays per-step across fuse modes
                     meter.record(sum(n_words), steps=L)
                     obs.record_step(L)
+                    self._serve_on_steps(L)
 
                 items = self._epoch_items(batcher, batch_size, stencil,
                                           fuse)
@@ -1676,6 +1696,9 @@ class Word2Vec:
                          checkpoint_path)
                 faults.checkpoint_event(npz_path(checkpoint_path))
         self.table.state = state
+        # final publish: readers see the trained state no matter where
+        # the every-K cadence landed
+        self._serve_publish()
         # observability surface (returned data, not just logs): the
         # hogwild drop bound is testable and the hybrid backend's
         # traffic counters ride along for bench detail fields
@@ -1822,6 +1845,43 @@ class Word2Vec:
             return np.asarray(self.table.state[hot_name("v")][slot])
         return np.asarray(
             self.table.state["v"][slot - n_hot])  # one-row transfer
+
+    def serving_publisher(self):
+        """The model's :class:`~swiftmpi_tpu.serve.snapshot
+        .SnapshotPublisher` — armed on first call (or by ``train()``
+        when ``[serve] every > 0``).  Attach
+        :class:`~swiftmpi_tpu.serve.reader.EmbeddingReader` instances to
+        it from any number of query threads; ``train()`` publishes a
+        versioned snapshot of the table (state + key→slot map) every
+        ``[serve] every`` consumed steps."""
+        if self.serve_publisher is None:
+            from swiftmpi_tpu.serve.snapshot import SnapshotPublisher
+            self.serve_publisher = SnapshotPublisher(
+                every=max(self.serve_every, 1), depth=self.serve_depth)
+        return self.serve_publisher
+
+    def _serve_on_steps(self, n: int) -> None:
+        """Trainer-thread publication hook: account ``n`` consumed steps
+        and publish when the staleness bound is reached.  The key→slot
+        view is captured HERE, on the trainer thread — a ``grow()`` can
+        never be mid-flight, so readers always see a matched
+        (state, key map) pair."""
+        pub = self.serve_publisher
+        if pub is None:
+            return
+        pub.on_steps(self.table, n=n, keys=lambda: self.vocab.keys,
+                     slots=lambda: np.asarray(self._slot_of_vocab),
+                     meta={"query_field": "v"})
+
+    def _serve_publish(self) -> None:
+        """Unconditional publish (end of train(): readers should see the
+        final state regardless of where the every-K cadence landed)."""
+        pub = self.serve_publisher
+        if pub is None:
+            return
+        pub.publish(self.table, keys=lambda: self.vocab.keys,
+                    slots=lambda: np.asarray(self._slot_of_vocab),
+                    meta={"query_field": "v"})
 
     def embedding_index(self, field: str = "v"):
         """Cosine-similarity index over the LIVE table (no dump round

@@ -376,10 +376,14 @@ def data_plane_gated(mode: str, name: str, env_var: str, fits: bool,
 
 def main(argv=None) -> int:
     """``python -m swiftmpi_tpu.ops.calibration --stale-check``: print
-    an advisory staleness report for the verdict file.  Always exits 0
-    — run_tier1.sh prints this next to the pytest verdict without ever
-    changing it."""
+    an advisory staleness report for the verdict file; exits 0 so
+    run_tier1.sh prints this next to the pytest verdict without ever
+    changing it.  ``--stale-check=strict`` promotes the report to a hard
+    gate (exit 1 on any stale verdict): a serving deployment preflights
+    with it to refuse to start on another stack's verdicts rather than
+    silently fall back to the uncalibrated path under live traffic."""
     argv = list(sys.argv[1:] if argv is None else argv)
+    strict = "--stale-check=strict" in argv
     path = _path()
     if not os.path.exists(path):
         print(f"calibration: no verdict file at {path}")
@@ -390,14 +394,15 @@ def main(argv=None) -> int:
         print(f"calibration: {total} verdict(s) at {path} match the "
               f"current stack {stack_key()}")
         return 0
-    print(f"calibration ADVISORY: {len(stale)}/{total} verdict(s) at "
+    label = "GATE" if strict else "ADVISORY"
+    print(f"calibration {label}: {len(stale)}/{total} verdict(s) at "
           f"{path} are STALE on this stack {stack_key()} — gates fall "
           f"back to the XLA path; re-calibrate on-chip via "
           f"scripts/gather_micro.py --ab-only and "
           f"scripts/scatter_micro.py --ab-only:")
     for key, reason in stale:
         print(f"  {key}: {reason}")
-    return 0
+    return 1 if strict else 0
 
 
 if __name__ == "__main__":

@@ -1,0 +1,34 @@
+"""Serving plane: bounded-staleness reads over live training state.
+
+The paper's parameter server exists to *answer pulls*; this package is
+the read side the training machinery earns.  Three pieces:
+
+* :mod:`~swiftmpi_tpu.serve.snapshot` — ``SnapshotPublisher`` /
+  ``TableSnapshot``: the trainer publishes an immutable, versioned view
+  of the table every K consumed steps.  Readers on other threads only
+  ever see a complete snapshot (one reference assignment — never a
+  half-swapped state dict), so staleness is bounded by K steps and torn
+  reads are impossible by construction.
+* :mod:`~swiftmpi_tpu.serve.reader` — ``EmbeddingReader``: the pull-only
+  read API.  Hot-head slots answer from the replicated ``@hot`` planes'
+  host replica; tail slots go through an LRU front built on
+  ``parameter.cache.LocalParamCache`` before paying a vectorized host
+  gather.  Readers never launch device programs — snapshots are host
+  replicas, so query threads cannot contend (or deadlock) with the
+  trainer's dispatches.
+* :mod:`~swiftmpi_tpu.serve.query` — the batched top-k neighbor path:
+  one normalized ``(Q, d) @ (d, V)`` matmul + ``argpartition`` over the
+  snapshot's host rows (``device=True`` opts into the jitted MXU kernel
+  under ``jax.named_scope("serve/topk")`` for trainer-thread bulk use).
+
+Metrics land in the ``obs`` registry under ``serve/*`` (qps, hit ratio,
+staleness, latency histograms) when telemetry is on; the readers also
+keep always-on plain-int counters for the bench cell.
+"""
+
+from swiftmpi_tpu.serve.reader import EmbeddingReader, LruTailFront
+from swiftmpi_tpu.serve.snapshot import (SnapshotPublisher, SnapshotUnavailable,
+                                         TableSnapshot)
+
+__all__ = ["EmbeddingReader", "LruTailFront", "SnapshotPublisher",
+           "SnapshotUnavailable", "TableSnapshot"]

@@ -1,0 +1,118 @@
+"""Batched top-k neighbor queries over a snapshot.
+
+The serving default is HOST-side: one normalized ``(Q, d) @ (d, V)``
+numpy matmul + ``argpartition`` over the snapshot's host replica.
+Reader threads must never launch device programs — two multi-device
+XLA programs dispatched concurrently from different threads can
+interleave their per-device enqueues and rendezvous-deadlock (observed
+on XLA:CPU), and serving load should not steal chip time from the
+trainer regardless.
+
+``device=True`` opts into the on-device kernel — the same MXU shape as
+:mod:`swiftmpi_tpu.models.embedding` (ONE ``(V, d) @ (d, Q)`` matmul +
+``jax.lax.top_k`` under ``jax.named_scope("serve/topk")``, module-cached
+jit with static k).  It is for TRAINER-THREAD bulk queries only (offline
+eval sweeps between epochs), where no concurrent dispatch exists.
+
+Self-exclusion is handled host-side by over-fetching one extra neighbor
+and dropping the query's own slot — no ``(Q, V)`` mask, same idiom as
+``EmbeddingIndex.topk``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import numpy as np
+
+_topk_unified_jit = None
+
+
+def _topk_unified_device(hot, tail, qt, k):
+    """On-device scores/slots of the top-k unified slots per query
+    column.  ``hot`` may be a (0, d) placeholder — concatenation keeps
+    one jit signature for hybrid and plain tables alike.  Rows are
+    normalized in f32 on device (the table may store bf16), queries
+    arrive pre-normalized."""
+    import jax
+    import jax.numpy as jnp
+
+    global _topk_unified_jit
+    if _topk_unified_jit is None:
+        @partial(jax.jit, static_argnames=("k",))
+        def f(hot, tail, qt, k):
+            with jax.named_scope("serve/topk"):
+                vecs = jnp.concatenate(
+                    [hot.astype(jnp.float32), tail.astype(jnp.float32)],
+                    axis=0)
+                norms = jnp.linalg.norm(vecs, axis=1, keepdims=True)
+                vecs = vecs / jnp.maximum(norms, 1e-12)
+                scores = (vecs @ qt).T          # (Q, V) — MXU
+                return jax.lax.top_k(scores, k)
+        _topk_unified_jit = f
+    scores, idx = _topk_unified_jit(jnp.asarray(hot), jnp.asarray(tail),
+                                    jnp.asarray(qt), k)
+    return np.asarray(scores), np.asarray(idx)
+
+
+def _topk_unified_host(hot, tail, qt, k):
+    """Host twin of the device kernel: same normalization, same
+    (scores, slots) contract, pure numpy."""
+    vecs = np.concatenate(
+        [np.asarray(hot, np.float32), np.asarray(tail, np.float32)],
+        axis=0)
+    norms = np.linalg.norm(vecs, axis=1, keepdims=True)
+    vecs = vecs / np.maximum(norms, 1e-12)
+    scores = (vecs @ qt).T                      # (Q, V)
+    V = scores.shape[1]
+    if k >= V:
+        idx = np.argsort(-scores, axis=1)
+    else:
+        part = np.argpartition(-scores, k - 1, axis=1)[:, :k]
+        order = np.argsort(-np.take_along_axis(scores, part, axis=1),
+                           axis=1)
+        idx = np.take_along_axis(part, order, axis=1)
+    return np.take_along_axis(scores, idx, axis=1), idx
+
+
+def snapshot_topk(snap, query_vecs: np.ndarray, k: int = 10,
+                  exclude_slots: Optional[np.ndarray] = None,
+                  device: bool = False
+                  ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Top-k neighbors of ``query_vecs`` (Q, d) over snapshot ``snap``.
+
+    Returns ``(keys (Q, k), slots (Q, k), scores (Q, k))`` in unified
+    slot space; vacant slots can only surface for near-empty tables (a
+    vacant row's init vector is a legal neighbor of nothing meaningful
+    but is still a valid row).  ``exclude_slots``: one slot per query to
+    drop (the query word itself); the fetch over-provisions by one.
+    ``device=True`` routes through the jitted MXU kernel — trainer
+    thread only (see module docstring).
+    """
+    field = snap.meta.get("query_field", "v")
+    tail = snap.tail_array(field)
+    hot = snap.hot_array(field)
+    if hot is None:
+        hot = np.zeros((0, tail.shape[1]), np.float32)
+    q = np.asarray(query_vecs, np.float32)
+    q = q / np.maximum(np.linalg.norm(q, axis=1, keepdims=True), 1e-12)
+    k_fetch = min(k + (1 if exclude_slots is not None else 0),
+                  snap.total_capacity)
+    kernel = _topk_unified_device if device else _topk_unified_host
+    scores, idx = kernel(hot, tail, q.T, k_fetch)
+    idx, scores = np.asarray(idx), np.asarray(scores)
+    k_out = min(k, snap.total_capacity)
+    Q = q.shape[0]
+    out_slots = np.zeros((Q, k_out), np.int64)
+    out_scores = np.full((Q, k_out), -np.inf, np.float32)
+    for qi in range(Q):
+        row_idx, row_sc = idx[qi], scores[qi]
+        if exclude_slots is not None and exclude_slots[qi] >= 0:
+            keep = row_idx != exclude_slots[qi]
+            row_idx, row_sc = row_idx[keep], row_sc[keep]
+        n = min(k_out, len(row_idx))
+        out_slots[qi, :n] = row_idx[:n]
+        out_scores[qi, :n] = row_sc[:n]
+    keys = snap.key_of_slot()[out_slots]
+    return keys, out_slots, out_scores

@@ -73,6 +73,18 @@ def grad_row_bytes(grads, with_index: bool = True,
     return total
 
 
+def pull_row_bytes(state, fields) -> int:
+    """Wire bytes per pulled row: int32 request index plus the pulled
+    fields' widths at the table's stored dtypes.  The pull-side twin of
+    :func:`grad_row_bytes` so ``pull_bytes`` means the same thing on
+    every backend."""
+    total = 4
+    for f in fields:
+        arr = state[f]
+        total += int(np.dtype(arr.dtype).itemsize) * int(arr.shape[-1])
+    return total
+
+
 @jax.tree_util.register_pytree_node_class
 class PushSpec:
     """One gradient-family push: ``(slots, grads, mean)``.
@@ -124,9 +136,10 @@ class Transfer:
     # -- wire traffic ledger (shared by every backend) ---------------------
     # ``wire_bytes`` counts push-side exchange PAYLOAD bytes (sparse:
     # valid rows x grad_row_bytes; dense: capacity x row bytes) and
-    # ``dispatches`` the number of push-side exchanges — pulls are not
-    # counted, so a window that coalesces W pushes into one exchange
-    # shows a W-fold dispatch drop regardless of the pull schedule.
+    # ``dispatches`` the number of push-side exchanges — pulls are
+    # ledgered separately (``pull_bytes``/``pull_rows``), so a window
+    # that coalesces W pushes into one exchange shows a W-fold dispatch
+    # drop regardless of the pull schedule.
     # Counting is off until ``count_traffic`` is set (one extra reduce
     # per push otherwise).  The counts are data-dependent under jit, so
     # the same tracer/eager discipline as the tpu backend's overflow
@@ -141,7 +154,8 @@ class Transfer:
                 "wire_bytes": 0, "dispatches": 0,
                 "window_sparse": 0, "window_dense": 0,
                 "coalesced_rows_in": 0, "coalesced_rows_out": 0,
-                "pending": []}
+                "pull_bytes": 0, "pull_rows": 0,
+                "pending": [], "pull_pending": []}
         return st
 
     def _obs_inc(self, key: str, n) -> None:
@@ -191,6 +205,34 @@ class Transfer:
                 for rb, r, d in pending:
                     self._accum_wire(rb, r, decision=d)
 
+    def _accum_pull(self, row_bytes, rows) -> None:
+        st = self._wire_state()
+        nbytes = int(rows) * int(row_bytes)
+        st["pull_bytes"] += nbytes
+        st["pull_rows"] += int(rows)
+        self._obs_inc("pull_bytes", nbytes)
+        self._obs_inc("pull_rows", int(rows))
+
+    def _record_pull(self, rows, row_bytes: int) -> None:
+        """Record one pull exchange of ``rows`` (traced or eager count)
+        at ``row_bytes`` per row.  ``row_bytes == 0`` still counts rows
+        — the hybrid backend's hot hits are local replica reads that
+        ship nothing but should show up in ``pull_rows`` so hit ratios
+        can be derived from the ledger alone."""
+        if not getattr(self, "count_traffic", False):
+            return
+        from functools import partial
+        cb = partial(self._accum_pull, int(row_bytes))
+        if isinstance(rows, jax.core.Tracer):
+            jax.debug.callback(cb, rows)
+        else:
+            st = self._wire_state()
+            st["pull_pending"].append((int(row_bytes), rows))
+            if len(st["pull_pending"]) >= 1024:
+                pending, st["pull_pending"] = st["pull_pending"], []
+                for rb, r in pending:
+                    self._accum_pull(rb, r)
+
     def _accum_coalesce(self, decision, rows_in, rows_out) -> None:
         st = self._wire_state()
         st["coalesced_rows_in"] += int(rows_in)
@@ -218,10 +260,11 @@ class Transfer:
 
     def wire_traffic(self) -> Dict[str, int]:
         """Cumulative wire ledger (flushes traced callbacks and queued
-        eager scalars): ``wire_bytes``, ``dispatches``, and the window
+        eager scalars): ``wire_bytes``, ``dispatches``, the window
         path's ``window_sparse``/``window_dense`` decision counts plus
         ``coalesced_rows_in``/``coalesced_rows_out`` (rows before/after
-        the per-window dedup).
+        the per-window dedup), and the pull side's
+        ``pull_bytes``/``pull_rows``.
 
         Reset semantics (contract for all backends, enforced by
         tests/test_telemetry.py): every value is a **monotonically
@@ -236,7 +279,11 @@ class Transfer:
         pending, st["pending"] = st["pending"], []
         for rb, r, d in pending:
             self._accum_wire(rb, r, decision=d)
-        return {k: v for k, v in st.items() if k != "pending"}
+        pulls, st["pull_pending"] = st["pull_pending"], []
+        for rb, r in pulls:
+            self._accum_pull(rb, r)
+        return {k: v for k, v in st.items()
+                if k not in ("pending", "pull_pending")}
 
     def traffic(self) -> Dict[str, int]:
         """Cumulative traffic counters; every backend reports at least

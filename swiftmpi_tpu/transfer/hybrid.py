@@ -146,7 +146,7 @@ class HybridTransfer(Transfer):
                "overflow_dropped": t["overflow_dropped"]}
         for k in ("wire_bytes", "dispatches", "window_sparse",
                   "window_dense", "coalesced_rows_in",
-                  "coalesced_rows_out"):
+                  "coalesced_rows_out", "pull_bytes", "pull_rows"):
             out[k] = t.get(k, 0) + w.get(k, 0)
         if self.metrics is not None:
             self.metrics.set("transfer_hot_rows", out["hot_rows"])
@@ -197,7 +197,12 @@ class HybridTransfer(Transfer):
         tail_slots = jnp.where(slots >= n_hot, slots - n_hot, -1)
         out = self.tail.pull(tail_state, tail_slots, access, fields)
         if self.count_traffic:
-            self._record_hot(jnp.sum(is_hot), 0)
+            n_hot_rows = jnp.sum(is_hot)
+            self._record_hot(n_hot_rows, 0)
+            # hot pulls are local replica hits: rows counted, zero bytes
+            # (tail rows/bytes land on the tail backend's own ledger and
+            # merge in traffic())
+            self._record_pull(n_hot_rows, 0)
         # hot rows are a LOCAL gather on the replicated head — the tail
         # pull returned exact zeros at these positions (slot -1 padding)
         hot_idx = jnp.clip(slots, 0, n_hot - 1)

@@ -12,7 +12,8 @@ from __future__ import annotations
 import numpy as np
 
 from swiftmpi_tpu.parameter.access import AccessMethod
-from swiftmpi_tpu.transfer.api import Transfer, grad_row_bytes
+from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
+                                       pull_row_bytes)
 
 
 class LocalTransfer(Transfer):
@@ -28,8 +29,10 @@ class LocalTransfer(Transfer):
     def pull(self, state, slots, access, fields=None):
         slots = np.asarray(slots, np.int64)
         valid = slots >= 0
+        fields = tuple(fields or access.pull_fields)
+        self._record_pull(int(valid.sum()), pull_row_bytes(state, fields))
         out = {}
-        for f in (fields or access.pull_fields):
+        for f in fields:
             arr = np.asarray(state[f])
             rows = arr[np.where(valid, slots, 0)]
             rows[~valid] = 0

@@ -39,7 +39,8 @@ from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
 from swiftmpi_tpu.ops import (calibration, pallas_gather, pallas_ring,
                               pallas_scatter)
 from swiftmpi_tpu.parameter.key_index import window_wire_format
-from swiftmpi_tpu.transfer.api import Transfer, grad_row_bytes
+from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
+                                       pull_row_bytes)
 
 
 def _shard_gather(arr: jax.Array, flat_idx: jax.Array) -> jax.Array:
@@ -251,7 +252,9 @@ class TpuTransfer(Transfer):
         fields = tuple(fields or access.pull_fields)
         slots = jnp.asarray(slots, jnp.int32)
         if self.count_traffic:
-            self._record_routed(jnp.sum(slots >= 0))
+            valid = jnp.sum(slots >= 0)
+            self._record_routed(valid)
+            self._record_pull(valid, pull_row_bytes(state, fields))
         sig = self._signature(state, slots) + (fields,)
         fn = self._pull_cache.get(sig)
         if fn is None:

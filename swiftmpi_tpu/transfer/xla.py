@@ -25,7 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from swiftmpi_tpu.ops import calibration, pallas_gather, pallas_scatter
-from swiftmpi_tpu.transfer.api import Transfer, grad_row_bytes
+from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
+                                       pull_row_bytes)
 
 # replica-spread scatter: cap the R-fold temporary at ~256MB so the
 # measured-win gate can never OOM a large table's push
@@ -79,8 +80,10 @@ class XlaTransfer(Transfer):
     def pull(self, state, slots, access, fields=None):
         slots = jnp.asarray(slots, jnp.int32)
         valid = slots >= 0
+        fields = tuple(fields or access.pull_fields)
+        self._record_pull(jnp.sum(valid), pull_row_bytes(state, fields))
         return {f: _masked_gather(state[f], slots, valid)
-                for f in (fields or access.pull_fields)}
+                for f in fields}
 
     # -- push (global_push_access.h:26-43 + server.h:159-176) --------------
     def push(self, state, slots, grads, access, mean=False):

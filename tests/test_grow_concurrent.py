@@ -1,0 +1,139 @@
+"""Satellite: table growth under a concurrent reader thread.
+
+``SparseTable.grow`` re-lays-out HBM arrays and remaps the KeyIndex in
+place — the serving plane's correctness rests on two properties this
+file pins down:
+
+1. ``table.state`` is swapped in ONE reference assignment, so a reader
+   capturing the dict mid-grow sees either the complete pre-grow or the
+   complete post-grow generation — never a mix of capacities ("torn").
+2. A published :class:`TableSnapshot` captures a matched (state,
+   key→slot) pair on the grower's thread, so reads through a snapshot
+   resolve to the right rows at whichever generation it belongs to.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+
+from swiftmpi_tpu.parameter import KeyIndex, SparseTable, w2v_access
+from swiftmpi_tpu.serve import SnapshotPublisher
+
+
+def _sentinel_table(n_keys=24, d=4):
+    """Table whose occupied ``v`` rows are recognizable: row for key k
+    is the constant vector k (growth must preserve them verbatim)."""
+    ki = KeyIndex(num_shards=2, capacity_per_shard=32)
+    table = SparseTable(w2v_access(0.3, d), ki, seed=1)
+    keys = np.arange(1, 1 + n_keys, dtype=np.uint64)
+    slots = np.asarray(ki.lookup(keys), np.int64)
+    v = np.asarray(table.state["v"]).copy()
+    v[slots] = keys[:, None].astype(np.float32)
+    state = dict(table.state)
+    state["v"] = jnp.asarray(v)
+    table.state = state
+    return table, keys
+
+
+def test_state_capture_is_never_torn(devices8):
+    """Reader thread repeatedly captures ``table.state`` while the main
+    thread grows the table; every captured generation is internally
+    consistent (one capacity across all fields) and carries the
+    sentinel rows of SOME complete generation."""
+    table, keys = _sentinel_table()
+    ki = table.key_index
+    caps = [64, 128, 256]                 # grow doublings from 64
+    stop = threading.Event()
+    errors, seen_caps = [], set()
+
+    def reader():
+        fields = sorted(table.access.fields)
+        while not stop.is_set():
+            state = table.state           # ONE reference read
+            shapes = {f: int(state[f].shape[0]) for f in fields}
+            if len(set(shapes.values())) != 1:
+                errors.append(f"torn state: {shapes}")
+                return
+            cap = shapes["v"]
+            if cap not in (64, 128, 256):
+                errors.append(f"unknown generation capacity {cap}")
+                return
+            seen_caps.add(cap)
+            time.sleep(1e-4)      # yield: don't starve the grower's
+            #                       jit-compile threads of the GIL
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for new_cap in caps[1:]:
+        table.grow(new_cap // ki.num_shards)
+        assert table.capacity == new_cap
+    stop.set()
+    t.join(timeout=30)
+    assert not errors, errors
+    assert seen_caps                      # the reader actually ran
+    # growth preserved every sentinel row at the remapped slots
+    slots = np.asarray(ki.lookup(keys, create=False), np.int64)
+    assert (slots >= 0).all()
+    rows = np.asarray(table.state["v"])[slots]
+    assert np.allclose(rows, keys[:, None].astype(np.float32))
+
+
+def test_snapshot_mid_grow_is_pre_or_post_generation(devices8):
+    """Snapshots published around repeated grows: a reader resolving
+    keys through whatever snapshot is latest always lands on sentinel
+    rows — i.e. it holds a matched (state, key map) pair from exactly
+    one generation, pre- or post-grow, never a cross of the two."""
+    table, keys = _sentinel_table()
+    ki = table.key_index
+    pub = SnapshotPublisher(every=1, depth=2)
+    pub.publish(table, keys=keys,
+                slots=np.asarray(ki.lookup(keys), np.int64))
+    stop = threading.Event()
+    errors, checked = [], [0]
+
+    def reader():
+        while not stop.is_set():
+            snap = pub.latest()
+            try:
+                slots = snap.lookup(keys)
+                if (slots < 0).any():
+                    errors.append("known key unmapped in snapshot")
+                    return
+                # slots must address THIS snapshot's arrays
+                if slots.max() >= int(snap.tail_array("v").shape[0]):
+                    errors.append(
+                        f"v{snap.version}: slot {slots.max()} out of "
+                        f"range {snap.tail_array('v').shape[0]} (torn "
+                        f"state/key-map pair)")
+                    return
+                rows = np.asarray(snap.tail_array("v"))[slots]
+                want = keys[:, None].astype(np.float32)
+                if not np.allclose(rows, want):
+                    errors.append(
+                        f"v{snap.version}: rows mismatch sentinel "
+                        f"(mixed-generation read)")
+                    return
+                checked[0] += 1
+            except Exception as e:        # noqa: BLE001
+                errors.append(repr(e))
+                return
+            time.sleep(1e-4)
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    for _ in range(2):
+        table.grow()                      # 2x capacity, remaps KeyIndex
+        # publish on the grower's thread — the serving contract: the
+        # key map is captured where no grow can be mid-flight
+        pub.publish(table, keys=keys,
+                    slots=np.asarray(ki.lookup(keys, create=False),
+                                     np.int64))
+    stop.set()
+    t.join(timeout=30)
+    assert not errors, errors
+    assert checked[0] > 0
+    assert pub.version == 3
+    # depth=2: only the newest generations stay publisher-referenced
+    assert len(pub._history) == 2
