@@ -516,7 +516,7 @@ W2V_1M_VOCAB = 1_000_000
 
 
 def build_w2v_1m_model(device, stencil=False, hybrid=False,
-                       window_steps=1, pipeline=0):
+                       window_steps=1, pipeline=0, control=None):
     """The 1M-vocab cell's model (BASELINE config #3 shape: demo.conf
     hyperparameters over a ~1M-word Zipf vocabulary / 1.3M-row table).
     ONE builder shared by the bench cell and the profiler ablation
@@ -545,7 +545,11 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
     ``pipeline=K``: the asynchronous input pipeline ([worker] pipeline)
     plus train()-path fusing ([worker] inner_steps = BENCH_SCAN) — the
     BENCH_ONLY=scale_pipeline cell's shape, which drives the PUBLIC
-    train() loop instead of a pre-staged ``_build_multi_step``."""
+    train() loop instead of a pre-staged ``_build_multi_step``.
+
+    ``control=dict``: arm the adaptive control plane with the given
+    ``[control]`` section (the BENCH_ONLY=scale_autotune cell's
+    autotune arm; ``None`` leaves the section absent = control off)."""
     import jax
     import numpy as np
     from swiftmpi_tpu.cluster.cluster import Cluster
@@ -597,6 +601,7 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
                        "dispatch_depth": os.environ.get(
                            "BENCH_DISPATCH_DEPTH", "auto")}
                       if pipeline else {})},
+        **({"control": dict(control)} if control else {}),
     })
     with jax.default_device(device):
         model = Word2Vec(
@@ -623,12 +628,14 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
     V = W2V_1M_VOCAB
     model, rng = build_w2v_1m_model(device, stencil=stencil, hybrid=hybrid,
                                     window_steps=window_steps)
+    tr0 = None
     if hybrid or window_steps > 1:
         # arm the traffic counters BEFORE the jit build: the per-step
         # routed/hot row counts — and the window wire ledger (bytes,
         # dispatches, sparse/dense decisions) — are recorded by
         # callbacks traced into the compiled program (transfer/)
         model.transfer.count_traffic = True
+        tr0 = model.transfer.traffic()
     with jax.default_device(device):
         step = model._build_multi_step(INNER_STEPS)
         B, W2 = BATCH, 2 * model.window
@@ -680,7 +687,7 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
     if hybrid:
         out["transfer"] = "hybrid"
         out["hot_head_rows"] = model.table.n_hot
-        tr = model.transfer.traffic()
+        tr = model.transfer.traffic_delta(tr0)
         # counters accumulate over warmup, timed AND latency-probe
         # executions
         steps = max((WARMUP_CALLS + timed_calls + min(timed_calls, 16))
@@ -695,7 +702,7 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
                                            3)
     if window_steps > 1:
         out["push_window"] = int(window_steps)
-        tr = model.transfer.traffic()
+        tr = model.transfer.traffic_delta(tr0)
         steps = max((WARMUP_CALLS + timed_calls + min(timed_calls, 16))
                     * INNER_STEPS, 1)
         windows = max(steps // window_steps, 1)
@@ -821,6 +828,132 @@ def _bench_w2v_1m_pipeline(device, timed_calls):
             "rendering": getattr(model, "resolved_rendering", None)}
 
 
+def _bench_w2v_1m_autotune(device, timed_calls):
+    """Adaptive control plane at 1M vocab (control/): a mid-run key-
+    frequency rotation (every token's traffic moves to the key V/2 away,
+    so the seed-calibrated hot head goes cold all at once) over the full
+    window+hybrid composition through the PUBLIC train() path.
+
+    In-cell A/B on the IDENTICAL drifted stream: the **autotune** arm
+    runs with ``[control] control: on`` (decayed sketch -> hysteresis ->
+    repartition at a safe point), the **pinned** arm keeps the seed
+    calibration — exactly what every run did before the control plane
+    existed.  Both arms report the post-shift phase's traffic
+    (``traffic_delta`` from the phase boundary), and the autotune arm
+    reports ``steps_to_reconverge`` (shift -> last applied ``hot_k``
+    decision, in steps) and ``recompiles`` — the price of the adaptation
+    next to its wire win."""
+    import jax
+    import numpy as np
+    from swiftmpi_tpu.data.text import StencilBatch
+
+    V = W2V_1M_VOCAB
+    win = int(os.environ.get("BENCH_WINDOW", INNER_STEPS))
+    depth = int(os.environ.get("BENCH_PIPELINE", 3))
+    B = BATCH
+    phase_steps = max(timed_calls, 1) * INNER_STEPS
+    # cadence scaled so the post-shift phase holds ~8 evaluations: the
+    # hysteresis (consecutive=2) then has room to defer AND apply well
+    # inside the phase
+    every = max(INNER_STEPS, phase_steps // 8)
+    ctl_cfg = {"control": "on", "every": every, "margin": 0.02,
+               "consecutive": 2, "decay": 0.3}
+
+    class _DriftStencilStream:
+        """Fixed-shape stencil epoch whose tokens follow the MODEL's
+        seed histogram (rot=False) or its half-vocab rotation
+        (rot=True).  Seeds are deterministic per (phase, epoch) so the
+        two arms consume bit-identical batches."""
+
+        def __init__(self, cdf, rot, span_w):
+            self._cdf = cdf
+            self._rot = rot
+            self._w = span_w
+            self._epoch = 0
+
+        def epoch_stencil(self, batch_size):
+            r = np.random.default_rng(
+                (1_000_000 if self._rot else 0) + self._epoch)
+            self._epoch += 1
+            S = batch_size + 2 * self._w
+            sent = np.arange(S, dtype=np.int32) // SENT_LEN
+            cpos = self._w + np.arange(batch_size, dtype=np.int32)
+            for _ in range(phase_steps):
+                toks = np.searchsorted(
+                    self._cdf, r.random(S)).astype(np.int32)
+                if self._rot:
+                    toks = (toks + V // 2) % V
+                yield StencilBatch(
+                    tokens=np.minimum(toks, V - 1), sent_id=sent,
+                    center_pos=cpos,
+                    half=r.integers(1, self._w + 1,
+                                    size=batch_size).astype(np.int32),
+                    n_words=int(batch_size))
+
+    def run_arm(autotune):
+        model, _ = build_w2v_1m_model(
+            device, hybrid=True, window_steps=win, pipeline=depth,
+            control=ctl_cfg if autotune else None)
+        model.transfer.count_traffic = True
+        p = model.vocab.counts.astype(np.float64)
+        cdf = np.cumsum(p / p.sum())
+        with jax.default_device(device):
+            # phase A: the distribution the seed calibration was built
+            # from — compiles the program and (autotune arm) settles the
+            # sketch on the status quo
+            model.train(batcher=_DriftStencilStream(cdf, False,
+                                                    model.window),
+                        niters=1, batch_size=B)
+            ctl = model.controller
+            evals0 = ctl.evaluations if ctl is not None else 0
+            tr0 = model.transfer.traffic()
+            t0 = time.perf_counter()
+            # phase B: the rotation, same stream both arms
+            model.train(batcher=_DriftStencilStream(cdf, True,
+                                                    model.window),
+                        niters=1, batch_size=B)
+            dt = time.perf_counter() - t0
+        tr = model.transfer.traffic_delta(tr0)
+        arm = {"words_per_sec": B * phase_steps / dt,
+               "wire_bytes_per_step": round(
+                   tr.get("wire_bytes", 0) / phase_steps, 1),
+               "routed_rows_per_step": round(
+                   tr.get("routed_rows", 0) / phase_steps, 1),
+               "hot_rows_per_step": round(
+                   tr.get("hot_rows", 0) / phase_steps, 1),
+               "hot_k": int(model.table.n_hot)}
+        if ctl is not None:
+            applied = [d for d in ctl.decisions
+                       if d.action == "apply" and d.knob == "hot_k"
+                       and d.evaluation > evals0]
+            arm["steps_to_reconverge"] = (
+                (max(d.evaluation for d in applied) - evals0) * every
+                if applied else -1)
+            arm["recompiles"] = int(model._control_recompiles)
+            arm["control_applied"] = len(applied)
+            arm["control_evaluations"] = ctl.evaluations - evals0
+        return arm
+
+    auto = run_arm(True)
+    pinned = run_arm(False)
+    out = dict(auto)
+    out.update({k + "_pinned": v for k, v in pinned.items()})
+    out.update({
+        # headline: the autotune arm's post-shift wire traffic relative
+        # to the arm that kept the stale seed calibration (<1 = win)
+        "wire_ratio_vs_pinned": round(
+            auto["wire_bytes_per_step"]
+            / max(pinned["wire_bytes_per_step"], 1e-9), 3),
+        "routed_ratio_vs_pinned": round(
+            auto["routed_rows_per_step"]
+            / max(pinned["routed_rows_per_step"], 1e-9), 3),
+        "phase_steps": phase_steps, "control_every": every,
+        "push_window": win, "pipeline": depth, "batch_size": B,
+        "vocab": V, "transfer": "hybrid",
+        "dtype": os.environ.get("BENCH_DTYPE", "float32")})
+    return out
+
+
 def _bench_serve_qps(device, streams=None):
     """Train-while-serving cell (serve/): a demo-shape w2v trains
     through the PUBLIC train() path with the snapshot publisher armed
@@ -877,6 +1010,10 @@ def _bench_serve_qps(device, streams=None):
 
     stop = threading.Event()
     readers = [EmbeddingReader(pub, field="v") for _ in range(streams)]
+    # pull-ledger snapshot at the end of warmup: the reported wire
+    # numbers cover exactly the timed concurrent train+serve region
+    tr0 = model.transfer.traffic()
+    steps0 = pub.train_step
 
     def query_stream(idx):
         r = readers[idx]
@@ -908,8 +1045,8 @@ def _bench_serve_qps(device, streams=None):
                for r in readers)
     served = hits + sum(r.stats["tail_misses"] for r in readers)
     hit_ratio = hits / max(served, 1)
-    tr = model.transfer.traffic()
-    steps = pub.train_step
+    tr = model.transfer.traffic_delta(tr0)
+    steps = pub.train_step - steps0
 
     def q(arr, frac):
         return float(arr[min(int(frac * len(arr)), len(arr) - 1)]) \
@@ -1729,6 +1866,17 @@ def child_main(which: str) -> None:
         # identical batch stream.  Own child + own key; never compared
         # against the pre-staged scale cells (different timed surface)
         out["w2v_1m_pipeline"] = _bench_w2v_1m_pipeline(
+            device, max(timed // 2, 1))
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
+    if os.environ.get("BENCH_ONLY") == "scale_autotune":
+        # adaptive control plane A/B at 1M vocab: a mid-run frequency
+        # rotation with autotune-on vs pinned-seed-calibration over the
+        # IDENTICAL drifted stream — steps_to_reconverge, recompiles and
+        # the post-shift wire/routed traffic for both arms in one cell
+        # (own child: two full train()-path models back to back)
+        out["w2v_1m_autotune"] = _bench_w2v_1m_autotune(
             device, max(timed // 2, 1))
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)

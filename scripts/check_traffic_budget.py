@@ -51,12 +51,14 @@ import sys
 TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
                    "dispatches_per_window", "stall_ms_per_step",
                    "kernel_ms", "serve_p99_ms", "serve_miss_ratio",
-                   "pull_bytes_per_step")
+                   "pull_bytes_per_step", "control_decisions_per_1k_steps")
 DETAIL_METRICS = ("window_sparse", "window_dense", "coalesce_ratio",
                   "push_window", "host_stall_ms", "queue_depth",
                   "pipeline", "speedup_vs_off", "qps", "p50_ms",
                   "hit_ratio", "streams", "snapshots",
-                  "staleness_bound_steps")
+                  "staleness_bound_steps", "pull_hot_rows",
+                  "control_applied", "control_evaluations",
+                  "steps_to_reconverge", "recompiles", "hot_k")
 #: absolute increase a metric must clear before it can regress: wall-
 #: clock metrics jitter run to run while the counter metrics are exact,
 #: so only the former get a floor (ms for the stall split; kernel_ms is
@@ -65,7 +67,11 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "coalesce_ratio",
 #: contention — the stall gate's 0.1ms convention applies; a
 #: miss-ratio wiggle under 1 point is query-stream sampling noise)
 ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1, "kernel_ms": 0.05,
-                   "serve_p99_ms": 0.1, "serve_miss_ratio": 0.01}
+                   "serve_p99_ms": 0.1, "serve_miss_ratio": 0.01,
+                   # a quiet baseline (0 decisions) must tolerate the
+                   # occasional legitimate retune; only a flapping tuner
+                   # (> 2 decisions per 1k steps above baseline) fails
+                   "control_decisions_per_1k_steps": 2.0}
 
 
 def load_telemetry_cells(path: str) -> dict:
@@ -73,7 +79,8 @@ def load_telemetry_cells(path: str) -> dict:
     by the run name.  Counters are summed across backends (the gate
     budgets the run's total wire, not the split) and normalized by the
     recorded step count; window decision totals ride along as detail."""
-    from telemetry_report import load, phase_table, traffic_summary
+    from telemetry_report import (control_summary, load, phase_table,
+                                  traffic_summary)
 
     doc = load(path)     # SystemExit(2) on unreadable/bad schema
     t = traffic_summary(doc)
@@ -94,6 +101,20 @@ def load_telemetry_cells(path: str) -> dict:
         total = sum(m.get(decision, 0.0) for m in t["transfer"].values())
         if total:
             cell[decision] = total
+    hot_pulls = sum(m.get("pull_hot_rows", 0.0)
+                    for m in t["transfer"].values())
+    if hot_pulls:
+        cell["pull_hot_rows"] = hot_pulls
+    # control plane: gate on the decision rate (a flapping tuner is a
+    # regression even when each individual decision looks justified);
+    # absent entirely when the run never evaluated (control off), so a
+    # control-off baseline never blocks a control-on candidate
+    ctl = control_summary(doc)
+    if ctl.get("evaluations"):
+        cell["control_decisions_per_1k_steps"] = \
+            ctl.get("decisions_per_1k_steps", 0.0)
+        cell["control_applied"] = ctl["applied"]
+        cell["control_evaluations"] = ctl["evaluations"]
     run = str(doc["meta"].get("run", "telemetry"))
     cells = {run: cell} if cell else {}
     # kernel microbench streams (obs.micro.MicroTelemetry): every

@@ -18,6 +18,10 @@ operator actually asks after a run:
 * **How much traffic?**  Cumulative ``transfer/*`` counters per backend
   with per-step averages, plus the host-stall split from the training
   samplers.
+* **What did the autotuner do?**  The control plane's out-of-band
+  ``control/decision`` events become a decision timeline — knob value
+  over steps with the triggering evidence (win, streak, traffic delta)
+  — so every knob change in a run is traceable to what it saw.
 
 Usage::
 
@@ -78,8 +82,9 @@ def _quantile(counts: List[int], bounds: List[float], q: float) -> float:
 
 # -- load -----------------------------------------------------------------
 def load(path: str) -> dict:
-    """Parse the JSONL into {"meta", "steps": [...], "summary"|None}.
-    SystemExit(2) on unreadable / non-telemetry input."""
+    """Parse the JSONL into {"meta", "steps": [...], "events": [...],
+    "summary"|None} — "events" collects the out-of-band ``control/*``
+    lines.  SystemExit(2) on unreadable / non-telemetry input."""
     try:
         with open(path) as f:
             lines = [ln for ln in f if ln.strip()]
@@ -100,7 +105,7 @@ def load(path: str) -> dict:
         print(f"telemetry_report: {path} is not a telemetry stream "
               f"(schema={head.get('schema')!r})", file=sys.stderr)
         raise SystemExit(2)
-    steps, summary = [], None
+    steps, events, summary = [], [], None
     for n, ln in enumerate(lines[1:], start=2):
         try:
             rec = json.loads(ln)
@@ -113,7 +118,10 @@ def load(path: str) -> dict:
             steps.append(rec)
         elif kind == "summary":
             summary = rec
-    return {"meta": head, "steps": steps, "summary": summary}
+        elif isinstance(kind, str) and kind.startswith("control/"):
+            events.append(rec)
+    return {"meta": head, "steps": steps, "events": events,
+            "summary": summary}
 
 
 # -- analyses -------------------------------------------------------------
@@ -182,6 +190,50 @@ def wire_timeline(doc: dict) -> List[dict]:
     return runs
 
 
+def decision_timeline(doc: dict) -> List[dict]:
+    """The control plane's knob trajectory: one row per
+    ``control/decision`` event, ordered by step, carrying the knob's
+    value transition and the evidence that triggered it.  Evaluations
+    that held every knob emit no decision, so the timeline is exactly
+    the changes (and near-changes: deferred streak ticks ride along,
+    marked by their action)."""
+    rows = []
+    for rec in doc["events"]:
+        if rec.get("kind") != "control/decision":
+            continue
+        rows.append({
+            "step": int(rec.get("step", 0)),
+            "knob": rec.get("knob", "?"),
+            "action": rec.get("action", "?"),
+            "old": rec.get("old"),
+            "new": rec.get("new"),
+            "win": rec.get("win"),
+            "streak": rec.get("streak"),
+            "evidence": rec.get("evidence") or {},
+            "traffic_delta": rec.get("traffic_delta") or {},
+        })
+    rows.sort(key=lambda r: r["step"])
+    return rows
+
+
+def control_summary(doc: dict) -> dict:
+    """Evaluation/decision counts for gates: decisions per 1k steps is
+    the traffic-budget metric that catches a flapping tuner."""
+    evals = sum(1 for r in doc["events"]
+                if r.get("kind") == "control/evaluation")
+    decisions = [r for r in doc["events"]
+                 if r.get("kind") == "control/decision"]
+    applied = sum(1 for r in decisions if r.get("action") == "apply")
+    steps = (int(doc["summary"].get("steps", 0))
+             if doc["summary"] is not None else
+             sum(int(r.get("steps", 1)) for r in doc["steps"]))
+    out = {"evaluations": evals, "decisions": len(decisions),
+           "applied": applied, "steps": steps}
+    if steps:
+        out["decisions_per_1k_steps"] = 1000.0 * len(decisions) / steps
+    return out
+
+
 def traffic_summary(doc: dict) -> dict:
     """Cumulative counters (prefer the summary line's authoritative
     totals; fall back to summing step deltas for a crashed run) grouped
@@ -227,6 +279,8 @@ def report(doc: dict, phases_only: bool = False) -> dict:
     if not phases_only:
         out["wire_timeline"] = wire_timeline(doc)
         out["traffic"] = traffic_summary(doc)
+        out["decisions"] = decision_timeline(doc)
+        out["control"] = control_summary(doc)
     return out
 
 
@@ -260,6 +314,31 @@ def _print_report(rep: dict) -> None:
                     else f"steps {run['first']}-{run['last']}")
             print(f"  {span}: {run['decision']} "
                   f"({run['windows']} record(s))")
+    if "decisions" in rep:
+        print()
+        print("control decisions:")
+        c = rep.get("control") or {}
+        if not rep["decisions"]:
+            hint = (" (no evaluations — control off)"
+                    if not c.get("evaluations") else
+                    f" over {c.get('evaluations', 0)} evaluation(s)")
+            print(f"  (none){hint}")
+        else:
+            print(f"  {c.get('evaluations', 0)} evaluations, "
+                  f"{c.get('decisions', 0)} decisions, "
+                  f"{c.get('applied', 0)} applied "
+                  f"({c.get('decisions_per_1k_steps', 0.0):.2f}/1k steps)")
+            for d in rep["decisions"]:
+                ev = d["evidence"]
+                ev_s = ", ".join(f"{k}={v:.4g}" if isinstance(v, float)
+                                 else f"{k}={v}"
+                                 for k, v in sorted(ev.items())
+                                 if not isinstance(v, (dict, list)))
+                print(f"  step {d['step']}: {d['knob']} {d['action']} "
+                      f"{d['old']} -> {d['new']} "
+                      f"(win={d['win']:.4f}, streak={d['streak']})")
+                if ev_s:
+                    print(f"      evidence: {ev_s}")
     if "traffic" in rep:
         t = rep["traffic"]
         print()

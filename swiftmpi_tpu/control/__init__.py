@@ -1,0 +1,16 @@
+"""Adaptive control plane (ISSUE 9): online re-derivation of the
+placement/wire knobs from the live traffic ledger.
+
+See :mod:`swiftmpi_tpu.control.controller` for the decision loop and
+:mod:`swiftmpi_tpu.control.sketch` for the decayed frequency sketch.
+Wiring lives with the owners: ``models/word2vec.py`` registers the
+``hot_k`` / ``push_window`` / ``wire_format`` knobs and their appliers;
+``models/trainer.py`` attaches an observe-only controller.
+"""
+
+from swiftmpi_tpu.control.controller import (Controller, ControlSettings,
+                                             Decision, Knob, Proposal)
+from swiftmpi_tpu.control.sketch import DecayedSketch
+
+__all__ = ["Controller", "ControlSettings", "Decision", "Knob",
+           "Proposal", "DecayedSketch"]

@@ -105,6 +105,11 @@ class Trainer:
         # step() publishes a params-only snapshot every K steps (dense
         # params carry no key map — readers use the pytree directly)
         self.serve_publisher = None
+        # control plane: attach a control.Controller here (dense params
+        # have no placement knobs, so the useful mode is observe-only —
+        # no sketch, no knobs — which emits control/evaluation events
+        # with the traffic delta each cadence tick)
+        self.controller = None
 
     # -- state ------------------------------------------------------------
     def init_state(self, key) -> TrainState:
@@ -198,6 +203,8 @@ class Trainer:
         out = TrainState(params, opt_state, step)
         if self.serve_publisher is not None:
             self.serve_publisher.on_steps(out.params, n=1)
+        if self.controller is not None:
+            self.controller.on_steps(1)
         return out, loss
 
     def run(self, state: TrainState, batches, pipeline: int = 0,

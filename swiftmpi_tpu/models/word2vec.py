@@ -300,6 +300,19 @@ class Word2Vec:
             raise ValueError("[serve] every must be >= 0")
         self.serve_publisher = None
 
+        # [control] (control/): the adaptive control plane — re-derive
+        # hot_k / push_window / wire-format knobs online from the live
+        # traffic ledger and the decayed id-frequency sketch.  Off (the
+        # default) constructs NOTHING: no sketch, no controller, no
+        # observation — trajectories are bit-identical to a build
+        # without the plane (the tests pin this down).
+        from swiftmpi_tpu.control import ControlSettings
+        self.control_settings = ControlSettings.from_config(self.config)
+        self.controller = None
+        self._control_sketch = None
+        self._control_recompiles = 0
+        self._control_dirty = False
+
         self.cluster = cluster or Cluster(self.config).initialize()
         # [cluster] data_plane (read by Cluster.initialize): steers the
         # stencil step's neu1 between the XLA gather->mask->sum chain
@@ -368,6 +381,8 @@ class Word2Vec:
         prob, alias = build_unigram_alias(self.vocab.counts)
         self._alias_prob = jnp.asarray(prob)
         self._alias_idx = jnp.asarray(alias)
+        if self.control_settings.enabled:
+            self._arm_control()
         log.info("vocab: %d words, %d tokens; table capacity %d",
                  V, self.vocab.total_words, self.table.capacity)
         return self
@@ -1358,11 +1373,18 @@ class Word2Vec:
         ``[worker] pipeline``."""
         inner = self.inner_steps
         group = []
+        # control-plane frequency sketch: observe the center/token ids
+        # HERE, on the rendering side (host numpy, thread-safe observe)
+        # — consumption may see already-transferred device arrays when
+        # the pipeline is on
+        sketch = self._control_sketch
 
         def group_item():
             n_words = [b.n_words for b in group]
             fields = (_stack_group_host_stencil(group) if stencil
                       else _stack_group_host(group))
+            if sketch is not None:
+                sketch.observe(fields[0])
             return ("group", fields, n_words)
 
         epoch_iter = (batcher.epoch_stencil(batch_size) if stencil
@@ -1387,6 +1409,8 @@ class Word2Vec:
             else:
                 fields = (batch.centers, batch.contexts,
                           batch.ctx_mask)
+            if sketch is not None:
+                sketch.observe(fields[0])
             yield ("single", fields, batch.n_words)
         if group:                  # leftover partial group
             yield group_item()
@@ -1605,6 +1629,13 @@ class Word2Vec:
                     meter.record(n_words)
                     obs.record_step(1)
                     self._serve_on_steps(1)
+                    if self._control_on_steps(1):
+                        # an applied decision re-laid out the table (or
+                        # rebuilt the step): repoint the loop-local
+                        # state — and the async snapshot, whose rows sit
+                        # at pre-repartition slots — at the remapped one
+                        state = self.table.state
+                        frozen = state
 
                 def run_group(fields, n_words):
                     # update ORDER is preserved either way: a group runs
@@ -1642,6 +1673,8 @@ class Word2Vec:
                     meter.record(sum(n_words), steps=L)
                     obs.record_step(L)
                     self._serve_on_steps(L)
+                    if self._control_on_steps(L):
+                        state = self.table.state
 
                 items = self._epoch_items(batcher, batch_size, stencil,
                                           fuse)
@@ -1713,6 +1746,10 @@ class Word2Vec:
             "pipeline_depth": self.pipeline_depth if pipelined else 0}
         if pipe_stats is not None:
             self.train_metrics["pipeline"] = dict(pipe_stats)
+        if self.controller is not None:
+            self.train_metrics["control"] = {
+                **self.controller.summary(),
+                "recompiles": self._control_recompiles}
         if hasattr(self.transfer, "traffic"):
             # traffic() drains queued eager counts through _accum_wire,
             # so the registry mirror is exact before the summary lands
@@ -1882,6 +1919,201 @@ class Word2Vec:
         pub.publish(self.table, keys=lambda: self.vocab.keys,
                     slots=lambda: np.asarray(self._slot_of_vocab),
                     meta={"query_field": "v"})
+
+    # -- adaptive control plane (control/; [control] section) --------------
+    def _arm_control(self) -> None:
+        """Construct the control plane for this model: the decayed
+        id-frequency sketch (seeded from the build-time vocab counts so
+        evaluation 0 reproduces the static calibration — no startup
+        flap), the knob registry, and the controller.  Knob appliers
+        own the safe-point machinery: re-partition via
+        ``SparseTable.repartition`` plus the grow()-style cache fixups."""
+        from swiftmpi_tpu.control import Controller, DecayedSketch, Knob
+        st = self.control_settings
+        keys = np.asarray(self.vocab.keys, np.uint64)
+        self._control_key_order = np.argsort(keys, kind="stable")
+        self._control_sorted_keys = keys[self._control_key_order]
+        self._control_sketch = DecayedSketch(
+            len(self.vocab), decay=st.decay,
+            seed_counts=self.vocab.counts)
+        self._control_recompiles = 0
+        knobs = []
+        if getattr(self.transfer, "name", "") == "hybrid":
+            knobs.append(Knob(
+                "hot_k",
+                current=lambda: int(self.table.key_index.n_hot),
+                propose=self._propose_hot_k,
+                apply=self._apply_hot_k,
+                describe=lambda p: {"n_hot": int(p.n_hot),
+                                    "head_mass": p.head_mass}))
+        if self.inner_steps > 1 and hasattr(self.transfer,
+                                            "push_window"):
+            knobs.append(Knob(
+                "push_window",
+                current=lambda: int(self.push_window_size),
+                propose=self._propose_push_window,
+                apply=self._apply_push_window))
+            knobs.append(Knob(
+                "wire_format",
+                current=lambda: float(
+                    self.transfer.window_expected_unique or 0.0),
+                propose=self._propose_wire,
+                apply=self._apply_wire))
+        self.controller = Controller(st, transfer=self.transfer,
+                                     sketch=self._control_sketch,
+                                     knobs=knobs)
+
+    def _control_on_steps(self, n: int) -> bool:
+        """Trainer-thread control hook — called at the same safe points
+        the serving plane publishes at (no dispatch in flight, table
+        state current).  Returns True when an applied decision re-laid
+        out the table or rebuilt the compiled step, i.e. the train
+        loop must refresh its local state reference."""
+        ctl = self.controller
+        if ctl is None:
+            return False
+        self._control_dirty = False
+        ctl.on_steps(n)
+        return self._control_dirty
+
+    def _control_mass(self, keys_arr, counts) -> float:
+        """Sketch mass carried by a key set (keys must be vocab keys)."""
+        keys_arr = np.asarray(keys_arr, np.uint64).ravel()
+        if keys_arr.size == 0:
+            return 0.0
+        pos = np.searchsorted(self._control_sorted_keys, keys_arr)
+        pos = np.minimum(pos, self._control_sorted_keys.size - 1)
+        return float(counts[self._control_key_order[pos]].sum())
+
+    def _rebuild_step(self) -> None:
+        """Safe-point recompile: a knob change that moves rows or
+        reshapes the window program invalidates every compiled step
+        (capacity, n_hot and the window layout are baked in at trace
+        time) — the ``grow()`` fixup contract, owned here for the
+        control-plane appliers."""
+        self._fused_cache = {}
+        if self.async_mode == "hogwild":
+            # control hooks never fire on the hogwild path; a stale
+            # step cannot be reached, but drop it anyway for symmetry
+            self._step = None
+        elif self.local_steps <= 1:
+            self._step = self._build_step()
+        else:
+            self._step = (jax.jit(self._build_grads()),
+                          jax.jit(self._build_apply()))
+        self._control_recompiles += 1
+        self._control_dirty = True
+
+    def _propose_hot_k(self, counts, delta):
+        """Re-run the hot/cold calibration on the decayed histogram.
+        Win = token-mass points the re-derived hot set captures over
+        the current one, under the CURRENT traffic distribution."""
+        if counts is None:
+            return None
+        total = float(counts.sum())
+        if total <= 0:
+            return None
+        from swiftmpi_tpu.control import Proposal
+        from swiftmpi_tpu.parameter.key_index import HotColdPartition
+        # x1024: from_counts quantizes to int64 — keep ~10 fractional
+        # bits of the decayed histogram instead of truncating it
+        part = HotColdPartition.from_counts(
+            self.vocab.keys, counts * 1024.0, batch_rows=self.minibatch)
+        cur = self.table.key_index.partition
+        if cur is not None and part == cur:
+            return None
+        new_mass = self._control_mass(part.hot_keys, counts) / total
+        cur_mass = (self._control_mass(cur.hot_keys, counts) / total
+                    if cur is not None and cur.n_hot else 0.0)
+        return Proposal(part, new_mass - cur_mass, {
+            "old_n_hot": int(cur.n_hot) if cur is not None else 0,
+            "new_n_hot": int(part.n_hot),
+            "old_head_mass": cur_mass, "new_head_mass": new_mass,
+            "sketch_observed": int(self._control_sketch.observed)})
+
+    def _apply_hot_k(self, part, evidence) -> bool:
+        """Re-partition at the safe point.  A shard without room for
+        the demoted rows rejects the decision (CapacityError is raised
+        before any mutation — the table is untouched)."""
+        from swiftmpi_tpu.parameter.key_index import CapacityError
+        try:
+            plan = self.table.repartition(part)
+        except CapacityError as e:
+            evidence["error"] = str(e)
+            return False
+        evidence["moved_rows"] = int(plan.moved_rows)
+        slots = self.table.key_index.lookup(self.vocab.keys)
+        self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
+        self._rebuild_step()
+        return True
+
+    def _propose_push_window(self, counts, delta):
+        """Retune the window width over {W/2, W, 2W} (capped at
+        inner_steps — the staleness bound W-1 never exceeds one fused
+        group).  Cost = expected unique rows on the wire per train
+        step, E[U(w*B)]/w — row_bytes cancels out of the comparison."""
+        if counts is None:
+            return None
+        from swiftmpi_tpu.cluster.hashfrag import expected_unique_rows
+        from swiftmpi_tpu.control import Proposal
+        W = self.push_window_size
+        B = self.minibatch
+        cands = sorted({max(1, W // 2), W,
+                        min(2 * W, max(self.inner_steps, 1))})
+        if len(cands) == 1:
+            return None
+
+        def cost(w):
+            return expected_unique_rows(counts, w * B) / w
+
+        cur_cost = cost(W)
+        if cur_cost <= 0:
+            return None
+        best = min(cands, key=cost)
+        if best == W:
+            return None
+        return Proposal(int(best), (cur_cost - cost(best)) / cur_cost, {
+            "old_w": int(W), "new_w": int(best),
+            "rows_per_step_old": cur_cost,
+            "rows_per_step_new": cost(best)})
+
+    def _apply_push_window(self, w, evidence) -> bool:
+        w = int(w)
+        self.push_window_size = w
+        if hasattr(self.transfer, "window_expected_unique"):
+            from swiftmpi_tpu.cluster.hashfrag import \
+                expected_unique_rows
+            self.transfer.window_expected_unique = (
+                expected_unique_rows(self._control_sketch.counts,
+                                     w * self.minibatch)
+                if w > 1 else None)
+        self._rebuild_step()
+        return True
+
+    def _propose_wire(self, counts, delta):
+        """Refresh the per-window sparse/dense crossover input: the
+        expected unique-row count under the DECAYED histogram.  Win =
+        relative drift of E[U] since it was last baked in."""
+        if counts is None or self.push_window_size <= 1:
+            return None
+        old = getattr(self.transfer, "window_expected_unique", None)
+        if old is None:
+            return None
+        from swiftmpi_tpu.cluster.hashfrag import expected_unique_rows
+        from swiftmpi_tpu.control import Proposal
+        new = expected_unique_rows(
+            counts, self.push_window_size * self.minibatch)
+        return Proposal(float(new), abs(new - old) / max(float(old), 1.0),
+                        {"old_expected_unique": float(old),
+                         "new_expected_unique": float(new)})
+
+    def _apply_wire(self, eu, evidence) -> bool:
+        self.transfer.window_expected_unique = float(eu)
+        # the sparse/dense decision is host-static, baked at trace time
+        # (transfer.decide_wire_format in _push_window_flat) — recompile
+        # so the new crossover takes effect at this safe point
+        self._rebuild_step()
+        return True
 
     def embedding_index(self, field: str = "v"):
         """Cosine-similarity index over the LIVE table (no dump round

@@ -22,6 +22,9 @@ Record schema (one JSON object per line, ``"v": 1`` on every line):
 * ``kind: "summary"`` — last line: cumulative counter totals, final
   gauges, and p50/p95/p99 per histogram — so one-shot consumers (the
   traffic-budget gate) never have to re-sum the deltas.
+* other kinds — out-of-band :meth:`StepRecorder.event` lines (the
+  control plane's ``control/decision`` records): arbitrary payload
+  stamped with the recorder's step/clock, same ``"v"`` versioning.
 
 Writes happen only on the recording thread (the training loop's consumer
 side); the registry itself is what the producer threads hit, and its
@@ -129,6 +132,27 @@ class StepRecorder:
             self._buf.append(json.dumps(rec, sort_keys=True))
             if len(self._buf) >= self._flush_every:
                 self.flush()
+
+    def event(self, kind: str, payload: Optional[dict] = None) -> dict:
+        """Append a schema-versioned out-of-band event line (e.g. the
+        control plane's ``control/decision`` records).  Events carry the
+        recorder's current step count and clock so they interleave with
+        the step series on a shared axis; they ride the same ring/flush
+        machinery as step records but never perturb the delta snapshots.
+        Returns the record written."""
+        if self._closed:
+            return {}
+        rec = {"v": SCHEMA_V, "kind": str(kind),
+               "step": self._step_total,
+               "t": time.monotonic() - self._t0,
+               "rank": self._meta["rank"], "ident": self._meta["ident"],
+               **(payload or {})}
+        self._ring.append(rec)
+        if self.path:
+            self._buf.append(json.dumps(rec, sort_keys=True))
+            if len(self._buf) >= self._flush_every:
+                self.flush()
+        return rec
 
     # -- read side ---------------------------------------------------------
     def records(self) -> List[dict]:

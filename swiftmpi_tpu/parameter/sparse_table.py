@@ -206,6 +206,82 @@ class SparseTable:
                 new_state[f] = v
         self.state = new_state
 
+    # -- online re-partition ----------------------------------------------
+    def repartition(self, new_partition) -> "object":
+        """Swap the hot/cold split to ``new_partition`` (a
+        ``HotColdPartition`` or None), replaying the KeyIndex's
+        :class:`~swiftmpi_tpu.parameter.key_index.RepartitionPlan` on
+        the device arrays: demoted hot rows are written back into their
+        tail slots, staying keys' hot rows move to their new frequency
+        rank, and promoted keys seed their hot row from their
+        materialized tail row (or fresh init if never touched).  Tail
+        rows never re-stride — a promoted key's tail slot stays
+        allocated and merely goes dormant under the hot overlay, so a
+        later demotion writes the live hot row back over it.
+
+        Like :meth:`grow`, the remap is one jitted scatter with no
+        donation (both layouts coexist during the copy) and anything
+        jitted over the OLD state dict must be rebuilt by the caller
+        (the safe-point contract in models/word2vec.py).  Raises
+        ``CapacityError`` before touching anything when demoted keys
+        cannot get tail slots."""
+        plan = self.key_index.repartition(new_partition)
+        old_n_hot, new_n_hot = plan.old_n_hot, plan.new_n_hot
+
+        fields = self.access.fields
+        sharding = self.row_sharding()
+        self.seed += 1        # fresh init stream for the new hot head
+
+        def remap(state, p, key):
+            out = {}
+            for name, fs in sorted(fields.items()):
+                tail = state[name]
+                if p["demote_src"].shape[0]:
+                    tail = tail.at[p["demote_dst"]].set(
+                        jnp.take(state[hot_name(name)], p["demote_src"],
+                                 axis=0))
+                out[name] = tail
+            for name, fs in sorted(fields.items()):
+                if not new_n_hot:
+                    continue
+                key, sub = jax.random.split(key)
+                hot = fs.init(sub, (new_n_hot, fs.dim)).astype(fs.dtype)
+                if p["hot_from_hot_src"].shape[0]:
+                    hot = hot.at[p["hot_from_hot_dst"]].set(
+                        jnp.take(state[hot_name(name)],
+                                 p["hot_from_hot_src"], axis=0))
+                if p["hot_from_tail_src"].shape[0]:
+                    # reads the OLD tail (state[name]), not the demoted-
+                    # updated copy: a promoted key's seed row predates
+                    # this repartition by construction
+                    hot = hot.at[p["hot_from_tail_dst"]].set(
+                        jnp.take(state[name], p["hot_from_tail_src"],
+                                 axis=0))
+                out[hot_name(name)] = hot
+            return out
+
+        state_in = dict(self.state)
+        if old_n_hot == 0:
+            # no hot arrays exist yet; remap indexes them only under
+            # zero-length plan arrays, but the dict entries must exist
+            for name, fs in sorted(fields.items()):
+                state_in[hot_name(name)] = jnp.zeros(
+                    (0, fs.dim), fs.dtype)
+        p = {k: jnp.asarray(getattr(plan, k)) for k in
+             ("demote_src", "demote_dst", "hot_from_hot_src",
+              "hot_from_hot_dst", "hot_from_tail_src",
+              "hot_from_tail_dst")}
+        out_shardings = None
+        if sharding is not None:
+            out_shardings = {name: sharding for name in fields}
+            if new_n_hot:
+                rep = self.replicated_sharding()
+                out_shardings.update(
+                    {hot_name(name): rep for name in fields})
+        jitted = jax.jit(remap, out_shardings=out_shardings)
+        self.state = jitted(state_in, p, jax.random.key(self.seed))
+        return plan
+
     # -- device-level row access ------------------------------------------
     def _take_unified(self, field: str, slots) -> jax.Array:
         """Row gather over the unified hot+tail slot space."""

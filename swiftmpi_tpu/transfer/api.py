@@ -154,8 +154,9 @@ class Transfer:
                 "wire_bytes": 0, "dispatches": 0,
                 "window_sparse": 0, "window_dense": 0,
                 "coalesced_rows_in": 0, "coalesced_rows_out": 0,
-                "pull_bytes": 0, "pull_rows": 0,
-                "pending": [], "pull_pending": []}
+                "pull_bytes": 0, "pull_rows": 0, "pull_hot_rows": 0,
+                "pending": [], "pull_pending": [],
+                "pull_hot_pending": []}
         return st
 
     def _obs_inc(self, key: str, n) -> None:
@@ -233,6 +234,31 @@ class Transfer:
                 for rb, r in pending:
                     self._accum_pull(rb, r)
 
+    def _accum_pull_hot(self, rows) -> None:
+        st = self._wire_state()
+        st["pull_hot_rows"] += int(rows)
+        self._obs_inc("pull_hot_rows", int(rows))
+
+    def _record_pull_hot(self, rows) -> None:
+        """Record ``rows`` pull hits answered by a local replica (the
+        hybrid backend's hot head).  These rows are INCLUDED in
+        ``pull_rows`` (so row totals stay comparable across backends)
+        but ship zero wire bytes; this explicit series lets miss-ratio
+        math separate replica hits from actually-shipped tail rows
+        instead of inferring it from ``pull_bytes == 0`` rows."""
+        if not getattr(self, "count_traffic", False):
+            return
+        if isinstance(rows, jax.core.Tracer):
+            jax.debug.callback(self._accum_pull_hot, rows)
+        else:
+            st = self._wire_state()
+            st["pull_hot_pending"].append(rows)
+            if len(st["pull_hot_pending"]) >= 1024:
+                pending, st["pull_hot_pending"] = \
+                    st["pull_hot_pending"], []
+                for r in pending:
+                    self._accum_pull_hot(r)
+
     def _accum_coalesce(self, decision, rows_in, rows_out) -> None:
         st = self._wire_state()
         st["coalesced_rows_in"] += int(rows_in)
@@ -282,8 +308,12 @@ class Transfer:
         pulls, st["pull_pending"] = st["pull_pending"], []
         for rb, r in pulls:
             self._accum_pull(rb, r)
+        hots, st["pull_hot_pending"] = st["pull_hot_pending"], []
+        for r in hots:
+            self._accum_pull_hot(r)
         return {k: v for k, v in st.items()
-                if k not in ("pending", "pull_pending")}
+                if k not in ("pending", "pull_pending",
+                             "pull_hot_pending")}
 
     def traffic(self) -> Dict[str, int]:
         """Cumulative traffic counters; every backend reports at least
@@ -297,6 +327,65 @@ class Transfer:
         on), so per-step deltas come from ``telemetry.jsonl`` without
         ever calling this (and without its ``jax.effects_barrier``)."""
         return self.wire_traffic()
+
+    def traffic_delta(self, since: Optional[Dict[str, int]] = None
+                      ) -> Dict[str, int]:
+        """Per-interval traffic: :meth:`traffic` minus an earlier
+        snapshot ``since`` (itself a ``traffic()`` return value).
+
+        This is the helper side of the monotonic-totals contract: the
+        ledger never resets, so interval numbers are always
+        snapshot-and-subtract — done HERE once instead of hand-rolled
+        at every call site.  ``since=None`` (or a key absent from
+        ``since``, e.g. a snapshot taken before a counter existed)
+        subtracts zero, so the result degrades to the totals."""
+        cur = self.traffic()
+        if not since:
+            return cur
+        return {k: v - since.get(k, 0) for k, v in cur.items()}
+
+    # -- wire-format decision hook ----------------------------------------
+    #: post-dedup unique-row estimate for the window crossover (set by
+    #: the model from the vocab histogram; retuned online by the
+    #: control plane).  None = use the raw pre-dedup row count.
+    window_expected_unique = None
+
+    def _ratio_state(self) -> dict:
+        st = self.__dict__.get("_wire_ratios")
+        if st is None:
+            st = self.__dict__["_wire_ratios"] = {}
+        return st
+
+    def wire_dense_ratio(self, family: Optional[str] = None) -> float:
+        """Current sparse/dense crossover ratio for a push family
+        (``None`` = the default family): dense wins when
+        ``sparse_volume * ratio >= dense_volume``.  2.0 is the
+        SparCML-derived seed default (see key_index.window_wire_format);
+        the control plane retunes it per family at runtime."""
+        st = self._ratio_state()
+        return float(st.get(family, st.get(None, 2.0)))
+
+    def set_wire_dense_ratio(self, ratio: float,
+                             family: Optional[str] = None) -> None:
+        """Set the crossover ratio (per ``family``, or the default when
+        ``family=None``).  Takes effect on the NEXT decision — decisions
+        are made host-side per call, so no recompile is needed."""
+        self._ratio_state()[family] = float(ratio)
+
+    def decide_wire_format(self, rows: int, capacity: int,
+                           row_bytes: int,
+                           family: Optional[str] = None) -> str:
+        """``"sparse" | "dense"`` for one exchange of ``rows`` candidate
+        rows against a ``capacity``-row dense alternative.  The ONE
+        place backends ask the sparse/dense question — call sites no
+        longer read config/module constants directly, so the control
+        plane can steer the crossover (ratio and expected-unique
+        estimate) without touching compiled code."""
+        from swiftmpi_tpu.parameter.key_index import window_wire_format
+        return window_wire_format(
+            int(rows), int(capacity), int(row_bytes),
+            dense_ratio=self.wire_dense_ratio(family),
+            expected_unique=self.window_expected_unique)
 
     def pull(self, state: TableState, slots, access: AccessMethod,
              fields=None) -> TableState:

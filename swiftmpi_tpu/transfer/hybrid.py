@@ -93,6 +93,14 @@ class HybridTransfer(Transfer):
     def window_expected_unique(self, v):
         self.tail.window_expected_unique = v
 
+    def wire_dense_ratio(self, family=None):
+        return self.tail.wire_dense_ratio(family)
+
+    def set_wire_dense_ratio(self, ratio, family=None):
+        # the tail backend asks the wire-format question (its
+        # _push_window_flat), so the tunable ratio state lives there
+        self.tail.set_wire_dense_ratio(ratio, family)
+
     def overflow_count(self) -> int:
         return self.tail.overflow_count()
 
@@ -146,7 +154,8 @@ class HybridTransfer(Transfer):
                "overflow_dropped": t["overflow_dropped"]}
         for k in ("wire_bytes", "dispatches", "window_sparse",
                   "window_dense", "coalesced_rows_in",
-                  "coalesced_rows_out", "pull_bytes", "pull_rows"):
+                  "coalesced_rows_out", "pull_bytes", "pull_rows",
+                  "pull_hot_rows"):
             out[k] = t.get(k, 0) + w.get(k, 0)
         if self.metrics is not None:
             self.metrics.set("transfer_hot_rows", out["hot_rows"])
@@ -201,8 +210,12 @@ class HybridTransfer(Transfer):
             self._record_hot(n_hot_rows, 0)
             # hot pulls are local replica hits: rows counted, zero bytes
             # (tail rows/bytes land on the tail backend's own ledger and
-            # merge in traffic())
+            # merge in traffic()).  The explicit pull_hot_rows series
+            # disambiguates the asymmetry — pull_rows includes these
+            # rows while pull_bytes books them at 0, so byte-per-row or
+            # miss-ratio math must subtract pull_hot_rows first
             self._record_pull(n_hot_rows, 0)
+            self._record_pull_hot(n_hot_rows)
         # hot rows are a LOCAL gather on the replicated head — the tail
         # pull returned exact zeros at these positions (slot -1 padding)
         hot_idx = jnp.clip(slots, 0, n_hot - 1)

@@ -38,7 +38,6 @@ from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
 from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
 from swiftmpi_tpu.ops import (calibration, pallas_gather, pallas_ring,
                               pallas_scatter)
-from swiftmpi_tpu.parameter.key_index import window_wire_format
 from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
                                        pull_row_bytes)
 
@@ -398,9 +397,12 @@ class TpuTransfer(Transfer):
         capacity = next(iter(state.values())).shape[0]
         with_counts = fcounts is not None
         row_bytes = grad_row_bytes(fgrads, with_counts=with_counts)
-        decision = window_wire_format(
-            int(flat.shape[0]), capacity, row_bytes,
-            expected_unique=self.window_expected_unique)
+        # the crossover is asked through the base-class decision hook
+        # (seed behavior == window_wire_format at dense_ratio 2.0 with
+        # this instance's expected-unique hint) so the control plane can
+        # retune it per family without touching this call site
+        decision = self.decide_wire_format(
+            int(flat.shape[0]), capacity, row_bytes, family="window")
         if decision == "dense":
             return self._push_window_dense(state, flat, fgrads, access,
                                            mean, fcounts)

@@ -91,7 +91,13 @@ class XlaTransfer(Transfer):
         capacity = next(iter(state.values())).shape[0]
         dense = self.dense_apply
         if dense is None:
-            dense = slots.shape[0] >= capacity // 2
+            # per-call compute crossover through the tunable decision
+            # hook: dense once the batch reaches capacity/ratio rows.
+            # The seed ratio 2.0 reproduces the measured
+            # ``>= capacity // 2`` rule exactly (int(cap / 2.0) ==
+            # cap // 2), keeping control-off trajectories bit-identical
+            dense = slots.shape[0] >= int(
+                capacity / self.wire_dense_ratio("push_apply"))
         if dense:
             self._record_exchange(
                 capacity, grad_row_bytes(grads, with_index=False))

@@ -271,8 +271,12 @@ def test_pull_ledger_all_backends(backend_name, devices8):
     assert tr1["pull_rows"] > 0 and tr1["pull_bytes"] > 0
     backend.pull(state, slots, access)
     tr2 = backend.traffic()
+    # the interval helper over the monotonic ledger: the second pull's
+    # delta equals the first pull's totals (exact + monotonic)
+    delta = backend.traffic_delta(tr1)
     for k in ("pull_rows", "pull_bytes"):
-        assert tr2[k] == 2 * tr1[k], k            # exact + monotonic
+        assert tr2[k] == 2 * tr1[k], k
+        assert delta[k] == tr1[k], k
     if backend_name in ("local", "xla", "tpu"):
         row_b = pull_row_bytes(state, access.pull_fields)
         assert tr1["pull_rows"] == n_valid
