@@ -516,7 +516,8 @@ W2V_1M_VOCAB = 1_000_000
 
 
 def build_w2v_1m_model(device, stencil=False, hybrid=False,
-                       window_steps=1, pipeline=0, control=None):
+                       window_steps=1, pipeline=0, control=None,
+                       wire_quant=None):
     """The 1M-vocab cell's model (BASELINE config #3 shape: demo.conf
     hyperparameters over a ~1M-word Zipf vocabulary / 1.3M-row table).
     ONE builder shared by the bench cell and the profiler ablation
@@ -549,7 +550,13 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
 
     ``control=dict``: arm the adaptive control plane with the given
     ``[control]`` section (the BENCH_ONLY=scale_autotune cell's
-    autotune arm; ``None`` leaves the section absent = control off)."""
+    autotune arm; ``None`` leaves the section absent = control off).
+
+    ``wire_quant``: arm the window wire compressor ([cluster]
+    wire_quant: int8|bf16) — the 4-way crossover may then pick the
+    quantized sparse rung (per-bucket scales + error-feedback
+    residuals) or the bitmap rung.  The BENCH_ONLY=scale_qwire cell's
+    shape; ``None`` keeps the lossless PR-9 wire."""
     import jax
     import numpy as np
     from swiftmpi_tpu.cluster.cluster import Cluster
@@ -566,7 +573,9 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
         "cluster": {"transfer": "hybrid" if hybrid else "xla",
                     "server_num": 1,
                     **({"push_window": int(window_steps)}
-                       if window_steps > 1 else {})},
+                       if window_steps > 1 else {}),
+                    **({"wire_quant": str(wire_quant)}
+                       if wire_quant else {})},
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
                      "sample": -1, "learning_rate": 0.05,
                      # BENCH_SCALE_SHARED=1: the batch-shared negative
@@ -611,7 +620,7 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
 
 
 def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
-                  window_steps=1):
+                  window_steps=1, wire_quant=None):
     """BASELINE config #3 shape: the same fused step over a ~1M-word
     vocabulary (1.3M-row table).  Batches are synthesized directly in
     vocab-index space (uniform centers/contexts, Zipf counts for the
@@ -627,7 +636,8 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
 
     V = W2V_1M_VOCAB
     model, rng = build_w2v_1m_model(device, stencil=stencil, hybrid=hybrid,
-                                    window_steps=window_steps)
+                                    window_steps=window_steps,
+                                    wire_quant=wire_quant)
     tr0 = None
     if hybrid or window_steps > 1:
         # arm the traffic counters BEFORE the jit build: the per-step
@@ -713,6 +723,13 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
         out["wire_bytes_per_step"] = round(tr["wire_bytes"] / steps, 1)
         out["window_sparse"] = tr["window_sparse"]
         out["window_dense"] = tr["window_dense"]
+        # the 4-way decision mix: which wire format each window closed
+        # on (sparse_q/bitmap booked at their ENCODED size) — the
+        # budget gate's decision-mix floor reads these next to the
+        # wire_quant detail
+        for fmt in ("dense", "sparse", "q", "bitmap"):
+            out[f"window_fmt_{fmt}"] = tr.get(f"window_fmt_{fmt}", 0)
+        out["wire_quant"] = str(wire_quant) if wire_quant else "off"
         out["coalesced_rows_in"] = tr["coalesced_rows_in"]
         out["coalesced_rows_out"] = tr["coalesced_rows_out"]
         if tr["coalesced_rows_in"]:
@@ -1836,6 +1853,24 @@ def child_main(which: str) -> None:
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
+    if os.environ.get("BENCH_ONLY") == "scale_qwire":
+        # quantized window wire at 1M vocab: the w2v_1m_window shape
+        # with [cluster] wire_quant armed (BENCH_WIRE_QUANT, default
+        # int8), so the 4-way crossover may pick the sparse_q rung —
+        # int8 values + per-bucket scales + error-feedback residuals —
+        # and book wire_bytes at the ENCODED size.  Own child + own
+        # key; identical declared rendering/window to w2v_1m_window,
+        # so the wire_bytes_per_step delta between the two cells is
+        # the compression win and the decision mix proves engagement
+        win = int(os.environ.get("BENCH_WINDOW", INNER_STEPS))
+        wq = os.environ.get("BENCH_WIRE_QUANT", "int8")
+        out["w2v_1m_qwire"] = _bench_w2v_1m(device, max(timed // 2, 1),
+                                            hybrid=True,
+                                            window_steps=win,
+                                            wire_quant=wq)
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
     if os.environ.get("BENCH_ONLY") == "scale_fused":
         # on-chip Pallas data plane A/B at 1M vocab: the fused stencil-
         # gather kernel vs the XLA chain, both arms inside ONE cell
@@ -2268,6 +2303,7 @@ _SECONDARY_CELLS = (
     ("w2v_1m_stencil", "w2v_1m_stencil", "words_per_sec", "words/s"),
     ("w2v_1m_hybrid", "w2v_1m_hybrid", "words_per_sec", "words/s"),
     ("w2v_1m_window", "w2v_1m_window", "words_per_sec", "words/s"),
+    ("w2v_1m_qwire", "w2v_1m_qwire", "words_per_sec", "words/s"),
     ("w2v_1m_pipeline", "w2v_1m_pipeline", "words_per_sec", "words/s"),
     ("w2v_1m_fused", "w2v_1m_fused", "words_per_sec", "words/s"),
     ("w2v_text8_epoch_wall", "w2v_text8", "epoch_wall_s", "s"),

@@ -52,7 +52,9 @@ TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
                    "dispatches_per_window", "stall_ms_per_step",
                    "kernel_ms", "serve_p99_ms", "serve_miss_ratio",
                    "pull_bytes_per_step", "control_decisions_per_1k_steps")
-DETAIL_METRICS = ("window_sparse", "window_dense", "coalesce_ratio",
+DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
+                  "window_fmt_sparse", "window_fmt_q",
+                  "window_fmt_bitmap", "wire_quant", "coalesce_ratio",
                   "push_window", "host_stall_ms", "queue_depth",
                   "pipeline", "speedup_vs_off", "qps", "p50_ms",
                   "hit_ratio", "streams", "snapshots",
@@ -97,7 +99,9 @@ def load_telemetry_cells(path: str) -> dict:
         cell["pull_bytes_per_step"] = pull / steps
     if "stall_ms_per_step" in t:
         cell["stall_ms_per_step"] = t["stall_ms_per_step"]
-    for decision in ("window_sparse", "window_dense"):
+    for decision in ("window_sparse", "window_dense", "window_fmt_dense",
+                     "window_fmt_sparse", "window_fmt_q",
+                     "window_fmt_bitmap"):
         total = sum(m.get(decision, 0.0) for m in t["transfer"].values())
         if total:
             cell[decision] = total
@@ -185,6 +189,28 @@ def compare(base: dict, cand: dict, tolerance: float,
     return regressions
 
 
+def decision_mix_violations(cells: dict) -> list:
+    """Cells that claim wire compression is on (``wire_quant`` detail
+    present and not ``off``) and booked window decisions, yet never once
+    chose an encoded format — the calibration equivalent of a feature
+    flag that silently no-ops.  Such a cell means the crossover model
+    and the live traffic disagree so badly the quantized rung never
+    fires, which is a gate failure, not a tuning preference."""
+    bad = []
+    fmt_keys = ("window_fmt_dense", "window_fmt_sparse",
+                "window_fmt_q", "window_fmt_bitmap")
+    for cell, m in sorted(cells.items()):
+        quant = m.get("wire_quant")
+        if quant in (None, "off"):
+            continue
+        total = sum(float(m.get(k, 0.0)) for k in fmt_keys)
+        encoded = float(m.get("window_fmt_q", 0.0)) \
+            + float(m.get("window_fmt_bitmap", 0.0))
+        if total > 0 and encoded <= 0:
+            bad.append((cell, quant, total))
+    return bad
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fail when bench traffic counters regressed")
@@ -228,6 +254,15 @@ def main(argv=None) -> int:
         print("check_traffic_budget: no cells with traffic counters in "
               "both files — nothing to check")
         return 0
+
+    mix = decision_mix_violations(
+        {c: m for c, m in cand.items() if not only or c in only})
+    if mix:
+        print("WIRE-COMPRESSION DECISION MIX FAILURE:")
+        for cell, quant, total in mix:
+            print(f"  {cell}: wire_quant={quant} with {total:g} window "
+                  "decisions but zero sparse_q/bitmap picks")
+        return 1
 
     regressions = compare(base, cand, args.tolerance, only)
     if regressions:

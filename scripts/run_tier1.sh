@@ -19,6 +19,15 @@ if [ -n "$BENCH_BASELINE" ] && [ -n "$BENCH_CANDIDATE" ] && [ -r "$BENCH_BASELIN
     echo "--- serve budget (advisory) ---"
     python "$(dirname "$0")/check_traffic_budget.py" --cells serve_qps "$BENCH_BASELINE" "$BENCH_CANDIDATE" || echo "serve budget ADVISORY FAILURE (tier-1 verdict unchanged)"
   fi
+  # Wire-compression gate: the qwire cell must hold its wire_bytes
+  # budget AND its decision mix must actually pick an encoded format
+  # (check_traffic_budget fails the run when wire_quant is armed but
+  # the sparse_q/bitmap share is zero).  Grep-gated so bench files
+  # predating the 4-way wire stay advisory-quiet.
+  if grep -q '"w2v_1m_qwire"' "$BENCH_BASELINE" && grep -q '"w2v_1m_qwire"' "$BENCH_CANDIDATE"; then
+    echo "--- qwire budget (advisory) ---"
+    python "$(dirname "$0")/check_traffic_budget.py" --cells w2v_1m_qwire "$BENCH_BASELINE" "$BENCH_CANDIDATE" || echo "qwire budget ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
 fi
 # Advisory calibration staleness check: verdicts recorded under another
 # jaxlib/libtpu stack no longer steer data-plane gates — say so next to

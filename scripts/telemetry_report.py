@@ -165,17 +165,29 @@ def phase_table(doc: dict) -> List[dict]:
 
 
 def wire_timeline(doc: dict) -> List[dict]:
-    """Per-step sparse/dense decision runs, compressed.  A step's
-    decision is whichever ``transfer/window_*`` counter moved in its
-    record (both can move when multiple windows close in one record —
-    then the step is labeled ``mixed``)."""
+    """Per-step wire-format decision runs, compressed.  A step's
+    decision is whichever ``transfer/window_fmt{fmt=...}`` label moved
+    in its record (dense/sparse/q/bitmap — the 4-way crossover); runs
+    recorded before the fmt counter existed fall back to the legacy
+    2-way ``transfer/window_{sparse,dense}`` counters.  Multiple
+    formats moving in one record (several windows closed) label the
+    step ``mixed``."""
     runs: List[dict] = []
     for rec in doc["steps"]:
         decisions = set()
+        legacy = set()
         for key, delta in (rec.get("counters") or {}).items():
-            name, _ = parse_series_key(key)
-            if name.startswith("transfer/window_") and delta > 0:
-                decisions.add(name[len("transfer/window_"):])
+            name, labels = parse_series_key(key)
+            if delta <= 0:
+                continue
+            if name == "transfer/window_fmt":
+                decisions.add(labels.get("fmt", "?"))
+            elif name.startswith("transfer/window_"):
+                legacy.add(name[len("transfer/window_"):])
+        # the fmt series is strictly finer (sparse_q/bitmap also bump
+        # the legacy sparse counter) — prefer it whenever present
+        if not decisions:
+            decisions = legacy
         if not decisions:
             continue
         label = decisions.pop() if len(decisions) == 1 else "mixed"
@@ -254,8 +266,16 @@ def traffic_summary(doc: dict) -> dict:
         name, labels = parse_series_key(key)
         if name.startswith("transfer/"):
             backend = labels.get("backend", "?")
-            transfer.setdefault(backend, {})[
-                name[len("transfer/"):]] = total
+            if name == "transfer/window_fmt":
+                # labeled decision counter: fold the fmt label into the
+                # metric name so the four series don't collide on one
+                # dict key (and so gate scripts see window_fmt_<fmt>)
+                k = "window_fmt_" + labels.get("fmt", "?")
+                bd = transfer.setdefault(backend, {})
+                bd[k] = bd.get(k, 0.0) + total
+            else:
+                transfer.setdefault(backend, {})[
+                    name[len("transfer/"):]] = total
         elif name.startswith("train/"):
             train[name[len("train/"):]] = total
         else:

@@ -29,7 +29,7 @@ import numpy as np
 from swiftmpi_tpu import obs
 from swiftmpi_tpu.cluster.bootstrap import host_array, is_writer
 from swiftmpi_tpu.parameter.sparse_table import (SparseTable, base_field,
-                                                 hot_name)
+                                                 hot_name, is_ef_field)
 
 Formatter = Callable[[Dict[str, np.ndarray]], str]
 Parser = Callable[[str], Dict[str, np.ndarray]]
@@ -495,16 +495,37 @@ def _load_checkpoint(table: SparseTable, path: str,
                 f"n_hot={table.n_hot} — the hot/cold partition is fixed "
                 "at vocab build; rebuild the model under the same "
                 "frequency split before restoring")
+        saved_ef = {zname[len("field__"):] for zname in z.files
+                    if zname.startswith("field__")
+                    and is_ef_field(zname[len("field__"):])}
+        table_ef = set(table.ef_fields)
+        if saved_ef != table_ef:
+            # a silent mismatch either drops pending residuals (EF
+            # checkpoint into a quant-off run: unapplied gradient mass
+            # vanishes) or zero-seeds planes mid-stream (non-EF
+            # checkpoint into an EF run: fine mathematically but almost
+            # always a misconfigured resume) — refuse loudly either way
+            raise ValueError(
+                f"checkpoint EF residual planes {sorted(saved_ef)} do "
+                f"not match the table's {sorted(table_ef)} — restore "
+                "with the same [cluster] wire_quant setting the "
+                "checkpoint was written under (or rebuild the model "
+                "with matching error-feedback arming)")
         state = {}
         for zname in z.files:
             if not zname.startswith("field__"):
                 continue
             name = zname[len("field__"):]
+            arr = z[zname]
+            if is_ef_field(name):
+                # EF residual planes are f32-native and not access
+                # fields — no FieldSpec, no dtype cast
+                state[name] = _replace(table, name, arr)
+                continue
             # @hot arrays restore next to their base field with the same
             # storage dtype (and their replicated placement, via
             # _replace's per-name sharding)
             fs = table.access.fields[base_field(name)]
-            arr = z[zname]
             if arr.dtype != fs.dtype:
                 # bf16 fields were saved upcast to fp32 (npz has no
                 # bfloat16); restore the table's storage dtype exactly

@@ -259,6 +259,19 @@ class Word2Vec:
         self.push_window_size = g("cluster", "push_window", 1).to_int32()
         if self.push_window_size < 1:
             raise ValueError("[cluster] push_window must be >= 1")
+        # [cluster] wire_quant: off|int8|bf16 — value quantization for
+        # the window push's sparse wire formats.  Arms the 4-way
+        # dense/sparse/bitmap/sparse_q crossover on the transfer and the
+        # per-field @ef error-feedback residual planes on the table
+        # (quantization error banks worker-side and drains into the next
+        # quantized window, so the trajectory tracks the f32 wire within
+        # the documented envelope).  "off" (default) keeps the 2-way
+        # decision and the wire bit-identical to the pre-quantization
+        # path.  Only meaningful with push_window > 1.
+        self.wire_quant = g("cluster", "wire_quant", "off").to_string()
+        if self.wire_quant not in ("off", "int8", "bf16"):
+            raise ValueError("[cluster] wire_quant must be off, int8 or "
+                             f"bf16, got {self.wire_quant!r}")
         # [worker] pipeline: K > 0 turns on the asynchronous input
         # pipeline (io/pipeline.py) — a producer thread renders batches
         # K ahead and eagerly device_puts them so H2D overlaps compute.
@@ -378,6 +391,18 @@ class Word2Vec:
             self.transfer.window_expected_unique = expected_unique_rows(
                 self.vocab.counts,
                 self.push_window_size * self.minibatch)
+        if self.wire_quant != "off":
+            if self.push_window_size > 1:
+                self.transfer.wire_quant = self.wire_quant
+                # EF residual planes for every window-pushed grad family
+                # — created BEFORE any step compiles so the state pytree
+                # shape is stable for the fused scan and checkpoints
+                self.table.ensure_ef(tuple(self.access.grad_fields))
+            else:
+                log.warning(
+                    "[cluster] wire_quant: %s has no effect at "
+                    "push_window: 1 (per-step pushes ship f32); "
+                    "ignoring", self.wire_quant)
         prob, alias = build_unigram_alias(self.vocab.counts)
         self._alias_prob = jnp.asarray(prob)
         self._alias_idx = jnp.asarray(alias)
@@ -2091,9 +2116,13 @@ class Word2Vec:
         return True
 
     def _propose_wire(self, counts, delta):
-        """Refresh the per-window sparse/dense crossover input: the
+        """Refresh the per-window wire-format crossover input: the
         expected unique-row count under the DECAYED histogram.  Win =
-        relative drift of E[U] since it was last baked in."""
+        relative drift of E[U] since it was last baked in.  Evidence
+        carries the 4-way format the crossover would pick under the old
+        vs the new estimate (a representative one-field window family),
+        so a decision log shows when a retune actually flips the baked
+        format rather than just nudging the estimate."""
         if counts is None or self.push_window_size <= 1:
             return None
         old = getattr(self.transfer, "window_expected_unique", None)
@@ -2101,11 +2130,28 @@ class Word2Vec:
             return None
         from swiftmpi_tpu.cluster.hashfrag import expected_unique_rows
         from swiftmpi_tpu.control import Proposal
+        from swiftmpi_tpu.parameter.key_index import window_wire_format
         new = expected_unique_rows(
             counts, self.push_window_size * self.minibatch)
+        d = self.len_vec
+        row_bytes = 4 + 4 * d + 4          # i32 index + f32 row + counts
+        qrb = 4 + (d + 4 if self.wire_quant == "int8" else 2 * d) + 4 \
+            if self.wire_quant != "off" else None
+        rows = self.push_window_size * self.minibatch
+
+        def _fmt(eu):
+            return window_wire_format(
+                rows, self.table.capacity, row_bytes,
+                dense_ratio=self.transfer.wire_dense_ratio("window"),
+                expected_unique=eu, quant=self.wire_quant,
+                quant_row_bytes=qrb,
+                quant_guard=self.transfer.wire_quant_guard)
+
         return Proposal(float(new), abs(new - old) / max(float(old), 1.0),
                         {"old_expected_unique": float(old),
-                         "new_expected_unique": float(new)})
+                         "new_expected_unique": float(new),
+                         "old_format": _fmt(float(old)),
+                         "new_format": _fmt(float(new))})
 
     def _apply_wire(self, eu, evidence) -> bool:
         self.transfer.window_expected_unique = float(eu)

@@ -92,8 +92,11 @@ def calibrate_hot_k(counts, mass_lo: float = 0.5, mass_hi: float = 0.8,
 
 def window_wire_format(rows: int, capacity: int, row_bytes: int,
                        dense_ratio: float = 2.0,
-                       expected_unique: Optional[float] = None) -> str:
-    """Sparse-vs-dense wire format for one coalesced push window.
+                       expected_unique: Optional[float] = None,
+                       quant: str = "off",
+                       quant_row_bytes: Optional[int] = None,
+                       quant_guard: float = 1.25) -> str:
+    """Wire format for one coalesced push window.
 
     The same crossover rule :func:`calibrate_hot_k` applies to placement
     ("dense once sparse volume passes half the dense size", SparCML
@@ -107,13 +110,52 @@ def window_wire_format(rows: int, capacity: int, row_bytes: int,
     (when the caller has a frequency histogram — see
     ``cluster.hashfrag.expected_unique_rows``) caps it at the rows the
     pre-exchange dedup will actually leave on the wire.  The decision is
-    host-static so the compiled window program bakes in one format."""
+    host-static so the compiled window program bakes in one format.
+
+    With ``quant != "off"`` the decision widens from 2-way to 4-way
+    (SparCML's quantized sparse streams, S2-Reducer's index-set
+    compression) using per-format byte models over ``eff`` effective
+    rows (``value_bytes = row_bytes - 4``, the index word removed):
+
+      =========  =====================================================
+      dense      ``capacity * row_bytes`` (unchanged 2-way gate, so
+                 the sparse/dense boundary is bit-identical to quant
+                 off)
+      sparse     ``eff * (4 + row_bytes)`` — f32 (index, value) pairs;
+                 lossless, the legacy representation
+      bitmap     ``capacity / 8 + eff * value_bytes`` — one occupancy
+                 bit per table row plus packed values; wins in the
+                 mid-density band where index words cost more than the
+                 mask; lossless
+      sparse_q   ``eff * (4 + quant_row_bytes)`` — indices stay i32,
+                 values ship quantized (int8 + per-bucket scale, or
+                 bf16); LOSSY per window, repaired across windows by
+                 error feedback
+      =========  =====================================================
+
+    The lossless minimum always beats sparse_q unless the quantized
+    volume clears the **quantization-error guard**: sparse_q is picked
+    only when ``q_vol * quant_guard <= lossless_vol`` (default 1.25 —
+    never pay quantization error for a marginal byte win)."""
     eff = float(min(rows, capacity))
     if expected_unique is not None:
         eff = min(eff, float(expected_unique))
     sparse_vol = eff * (4.0 + row_bytes)
     dense_vol = float(capacity) * row_bytes
-    return "dense" if sparse_vol * dense_ratio >= dense_vol else "sparse"
+    if sparse_vol * dense_ratio >= dense_vol:
+        return "dense"
+    if quant == "off":
+        return "sparse"
+    value_bytes = max(float(row_bytes) - 4.0, 0.0)
+    bitmap_vol = capacity / 8.0 + eff * value_bytes
+    best, best_vol = "sparse", sparse_vol
+    if bitmap_vol < best_vol:
+        best, best_vol = "bitmap", bitmap_vol
+    if quant_row_bytes is not None:
+        q_vol = eff * (4.0 + float(quant_row_bytes))
+        if q_vol * quant_guard <= best_vol:
+            return "sparse_q"
+    return best
 
 
 class HotColdPartition:

@@ -77,6 +77,23 @@ def base_field(name: str) -> str:
     return name[:-len(HOT_SUFFIX)] if is_hot_field(name) else name
 
 
+#: suffix marking an error-feedback residual plane in a table state
+#: dict: ``"v@ef"`` holds, per TAIL row, the quantization error of v's
+#: gradients not yet applied (drained into the row's next quantized
+#: window push).  Tail-shaped, f32, row-sharded; NOT an access field —
+#: pushes route around it and pulls never see it, it simply rides the
+#: state pytree like the ``@hot`` overlays do.
+EF_SUFFIX = "@ef"
+
+
+def ef_name(field: str) -> str:
+    return field + EF_SUFFIX
+
+
+def is_ef_field(name: str) -> bool:
+    return name.endswith(EF_SUFFIX)
+
+
 class SparseTable:
     def __init__(self, access: AccessMethod, key_index: KeyIndex,
                  mesh: Optional[Mesh] = None, axis: str = MODEL_AXIS,
@@ -145,6 +162,30 @@ class SparseTable:
         return jax.jit(init_all, out_shardings=shardings)(
             jax.random.key(self.seed))
 
+    def ensure_ef(self, grad_fields) -> None:
+        """Arm error-feedback residual planes for ``grad_fields``: one
+        zero-initialized tail-shaped ``<f>@ef`` f32 array per field,
+        row-sharded like the field's tail.  Idempotent — existing
+        planes (e.g. restored from a checkpoint) are left alone.  Hot
+        rows need no residuals: the hybrid backend reconciles them with
+        a dense psum that never quantizes."""
+        sharding = self.row_sharding()
+        cap = self.key_index.capacity
+        for f in grad_fields:
+            name = ef_name(f)
+            if name in self.state:
+                continue
+            fs = self.access.fields[f]
+            z = jnp.zeros((cap, fs.dim), jnp.float32)
+            if sharding is not None:
+                z = jax.device_put(z, sharding)
+            self.state[name] = z
+
+    @property
+    def ef_fields(self):
+        """Names of the armed residual planes (``[] when EF is off``)."""
+        return [f for f in self.state if is_ef_field(f)]
+
     # -- growth ------------------------------------------------------------
     def grow(self, new_capacity_per_shard: Optional[int] = None) -> None:
         """Re-lay-out the table at a larger per-shard capacity (default
@@ -204,6 +245,19 @@ class SparseTable:
         for f, v in self.state.items():
             if is_hot_field(f):
                 new_state[f] = v
+        # EF residual planes re-stride with the tail rows they describe;
+        # new slots start with zero residual (nothing pending by
+        # construction)
+        for f, v in self.state.items():
+            if not is_ef_field(f):
+                continue
+            arr = jnp.zeros((new_cap, v.shape[1]), v.dtype)
+            if len(items):
+                arr = arr.at[jnp.asarray(new_rows)].set(
+                    v[jnp.asarray(old_rows)])
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            new_state[f] = arr
         self.state = new_state
 
     # -- online re-partition ----------------------------------------------
@@ -279,7 +333,17 @@ class SparseTable:
                 out_shardings.update(
                     {hot_name(name): rep for name in fields})
         jitted = jax.jit(remap, out_shardings=out_shardings)
-        self.state = jitted(state_in, p, jax.random.key(self.seed))
+        new_state = jitted(state_in, p, jax.random.key(self.seed))
+        # EF residual planes are tail-indexed and tail rows never
+        # re-stride under repartition, so they carry through unchanged.
+        # A promoted key's residual freezes with its dormant tail slot
+        # (the hot psum path never quantizes) and drains on a later
+        # demotion — one stale bounded-by-a-window quantization error,
+        # within the documented EF envelope.
+        for f, v in self.state.items():
+            if is_ef_field(f):
+                new_state[f] = v
+        self.state = new_state
         return plan
 
     # -- device-level row access ------------------------------------------

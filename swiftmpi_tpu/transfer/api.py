@@ -73,6 +73,95 @@ def grad_row_bytes(grads, with_index: bool = True,
     return total
 
 
+def quant_grad_row_bytes(grads, quant: str,
+                         with_counts: bool = False) -> int:
+    """Encoded wire bytes per pushed row under the ``sparse_q`` format:
+    the int32 index survives, each grad field ships its values quantized
+    — int8 (1 byte/element plus a 4-byte per-(row, field) scale bucket)
+    or bf16 (2 bytes/element, no scale) — and the counts column, when a
+    span family ships one, stays f32.  The sparse_q twin of
+    :func:`grad_row_bytes`, used both by the crossover model and by the
+    ledger's encoded-size booking."""
+    if quant not in ("int8", "bf16"):
+        raise ValueError(f"quant_grad_row_bytes: unknown quant {quant!r}")
+    total = 4
+    for g in grads.values():
+        d = int(jnp.asarray(g).shape[-1])
+        total += d + 4 if quant == "int8" else 2 * d
+    if with_counts:
+        total += 4
+    return total
+
+
+def quantize_dequantize(g, quant: str):
+    """Round-trip one grad block through the ``sparse_q`` value encoding
+    (what the receiver would reconstruct): ``int8`` scales each bucket
+    (last axis) by max|g|/127 and rounds symmetrically; ``bf16`` is a
+    dtype round-trip.  Always returns f32 — the quantization lives in
+    the VALUES; downstream routing/apply is unchanged, which is what
+    keeps the format decision bit-path-exact outside the documented
+    envelope."""
+    g = jnp.asarray(g, jnp.float32)
+    if quant == "bf16":
+        return g.astype(jnp.bfloat16).astype(jnp.float32)
+    if quant != "int8":
+        raise ValueError(f"quantize_dequantize: unknown quant {quant!r}")
+    scale = jnp.max(jnp.abs(g), axis=-1, keepdims=True) * (1.0 / 127.0)
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(jnp.round(g / safe), -127.0, 127.0)
+    return q * jnp.where(scale > 0, scale, 0.0)
+
+
+def ef_quantize_window(state, ded_slots, ded_grads, capacity: int,
+                       quant: str):
+    """Error-feedback quantize of one deduped window: drain each touched
+    slot's residual into its gradient sum, quantize-dequantize, and
+    store the new per-slot quantization error back into the ``<f>@ef``
+    residual planes.  Returns ``(state', grads')`` with the residual
+    planes replaced and the grads dequantized (f32, ready for the
+    unchanged routing/apply path).  Fields without an ``@ef`` plane in
+    ``state`` pass through untouched.
+
+    Written to be correct under the tpu backend's DEVICE-LOCAL dedup,
+    where the same slot can survive as owner in several devices' batch
+    slices: the residual is drained into the globally FIRST occurrence
+    only (representative trick over the full flattened batch), and the
+    write-back is clear-then-scatter-ADD, which commutes under
+    duplicates — the EF identity sum(applied_deq) + residual' ==
+    sum(true grads) + residual holds exactly per slot either way.
+    Plain traced jnp ops on the global arrays (GSPMD routes them), so
+    the same code serves the xla oracle and the tpu/hybrid windows."""
+    from swiftmpi_tpu.parameter.sparse_table import ef_name
+
+    ded_slots = jnp.asarray(ded_slots, jnp.int32)
+    B = ded_slots.shape[0]
+    valid = ded_slots >= 0
+    pos = jnp.arange(B, dtype=jnp.int32)
+    safe = jnp.where(valid, ded_slots, capacity)
+    rep = jnp.full((capacity + 1,), B, jnp.int32).at[safe].min(
+        jnp.where(valid, pos, B), mode="drop")
+    first = valid & (jnp.take(rep, safe) == pos)
+    touched = jnp.zeros((capacity,), jnp.bool_).at[safe].set(
+        True, mode="drop")
+    gather_idx = jnp.clip(safe, 0, capacity - 1)
+    out_state = dict(state)
+    out_grads = dict(ded_grads)
+    for f, g in ded_grads.items():
+        efk = ef_name(f)
+        if efk not in state:
+            continue
+        ef = state[efk]
+        g = jnp.asarray(g, jnp.float32)
+        res = jnp.take(ef, gather_idx, axis=0) * first[:, None]
+        tot = g + res
+        deq = quantize_dequantize(tot, quant) * valid[:, None]
+        err = (tot - deq) * valid[:, None]
+        cleared = ef * (~touched)[:, None]
+        out_state[efk] = cleared.at[safe].add(err, mode="drop")
+        out_grads[f] = deq
+    return out_state, out_grads
+
+
 def pull_row_bytes(state, fields) -> int:
     """Wire bytes per pulled row: int32 request index plus the pulled
     fields' widths at the table's stored dtypes.  The pull-side twin of
@@ -153,58 +242,88 @@ class Transfer:
             st = self.__dict__["_wire_ledger"] = {
                 "wire_bytes": 0, "dispatches": 0,
                 "window_sparse": 0, "window_dense": 0,
+                "window_fmt_dense": 0, "window_fmt_sparse": 0,
+                "window_fmt_q": 0, "window_fmt_bitmap": 0,
                 "coalesced_rows_in": 0, "coalesced_rows_out": 0,
                 "pull_bytes": 0, "pull_rows": 0, "pull_hot_rows": 0,
                 "pending": [], "pull_pending": [],
                 "pull_hot_pending": []}
         return st
 
-    def _obs_inc(self, key: str, n) -> None:
+    #: decision string -> fine-grained format counter.  The legacy
+    #: 2-way counters keep counting (dense -> window_dense, everything
+    #: sparse-shaped -> window_sparse) so pre-4-way dashboards and
+    #: goldens stay valid; the fmt counters record which format WON.
+    _WINDOW_FMT_KEY = {"dense": "window_fmt_dense",
+                       "sparse": "window_fmt_sparse",
+                       "sparse_q": "window_fmt_q",
+                       "bitmap": "window_fmt_bitmap"}
+
+    def _obs_inc(self, key: str, n, **labels) -> None:
         """Mirror a ledger increment into the telemetry registry as
-        ``transfer/<key>{backend=<name>}``.  Telemetry off costs one
-        branch; handles are cached per instance and re-fetched if the
-        global registry was swapped (tests reset it)."""
+        ``transfer/<key>{backend=<name>, **labels}``.  Telemetry off
+        costs one branch; handles are cached per instance and re-fetched
+        if the global registry was swapped (tests reset it)."""
         reg = obs.get_registry()
         if not reg.enabled:
             return
         cache = self.__dict__.get("_obs_cache")
         if cache is None or cache[0] is not reg:
             cache = self.__dict__["_obs_cache"] = (reg, {})
-        c = cache[1].get(key)
+        ck = (key,) + tuple(sorted(labels.items())) if labels else key
+        c = cache[1].get(ck)
         if c is None:
-            c = cache[1][key] = reg.counter("transfer/" + key,
-                                            backend=self.name)
+            c = cache[1][ck] = reg.counter("transfer/" + key,
+                                           backend=self.name, **labels)
         c.inc(n)
 
+    def _count_decision(self, st: dict, decision: str) -> None:
+        """Book one window's wire-format decision: the legacy 2-way
+        counter plus the 4-way ``window_fmt_*`` split, mirrored as a
+        single fmt-labeled telemetry series
+        ``transfer/window_fmt{backend=, fmt=}``."""
+        legacy = "window_dense" if decision == "dense" else "window_sparse"
+        st[legacy] += 1
+        self._obs_inc(legacy, 1)
+        fmt_key = self._WINDOW_FMT_KEY[decision]
+        st[fmt_key] += 1
+        self._obs_inc("window_fmt", 1,
+                      fmt=fmt_key[len("window_fmt_"):])
+
     def _accum_wire(self, row_bytes, rows, ndisp: int = 1,
-                    decision: Optional[str] = None) -> None:
+                    decision: Optional[str] = None,
+                    base_bytes: int = 0) -> None:
         st = self._wire_state()
-        nbytes = int(rows) * int(row_bytes)
+        nbytes = int(rows) * int(row_bytes) + int(base_bytes)
         st["wire_bytes"] += nbytes
         st["dispatches"] += ndisp
         self._obs_inc("wire_bytes", nbytes)
         self._obs_inc("dispatches", ndisp)
         if decision:
-            st["window_" + decision] += 1
-            self._obs_inc("window_" + decision, 1)
+            self._count_decision(st, decision)
 
     def _record_exchange(self, rows, row_bytes: int,
-                         decision: Optional[str] = None) -> None:
+                         decision: Optional[str] = None,
+                         base_bytes: int = 0) -> None:
         """Record one push exchange of ``rows`` (traced or eager count)
-        at ``row_bytes`` per row."""
+        at ``row_bytes`` per row, plus ``base_bytes`` of per-exchange
+        overhead independent of the row count (the bitmap format's
+        capacity/8-byte occupancy mask)."""
         if not getattr(self, "count_traffic", False):
             return
         from functools import partial
-        cb = partial(self._accum_wire, int(row_bytes), decision=decision)
+        cb = partial(self._accum_wire, int(row_bytes), decision=decision,
+                     base_bytes=int(base_bytes))
         if isinstance(rows, jax.core.Tracer):
             jax.debug.callback(cb, rows)
         else:
             st = self._wire_state()
-            st["pending"].append((int(row_bytes), rows, decision))
+            st["pending"].append((int(row_bytes), rows, decision,
+                                  int(base_bytes)))
             if len(st["pending"]) >= 1024:
                 pending, st["pending"] = st["pending"], []
-                for rb, r, d in pending:
-                    self._accum_wire(rb, r, decision=d)
+                for rb, r, d, bb in pending:
+                    self._accum_wire(rb, r, decision=d, base_bytes=bb)
 
     def _accum_pull(self, row_bytes, rows) -> None:
         st = self._wire_state()
@@ -266,8 +385,7 @@ class Transfer:
         self._obs_inc("coalesced_rows_in", int(rows_in))
         self._obs_inc("coalesced_rows_out", int(rows_out))
         if decision:
-            st["window_" + decision] += 1
-            self._obs_inc("window_" + decision, 1)
+            self._count_decision(st, decision)
 
     def _record_coalesce(self, rows_in, rows_out,
                          decision: Optional[str] = None) -> None:
@@ -303,8 +421,8 @@ class Transfer:
         jax.effects_barrier()
         st = self._wire_state()
         pending, st["pending"] = st["pending"], []
-        for rb, r, d in pending:
-            self._accum_wire(rb, r, decision=d)
+        for rb, r, d, bb in pending:
+            self._accum_wire(rb, r, decision=d, base_bytes=bb)
         pulls, st["pull_pending"] = st["pull_pending"], []
         for rb, r in pulls:
             self._accum_pull(rb, r)
@@ -350,6 +468,22 @@ class Transfer:
     #: control plane).  None = use the raw pre-dedup row count.
     window_expected_unique = None
 
+    #: value quantization for the window push's sparse formats:
+    #: ``"off"`` (default — 2-way decision, bit-identical to the
+    #: pre-quantization wire) | ``"int8"`` | ``"bf16"``.  Set from
+    #: ``[cluster] wire_quant`` by the model, which also arms the
+    #: ``@ef`` residual planes; flipping it mid-run requires a step
+    #: rebuild (the decision is baked at trace time).
+    wire_quant = "off"
+
+    #: safety factor pricing the lossy rung: ``sparse_q`` wins only
+    #: when its volume times this still beats the best lossless format
+    #: (key_index.window_wire_format).  Raise toward 2.0 to keep
+    #: quantization off marginal windows, lower toward 1.0 to compress
+    #: aggressively.  Host-side like the dense ratio — takes effect on
+    #: the next decision.
+    wire_quant_guard = 1.25
+
     def _ratio_state(self) -> dict:
         st = self.__dict__.get("_wire_ratios")
         if st is None:
@@ -374,18 +508,25 @@ class Transfer:
 
     def decide_wire_format(self, rows: int, capacity: int,
                            row_bytes: int,
-                           family: Optional[str] = None) -> str:
-        """``"sparse" | "dense"`` for one exchange of ``rows`` candidate
-        rows against a ``capacity``-row dense alternative.  The ONE
-        place backends ask the sparse/dense question — call sites no
-        longer read config/module constants directly, so the control
-        plane can steer the crossover (ratio and expected-unique
-        estimate) without touching compiled code."""
+                           family: Optional[str] = None,
+                           quant_row_bytes: Optional[int] = None) -> str:
+        """``"sparse" | "dense"`` — or, with ``wire_quant`` armed and a
+        ``quant_row_bytes`` estimate supplied, the full 4-way
+        ``"sparse" | "dense" | "bitmap" | "sparse_q"`` — for one
+        exchange of ``rows`` candidate rows against a ``capacity``-row
+        dense alternative.  The ONE place backends ask the wire-format
+        question — call sites no longer read config/module constants
+        directly, so the control plane can steer the crossover (ratio
+        and expected-unique estimate) without touching compiled code."""
         from swiftmpi_tpu.parameter.key_index import window_wire_format
         return window_wire_format(
             int(rows), int(capacity), int(row_bytes),
             dense_ratio=self.wire_dense_ratio(family),
-            expected_unique=self.window_expected_unique)
+            expected_unique=self.window_expected_unique,
+            quant=self.wire_quant if quant_row_bytes is not None
+            else "off",
+            quant_row_bytes=quant_row_bytes,
+            quant_guard=self.wire_quant_guard)
 
     def pull(self, state: TableState, slots, access: AccessMethod,
              fields=None) -> TableState:

@@ -93,6 +93,26 @@ class HybridTransfer(Transfer):
     def window_expected_unique(self, v):
         self.tail.window_expected_unique = v
 
+    @property
+    def wire_quant(self) -> str:
+        """Window value-quantization mode (``off|int8|bf16``); lives on
+        the tail, which makes the wire-format decision and owns the EF
+        drain.  Hot rows are untouched — their dense psum never
+        quantizes."""
+        return self.tail.wire_quant
+
+    @wire_quant.setter
+    def wire_quant(self, v: str):
+        self.tail.wire_quant = v
+
+    @property
+    def wire_quant_guard(self) -> float:
+        return self.tail.wire_quant_guard
+
+    @wire_quant_guard.setter
+    def wire_quant_guard(self, v: float):
+        self.tail.wire_quant_guard = float(v)
+
     def wire_dense_ratio(self, family=None):
         return self.tail.wire_dense_ratio(family)
 
@@ -153,9 +173,10 @@ class HybridTransfer(Transfer):
                "psum_bytes": self._psum_bytes_total,
                "overflow_dropped": t["overflow_dropped"]}
         for k in ("wire_bytes", "dispatches", "window_sparse",
-                  "window_dense", "coalesced_rows_in",
-                  "coalesced_rows_out", "pull_bytes", "pull_rows",
-                  "pull_hot_rows"):
+                  "window_dense", "window_fmt_dense", "window_fmt_sparse",
+                  "window_fmt_q", "window_fmt_bitmap",
+                  "coalesced_rows_in", "coalesced_rows_out",
+                  "pull_bytes", "pull_rows", "pull_hot_rows"):
             out[k] = t.get(k, 0) + w.get(k, 0)
         if self.metrics is not None:
             self.metrics.set("transfer_hot_rows", out["hot_rows"])

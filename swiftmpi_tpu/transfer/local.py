@@ -12,8 +12,11 @@ from __future__ import annotations
 import numpy as np
 
 from swiftmpi_tpu.parameter.access import AccessMethod
+from swiftmpi_tpu.parameter.sparse_table import ef_name
 from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
-                                       pull_row_bytes)
+                                       pull_row_bytes,
+                                       quant_grad_row_bytes,
+                                       quantize_dequantize)
 
 
 class LocalTransfer(Transfer):
@@ -62,7 +65,8 @@ class LocalTransfer(Transfer):
             out[f][uniq] = np.asarray(updated[f])
         return out
 
-    def push_span(self, state, slots, grads, counts, access, mean=False):
+    def push_span(self, state, slots, grads, counts, access, mean=False,
+                  _wire=None):
         """Span-family oracle (stencil wire format): rows carry window-
         overlap gradient SUMS with per-row DATA counts; ``mean`` divides
         each unique key's gradient sum by its summed data count —
@@ -70,8 +74,12 @@ class LocalTransfer(Transfer):
         slots = np.asarray(slots, np.int64)
         counts = np.asarray(counts, np.float32)
         valid = slots >= 0
-        self._record_exchange(int(valid.sum()),
-                              grad_row_bytes(grads, with_counts=True))
+        if _wire is not None:
+            self._record_exchange(int(valid.sum()), _wire[0],
+                                  base_bytes=_wire[1])
+        else:
+            self._record_exchange(int(valid.sum()),
+                                  grad_row_bytes(grads, with_counts=True))
         uniq = np.unique(slots[valid])
         pos = np.searchsorted(uniq, slots[valid])
         csum = np.zeros((len(uniq),), np.float32)
@@ -91,3 +99,70 @@ class LocalTransfer(Transfer):
         for f in updated:
             out[f][uniq] = np.asarray(updated[f])
         return out
+
+    # -- window-coalesced push ---------------------------------------------
+    def push_window(self, state, slots, grads, access, mean=False,
+                    counts=None):
+        """Window-push oracle.  ``wire_quant`` off (default): the base
+        flatten-and-delegate, bit-identical to the legacy wire.  Armed:
+        the same 4-way decision the device backends make, with the
+        dedup / EF drain / quantize pipeline spelled out in numpy — the
+        exactness reference the envelope tests diff against."""
+        slots_a = np.asarray(slots, np.int64)
+        if slots_a.ndim < 2 or slots_a.shape[0] == 1 \
+                or self.wire_quant == "off":
+            return super().push_window(state, slots, grads, access,
+                                       mean=mean, counts=counts)
+        flat = slots_a.reshape(-1)
+        fgrads = {}
+        for f, g in grads.items():
+            g = np.asarray(g, np.float32)
+            fgrads[f] = g.reshape((-1,) + g.shape[2:])
+        fcounts = (np.ones(flat.shape, np.float32) if counts is None
+                   else np.asarray(counts, np.float32).reshape(-1))
+        capacity = next(iter(state.values())).shape[0]
+        row_bytes = grad_row_bytes(fgrads, with_counts=True)
+        qrb = quant_grad_row_bytes(fgrads, self.wire_quant,
+                                   with_counts=True)
+        decision = self.decide_wire_format(
+            len(flat), capacity, row_bytes, family="window",
+            quant_row_bytes=qrb)
+        if decision in ("dense", "sparse"):
+            self._record_coalesce(0, 0, decision=decision)
+            return super().push_window(state, slots, grads, access,
+                                       mean=mean, counts=counts)
+        valid = flat >= 0
+        uniq = np.unique(flat[valid])
+        pos = np.searchsorted(uniq, flat[valid])
+        csum = np.zeros((len(uniq),), np.float32)
+        np.add.at(csum, pos, fcounts[valid])
+        sums = {}
+        for f, g in fgrads.items():
+            acc = np.zeros((len(uniq), g.shape[1]), np.float32)
+            np.add.at(acc, pos, g[valid])
+            sums[f] = acc
+        self._record_coalesce(int(valid.sum()), len(uniq),
+                              decision=decision)
+        if decision == "sparse_q":
+            # drain residual, quantize the SUM, bank the new error —
+            # same order of operations as api.ef_quantize_window
+            state = dict(state)
+            for f in list(sums):
+                efk = ef_name(f)
+                if efk not in state:
+                    continue
+                ef = np.asarray(state[efk], np.float32).copy()
+                tot = sums[f] + ef[uniq]
+                deq = np.asarray(
+                    quantize_dequantize(tot, self.wire_quant),
+                    np.float32)
+                ef[uniq] = tot - deq
+                state[efk] = ef
+                sums[f] = deq
+            wire = (quant_grad_row_bytes(sums, self.wire_quant,
+                                         with_counts=True), 0)
+        else:       # bitmap: same payload at mask-indexed encoding
+            wire = (grad_row_bytes(sums, with_index=False,
+                                   with_counts=True), capacity // 8)
+        return self.push_span(state, uniq, sums, csum, access,
+                              mean=mean, _wire=wire)

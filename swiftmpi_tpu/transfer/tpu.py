@@ -38,8 +38,9 @@ from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
 from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
 from swiftmpi_tpu.ops import (calibration, pallas_gather, pallas_ring,
                               pallas_scatter)
-from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
-                                       pull_row_bytes)
+from swiftmpi_tpu.transfer.api import (Transfer, ef_quantize_window,
+                                       grad_row_bytes, pull_row_bytes,
+                                       quant_grad_row_bytes)
 
 
 def _shard_gather(arr: jax.Array, flat_idx: jax.Array) -> jax.Array:
@@ -315,12 +316,19 @@ class TpuTransfer(Transfer):
         return _pull
 
     # -- push --------------------------------------------------------------
-    def push(self, state, slots, grads, access, mean=False, counts=None):
+    def push(self, state, slots, grads, access, mean=False, counts=None,
+             _wire=None):
         """``counts`` (non-None) marks a position-indexed span family (the
         stencil wire format): per-row contribution counts ship as a
         synthetic width-1 grad field through the same bucket routing, so
         ``mean`` normalization at the owner divides by DATA counts rather
-        than 1-per-request — matching ``XlaTransfer.push_span``."""
+        than 1-per-request — matching ``XlaTransfer.push_span``.
+
+        ``_wire`` (internal, ``(row_bytes, base_bytes)``) overrides the
+        ledger's per-row byte model: the window path books its
+        quantized/bitmap exchanges at ENCODED size while the routed
+        payload itself stays dequantized f32 (the format decision
+        changes bytes, not semantics)."""
         slots = jnp.asarray(slots, jnp.int32)
         with_counts = counts is not None
         if self.count_traffic:
@@ -329,8 +337,11 @@ class TpuTransfer(Transfer):
             # wire ledger: sparse (index, value) rows; counts ride as an
             # extra 4-byte column on span families (computed BEFORE the
             # synthetic field is attached so it isn't double-counted)
-            self._record_exchange(
-                rows, grad_row_bytes(grads, with_counts=with_counts))
+            if _wire is not None:
+                self._record_exchange(rows, _wire[0], base_bytes=_wire[1])
+            else:
+                self._record_exchange(
+                    rows, grad_row_bytes(grads, with_counts=with_counts))
         if with_counts:
             grads = dict(grads)
             grads["__counts__"] = jnp.asarray(
@@ -400,9 +411,16 @@ class TpuTransfer(Transfer):
         # the crossover is asked through the base-class decision hook
         # (seed behavior == window_wire_format at dense_ratio 2.0 with
         # this instance's expected-unique hint) so the control plane can
-        # retune it per family without touching this call site
+        # retune it per family without touching this call site; with
+        # wire_quant armed the quantized-row estimate widens it to the
+        # 4-way dense/sparse/bitmap/sparse_q decision
+        quant = self.wire_quant
+        qrb = (quant_grad_row_bytes(fgrads, quant,
+                                    with_counts=with_counts)
+               if quant != "off" else None)
         decision = self.decide_wire_format(
-            int(flat.shape[0]), capacity, row_bytes, family="window")
+            int(flat.shape[0]), capacity, row_bytes, family="window",
+            quant_row_bytes=qrb)
         if decision == "dense":
             return self._push_window_dense(state, flat, fgrads, access,
                                            mean, fcounts)
@@ -414,20 +432,37 @@ class TpuTransfer(Transfer):
                 # log it with zero row deltas; the traced zero keeps the
                 # callback firing once per compiled execution
                 zero = jnp.sum(flat >= 0) * 0
-                self._record_coalesce(zero, zero, decision="sparse")
+                self._record_coalesce(zero, zero, decision=decision)
         else:
             ded_slots, ded_grads, ded_counts = self._window_dedup(
                 flat, fgrads, fcounts, capacity)
             if self.count_traffic:
                 self._record_coalesce(jnp.sum(flat >= 0),
                                       jnp.sum(ded_slots >= 0),
-                                      decision="sparse")
+                                      decision=decision)
         # mean needs the original contribution multiplicities (dedup
         # collapsed them into ded_counts); plain sums need no counts at
         # all — pre-summing commutes with the owner-side segment sum
         need_counts = mean or with_counts
+        wire = None
+        if decision == "sparse_q":
+            # drain EF residuals into the deduped sums, quantize the
+            # values (the routed payload stays dequantized f32), bank
+            # the new per-slot error; book the exchange at encoded size
+            state, ded_grads = ef_quantize_window(
+                state, ded_slots, ded_grads, capacity, quant)
+            wire = (quant_grad_row_bytes(ded_grads, quant,
+                                         with_counts=need_counts), 0)
+        elif decision == "bitmap":
+            # same deduped sparse payload and routing — only the wire
+            # REPRESENTATION differs: a capacity/8-byte occupancy mask
+            # replaces the per-row index words, values ship packed
+            wire = (grad_row_bytes(ded_grads, with_index=False,
+                                   with_counts=need_counts),
+                    capacity // 8)
         return self.push(state, ded_slots, ded_grads, access, mean=mean,
-                         counts=ded_counts if need_counts else None)
+                         counts=ded_counts if need_counts else None,
+                         _wire=wire)
 
     def _window_dedup(self, flat, fgrads, fcounts, capacity):
         """Device-local positional dedup of the flattened window: each

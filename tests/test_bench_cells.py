@@ -175,3 +175,24 @@ def test_tfm_odd_head_dim_fails_fast(tiny_shapes, monkeypatch):
     monkeypatch.setenv("BENCH_TFM_LAYERS", "1")
     out = bench._bench_tfm(jax.devices()[0], timed_calls=1)
     assert out["tokens_per_sec"] > 0
+
+
+def test_scale_qwire_cell_tiny(tiny_shapes, monkeypatch):
+    """BENCH_ONLY=scale_qwire's cell: the window shape with [cluster]
+    wire_quant armed at (shrunk) 1M-vocab scale — self-describes the
+    quant mode, carries the 4-way decision-mix counters the budget
+    gate's sanity floor reads, and books a finite encoded wire ledger."""
+    monkeypatch.setattr(bench, "W2V_1M_VOCAB", 5000)
+    dev = jax.devices()[0]
+    out = bench._bench_w2v_1m(dev, timed_calls=1, hybrid=True,
+                              window_steps=2, wire_quant="int8")
+    assert out["wire_quant"] == "int8"
+    assert out["push_window"] == 2
+    assert out["words_per_sec"] > 0
+    fmts = [out[f"window_fmt_{f}"]
+            for f in ("dense", "sparse", "q", "bitmap")]
+    assert all(v >= 0 for v in fmts) and sum(fmts) > 0
+    assert out["wire_bytes_per_step"] > 0
+    # (quant-off self-description is pinned cheaply at unit level by
+    # test_window_push.py::test_wire_quant_off_bit_identity_all_backends
+    # — a second tiny bench build here would double the cell's cost)

@@ -364,11 +364,14 @@ def test_chaos_hang_watchdog_recovers(tmp_path, devices8):
     corpus = synthetic_corpus(20, vocab_size=30, length=10, seed=9)
     m = _model()
     m.build(corpus)
-    plan = FaultPlan().hang_at_step(2, seconds=3.0)
+    # deadline sized 2x above a normal epoch's wall on a slow CPU host
+    # (spurious trips burn the restart budget before the fault fires)
+    # and 2x below the injected stall, so only the fault trips it
+    plan = FaultPlan().hang_at_step(2, seconds=4.0)
     losses = train_with_resume(
         m, corpus, niters=4, checkpoint_path=str(tmp_path / "ck"),
         checkpoint_every=1, max_restarts=2, retain=2, fault_plan=plan,
-        hang_timeout_s=1.0, probe_timeout_s=30.0, batch_size=64)
+        hang_timeout_s=2.0, probe_timeout_s=30.0, batch_size=64)
     # hang at step 2 tripped the watchdog; the cancelled worker finishes
     # its in-flight epoch before acknowledging at the next bus event, so
     # the retry resumes at iter 2 or 3 -> 1-2 iters rerun, never all 4

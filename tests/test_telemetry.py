@@ -511,3 +511,80 @@ def test_configure_off_by_default(tmp_path):
     cfg = ConfigParser().update({"worker": {"minibatch": 64}})
     assert obs.configure(cfg) is None
     assert not obs.get_registry().enabled
+
+
+# -- 4-way wire-format decision series in the run analyzer -----------------
+
+def _fmt_doc():
+    """Synthetic analyzer doc: two steps whose counters carry the
+    labeled transfer/window_fmt series next to the legacy 2-way
+    counters (sparse_q windows bump BOTH, by design)."""
+    steps = [
+        {"kind": "step", "step": 1, "steps": 1, "counters": {
+            "transfer/window_fmt{backend=tpu,fmt=q}": 2.0,
+            "transfer/window_sparse{backend=tpu}": 2.0,
+            "transfer/wire_bytes{backend=tpu}": 700.0}},
+        {"kind": "step", "step": 2, "steps": 1, "counters": {
+            "transfer/window_fmt{backend=tpu,fmt=bitmap}": 1.0,
+            "transfer/window_sparse{backend=tpu}": 1.0,
+            "transfer/wire_bytes{backend=tpu}": 300.0}},
+    ]
+    return {"meta": {"run": "fmtrun"}, "steps": steps, "events": [],
+            "summary": None}
+
+
+def test_traffic_summary_folds_window_fmt_labels():
+    """The labeled decision counter must fold into window_fmt_<fmt>
+    keys per backend — four series, four keys, no dict collision."""
+    _scripts_on_path()
+    import telemetry_report
+    t = telemetry_report.traffic_summary(_fmt_doc())
+    tpu = t["transfer"]["tpu"]
+    assert tpu["window_fmt_q"] == 2.0
+    assert tpu["window_fmt_bitmap"] == 1.0
+    assert "window_fmt" not in tpu          # no overwritten shared key
+    assert tpu["window_sparse"] == 3.0      # legacy series intact
+
+
+def test_wire_timeline_prefers_fmt_labels():
+    """Steps carrying the fmt-labeled series are labeled by the actual
+    4-way decision, not 'mixed' with the coarser legacy counter."""
+    _scripts_on_path()
+    import telemetry_report
+    runs = telemetry_report.wire_timeline(_fmt_doc())
+    assert [r["decision"] for r in runs] == ["q", "bitmap"]
+
+
+def test_budget_gate_decision_mix_floor():
+    """A cell claiming wire_quant is armed but whose decision mix never
+    picked an encoded format must fail the gate (exit 1); a mix with
+    any q/bitmap share passes."""
+    _scripts_on_path()
+    import check_traffic_budget as ctb
+    dead = {"w2v_1m_qwire": {"wire_quant": "int8", "window_fmt_q": 0,
+                             "window_fmt_sparse": 40.0}}
+    assert ctb.decision_mix_violations(dead) \
+        == [("w2v_1m_qwire", "int8", 40.0)]
+    live = {"w2v_1m_qwire": {"wire_quant": "int8", "window_fmt_q": 30.0,
+                             "window_fmt_sparse": 10.0}}
+    assert ctb.decision_mix_violations(live) == []
+    off = {"w2v_1m_window": {"window_fmt_sparse": 40.0}}
+    assert ctb.decision_mix_violations(off) == []
+
+
+def test_budget_gate_aggregates_fmt_cells(tmp_path):
+    """load_telemetry_cells surfaces the folded window_fmt_* totals as
+    cell detail so the decision-mix floor sees live-run JSONL too."""
+    _scripts_on_path()
+    import check_traffic_budget as ctb
+    path = str(tmp_path / "t.jsonl")
+    doc = _fmt_doc()
+    with open(path, "w") as f:
+        f.write(json.dumps({"kind": "meta", "schema": obs.SCHEMA,
+                            "run": "fmtrun"}) + "\n")
+        for rec in doc["steps"]:
+            f.write(json.dumps(rec) + "\n")
+    cells = ctb.load_cells(path)
+    assert cells["fmtrun"]["window_fmt_q"] == 2.0
+    assert cells["fmtrun"]["window_fmt_bitmap"] == 1.0
+    assert cells["fmtrun"]["window_sparse"] == 3.0

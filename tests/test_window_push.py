@@ -26,7 +26,10 @@ from swiftmpi_tpu.parameter import KeyIndex, SparseTable, w2v_access
 from swiftmpi_tpu.parameter.access import lr_access
 from swiftmpi_tpu.parameter.key_index import (HotColdPartition,
                                               window_wire_format)
-from swiftmpi_tpu.parameter.sparse_table import hot_name
+from swiftmpi_tpu.parameter.sparse_table import ef_name, hot_name
+from swiftmpi_tpu.transfer.api import (ef_quantize_window,
+                                       quant_grad_row_bytes,
+                                       quantize_dequantize)
 from swiftmpi_tpu.transfer.hybrid import HybridTransfer
 from swiftmpi_tpu.transfer.local import LocalTransfer
 from swiftmpi_tpu.transfer.tpu import TpuTransfer
@@ -387,3 +390,432 @@ def test_w2v_push_window_rejects_dense_logits(devices8):
                   word2vec={"dense_logits": "1"})
     with pytest.raises(ValueError, match="cannot coalesce dense"):
         m.train(corpus, niters=1, batch_size=64)
+
+
+# -- 4-way wire compression (sparse_q / bitmap + error feedback) ----------
+
+def distinct_window(ki, rng, W=2, B=64):
+    """All-distinct keys (plus padding): the tpu backend's device-LOCAL
+    dedup then equals the global dedup, so every quantized sum is
+    quantized exactly once and the device paths are tightly comparable
+    to the numpy oracle (no summation-order noise under the per-bucket
+    int8 scales)."""
+    keys = rng.choice(5000, size=W * B, replace=False).astype(np.uint64)
+    slots = np.asarray(ki.lookup(keys), np.int32).reshape(W, B)
+    slots[:, ::9] = -1
+    grads = {f: rng.normal(size=(W, B, DIM)).astype(np.float32)
+             for f in ("h", "v")}
+    counts = rng.integers(1, 4, size=(W, B)).astype(np.float32)
+    counts[slots < 0] = 0
+    return slots, grads, counts
+
+
+def test_window_wire_format_4way_goldens():
+    """Byte-model goldens for the calibrated 4-way crossover.  The
+    dense gate is the OLD 2-way rule checked first verbatim, so
+    arming quantization can never move the sparse/dense boundary."""
+    cap = 1024
+    # quant="off" reproduces the 2-way decision bit-identically, with
+    # or without a (stale) quantized-row estimate supplied
+    for rows in (8, 100, 4 * 16_384):
+        for eu in (None, 16.0, 400.0):
+            want = window_wire_format(rows, cap, 68, expected_unique=eu)
+            got = window_wire_format(rows, cap, 68, expected_unique=eu,
+                                     quant="off", quant_row_bytes=40)
+            assert got == want, (rows, eu)
+    # a dense window stays dense no matter how cheap quantized rows look
+    assert window_wire_format(4 * 16_384, cap, 68) == "dense"
+    assert window_wire_format(4 * 16_384, cap, 68, quant="int8",
+                              quant_row_bytes=1) == "dense"
+    # d=8 two-field geometry (lossless row 72B, int8 row 32B): the
+    # quantized volume beats both lossless encodings by more than the
+    # 1.25x guard -> sparse_q; without the estimate the capacity/8
+    # occupancy mask still beats per-row index words at this density
+    assert window_wire_format(256, cap, 72, quant="int8",
+                              quant_row_bytes=32) == "sparse_q"
+    assert window_wire_format(256, cap, 72, quant="int8",
+                              quant_row_bytes=None) == "bitmap"
+    # a stricter guard demands a bigger win: fall back to lossless bitmap
+    assert window_wire_format(256, cap, 72, quant="int8",
+                              quant_row_bytes=32,
+                              quant_guard=2.5) == "bitmap"
+    # d=1 geometry: the 4-byte per-bucket scale word makes int8 rows
+    # BIGGER than bitmap rows -> bitmap wins even with quant armed
+    assert window_wire_format(256, cap, 12, quant="int8",
+                              quant_row_bytes=13) == "bitmap"
+    # low density: the mask amortizes over too few rows, and bf16's
+    # 10-byte row cannot beat the 12-byte lossless row by the guard
+    assert window_wire_format(8, cap, 12, quant="bf16",
+                              quant_row_bytes=10) == "sparse"
+
+
+def test_ef_quantize_window_duplicate_owner_identity():
+    """tpu's window dedup is device-LOCAL: the same slot can survive as
+    owner in several devices' batch slices.  The EF drain must stay
+    exact anyway — the prior residual drains into the globally FIRST
+    occurrence only, and the error write-back scatter-ADDs (commutes
+    under duplicates)."""
+    cap, d = 16, 4
+    rng = np.random.default_rng(6)
+    ef0 = (rng.normal(size=(cap, d)) * 0.01).astype(np.float32)
+    state = {"h": jnp.zeros((cap, d), jnp.float32),
+             "h@ef": jnp.asarray(ef0)}
+    ded_slots = jnp.asarray(np.array([3, 3, -1, 5], np.int32))
+    g = rng.normal(size=(4, d)).astype(np.float32)
+    g[2] = 0.0
+    out_state, out_grads = ef_quantize_window(
+        state, ded_slots, {"h": jnp.asarray(g)}, cap, "int8")
+    deq = np.asarray(out_grads["h"])
+    ef1 = np.asarray(out_state["h@ef"])
+    assert np.all(deq[2] == 0)                  # padding ships zeros
+    # per-slot EF identity, duplicate owners and all:
+    #   sum(applied deq) + residual' == sum(true grads) + residual
+    for s, rows in ((3, [0, 1]), (5, [3])):
+        np.testing.assert_allclose(
+            deq[rows].sum(0) + ef1[s], g[rows].sum(0) + ef0[s],
+            rtol=1e-5, atol=1e-6, err_msg=s)
+    untouched = np.setdiff1d(np.arange(cap), [3, 5])
+    assert np.array_equal(ef1[untouched], ef0[untouched])
+    # the residual is quantization ERROR, not a copy: bounded by one
+    # int8 step of each contributing row's bucket scale
+    tot0 = g[0] + ef0[3]
+    bound = (np.abs(tot0).max() + np.abs(g[1]).max()) / 127.0
+    assert np.abs(ef1[3]).max() <= bound + 1e-7
+
+
+def test_ef_drain_exactness_numpy_oracle():
+    """Local sparse_q pipeline vs a from-scratch numpy simulation over
+    three windows: the banked residual planes are bit-equal to the
+    simulation, the routed grads are exactly the independently
+    quantized sums, the wire ledger books the ENCODED size, and the EF
+    telescope sum(applied) + residual_final == sum(true grads)
+    closes."""
+    table, ki, access = make_table()            # capacity 1024
+    table.ensure_ef(("h", "v"))
+    state = {f: np.asarray(v).copy() for f, v in table.state.items()}
+    t = LocalTransfer()
+    t.wire_quant = "int8"
+    t.count_traffic = True
+    rng = np.random.default_rng(7)
+    cap = ki.capacity
+    ef_sim = {f: np.zeros((cap, DIM), np.float32) for f in ("h", "v")}
+    true_tot = {f: np.zeros((cap, DIM), np.float32) for f in ("h", "v")}
+    applied = {f: np.zeros((cap, DIM), np.float32) for f in ("h", "v")}
+    want_bytes = 0
+    for _ in range(3):
+        slots, grads, _ = window_batch(ki, rng, W=2, B=32)
+        prev = {f: v.copy() for f, v in state.items()}
+        state = {f: np.asarray(v) for f, v in t.push_window(
+            state, slots, grads, access, mean=False).items()}
+        # -- independent simulation of the same window ------------------
+        flat = slots.reshape(-1)
+        valid = flat >= 0
+        uniq = np.unique(flat[valid])
+        pos = np.searchsorted(uniq, flat[valid])
+        deq_sums = {}
+        for f in ("h", "v"):
+            sums = np.zeros((len(uniq), DIM), np.float32)
+            np.add.at(sums, pos, grads[f].reshape(-1, DIM)[valid])
+            true_tot[f][uniq] += sums
+            tot = sums + ef_sim[f][uniq]
+            deq = np.asarray(quantize_dequantize(tot, "int8"),
+                             np.float32)
+            ef_sim[f][uniq] = tot - deq
+            applied[f][uniq] += deq
+            deq_sums[f] = deq
+            # the pipeline banked exactly the simulated residual
+            assert np.array_equal(state[ef_name(f)], ef_sim[f]), f
+        # and the table update is exactly push_span of the simulated
+        # dequantized sums at the deduped slots
+        csum = np.zeros((len(uniq),), np.float32)
+        np.add.at(csum, pos, np.ones(int(valid.sum()), np.float32))
+        want = LocalTransfer().push_span(prev, uniq, deq_sums, csum,
+                                         access, mean=False)
+        for f in access.fields:
+            assert np.array_equal(state[f], np.asarray(want[f])), f
+        want_bytes += len(uniq) * quant_grad_row_bytes(
+            deq_sums, "int8", with_counts=True)
+    # residuals are live (quantization actually erred somewhere) and the
+    # telescope closes: nothing was lost, nothing double-applied
+    assert any(ef_sim[f].any() for f in ("h", "v"))
+    for f in ("h", "v"):
+        np.testing.assert_allclose(applied[f] + ef_sim[f], true_tot[f],
+                                   rtol=1e-6, atol=1e-5, err_msg=f)
+    tr = t.traffic()
+    assert tr["window_fmt_q"] == 3 and tr["window_sparse"] == 3, tr
+    assert tr["window_fmt_bitmap"] == 0 and tr["window_dense"] == 0, tr
+    assert tr["wire_bytes"] == want_bytes, (tr, want_bytes)
+
+
+@pytest.mark.parametrize("name", ["xla", "tpu", "hybrid"])
+def test_sparse_q_window_matches_numpy_oracle(name, devices8):
+    """Device sparse_q windows against the armed local oracle: same
+    quantized values applied, same residuals banked, exchange booked at
+    encoded size on every backend."""
+    mesh = ps_mesh()
+    table, ki, access = make_table(mesh)
+    table.ensure_ef(("h", "v"))
+    rng = np.random.default_rng(13)
+    slots, grads, counts = distinct_window(ki, rng)
+    state_np = {f: np.asarray(v).copy() for f, v in table.state.items()}
+    lo = LocalTransfer()
+    lo.wire_quant = "int8"
+    want = lo.push_window({f: v.copy() for f, v in state_np.items()},
+                          slots, grads, access, mean=True, counts=counts)
+    t = backend(name, mesh)
+    t.wire_quant = "int8"
+    t.count_traffic = True
+    state = table.state if name in ("tpu", "hybrid") else {
+        f: jnp.asarray(v) for f, v in state_np.items()}
+    got = t.push_window(state, slots, grads, access, mean=True,
+                        counts=counts)
+    for f in list(access.fields) + [ef_name("h"), ef_name("v")]:
+        np.testing.assert_allclose(np.asarray(got[f]),
+                                   np.asarray(want[f]), rtol=1e-5,
+                                   atol=1e-6, err_msg=(name, f))
+    tr = t.traffic()
+    assert tr["window_fmt_q"] == 1 and tr["window_fmt_bitmap"] == 0, tr
+    assert tr["window_sparse"] == 1 and tr["window_dense"] == 0, tr
+    # booked at ENCODED size: unique rows x int8 row bytes — less than
+    # half the lossless sparse volume at d=8 x 2 fields
+    nvalid = int((slots >= 0).sum())
+    qrb = quant_grad_row_bytes(
+        {f: g.reshape(-1, DIM) for f, g in grads.items()}, "int8",
+        with_counts=True)
+    assert tr["wire_bytes"] == nvalid * qrb, (tr, nvalid, qrb)
+    assert 2 * tr["wire_bytes"] < nvalid * (4 + 4 * 2 * DIM + 4)
+
+
+def test_sparse_q_xla_duplicate_window_matches_oracle(devices8):
+    """Duplicates across and within steps: xla's global representative
+    dedup must agree with the numpy oracle — sums folded once, residual
+    drained once, then quantized once."""
+    table, ki, access = make_table()
+    table.ensure_ef(("h", "v"))
+    rng = np.random.default_rng(14)
+    slots, grads, counts = window_batch(ki, rng, W=2, B=64)
+    state_np = {f: np.asarray(v).copy() for f, v in table.state.items()}
+    lo = LocalTransfer()
+    lo.wire_quant = "int8"
+    want = lo.push_window({f: v.copy() for f, v in state_np.items()},
+                          slots, grads, access, mean=True, counts=counts)
+    x = XlaTransfer()
+    x.wire_quant = "int8"
+    got = x.push_window({f: jnp.asarray(v) for f, v in state_np.items()},
+                        slots, grads, access, mean=True, counts=counts)
+    for f in list(access.fields) + [ef_name("h"), ef_name("v")]:
+        np.testing.assert_allclose(np.asarray(got[f]),
+                                   np.asarray(want[f]), rtol=1e-5,
+                                   atol=1e-5, err_msg=f)
+
+
+@pytest.mark.parametrize("name", ["local", "xla", "tpu"])
+def test_bitmap_window_parity_and_byte_booking(name, devices8):
+    """d=1 geometry: the 4-byte per-bucket scale word makes int8 rows
+    BIGGER than bitmap rows, so the decision lands on bitmap — whose
+    payload is the plain lossless sums (only the BOOKED wire
+    representation changes: capacity/8 mask + packed rows, no index
+    words)."""
+    mesh = ps_mesh()
+    access = lr_access(0.1)
+    ki = KeyIndex(num_shards=8, capacity_per_shard=128)   # capacity 1024
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS)
+    rng = np.random.default_rng(15)
+    keys = rng.choice(4000, size=256, replace=False).astype(np.uint64)
+    slots = np.asarray(ki.lookup(keys), np.int32).reshape(2, 128)
+    grads = {"val": rng.normal(size=(2, 128, 1)).astype(np.float32)}
+    state_np = {f: np.asarray(v).copy() for f, v in table.state.items()}
+    want = LocalTransfer().push_window(
+        {f: v.copy() for f, v in state_np.items()}, slots, grads,
+        access, mean=True)
+    t = backend(name, mesh)
+    t.wire_quant = "int8"
+    t.count_traffic = True
+    state = table.state if name == "tpu" else {
+        f: jnp.asarray(v) for f, v in state_np.items()}
+    got = t.push_window(state, slots, grads, access, mean=True)
+    for f in access.fields:
+        np.testing.assert_allclose(np.asarray(got[f]),
+                                   np.asarray(want[f]), rtol=1e-5,
+                                   atol=1e-6, err_msg=(name, f))
+    tr = t.traffic()
+    assert tr["window_fmt_bitmap"] == 1 and tr["window_fmt_q"] == 0, tr
+    assert tr["wire_bytes"] == 256 * 8 + 1024 // 8, tr
+
+
+@pytest.mark.parametrize("name", ["local", "xla", "tpu", "hybrid"])
+def test_wire_quant_off_bit_identity_all_backends(name, devices8):
+    """``wire_quant: off`` must be STRUCTURALLY the pre-quantization
+    path: bit-identical results even with @ef planes parked in the
+    state, residuals untouched, no q/bitmap decisions booked."""
+    mesh = ps_mesh()
+    table, ki, access = make_table(mesh)
+    rng = np.random.default_rng(16)
+    slots, grads, _ = window_batch(ki, rng, W=2, B=64)
+    plain = dict(table.state)               # snapshot WITHOUT EF planes
+    table.ensure_ef(("h", "v"))
+    armed = table.state                     # same arrays + @ef zeros
+
+    def dev(st):
+        return st if name in ("tpu", "hybrid") else {
+            f: jnp.asarray(np.asarray(v)) for f, v in st.items()}
+
+    base_t = backend(name, mesh)
+    want = base_t.push_window(dev(plain), slots, grads, access,
+                              mean=True)
+    t = backend(name, mesh)
+    t.wire_quant = "off"                    # the explicit escape hatch
+    t.count_traffic = True
+    got = t.push_window(dev(armed), slots, grads, access, mean=True)
+    for f in access.fields:
+        assert np.array_equal(np.asarray(got[f]), np.asarray(want[f])), \
+            (name, f)
+    for f in ("h", "v"):
+        assert np.array_equal(np.asarray(got[ef_name(f)]),
+                              np.asarray(armed[ef_name(f)])), (name, f)
+    tr = t.traffic()
+    assert tr["window_fmt_q"] == 0 and tr["window_fmt_bitmap"] == 0, tr
+    if name in ("tpu", "hybrid"):
+        # the decision-making backends book the 2-way split; the base
+        # flatten path (local/xla off) never did and still must not
+        assert tr["window_fmt_dense"] + tr["window_fmt_sparse"] == 1, tr
+    else:
+        assert tr["window_fmt_dense"] + tr["window_fmt_sparse"] == 0, tr
+
+
+def test_window_fmt_telemetry_mirror():
+    """Satellite: the 4-way decision counters mirror into the registry
+    as ONE fmt-labeled series ``transfer/window_fmt{backend=, fmt=}``
+    next to the legacy 2-way mirrors."""
+    from swiftmpi_tpu import obs
+
+    table, ki, access = make_table()
+    table.ensure_ef(("h", "v"))
+    state = {f: np.asarray(v).copy() for f, v in table.state.items()}
+    obs.set_enabled(True)
+    try:
+        t = LocalTransfer()
+        t.wire_quant = "int8"
+        t.count_traffic = True
+        rng = np.random.default_rng(17)
+        slots, grads, _ = window_batch(ki, rng, W=2, B=32)
+        t.push_window(state, slots, grads, access, mean=False)
+        reg = obs.get_registry()
+        assert reg.counter("transfer/window_fmt", backend="local",
+                           fmt="q").value == 1
+        assert reg.counter("transfer/window_sparse",
+                           backend="local").value == 1
+    finally:
+        obs.set_enabled(False)
+
+
+def test_w2v_sparse_q_trajectory_parity(devices8):
+    """[cluster] wire_quant: int8 through the fused windowed scan tracks
+    the f32 wire within the documented envelope |a-b| <= 1e-5 + 1e-3|b|
+    over a 3-epoch run, with the decision mix showing sparse_q engaged
+    and every booked byte at the encoded (28B/row) size."""
+    from swiftmpi_tpu.data.text import synthetic_corpus
+
+    corpus = synthetic_corpus(160, vocab_size=300, length=12, seed=21)
+    kw = dict(cluster={"transfer": "xla", "push_window": 2},
+              worker={"inner_steps": 4, "minibatch": 64})
+    base = w2v_model(**kw)
+    base.transfer.count_traffic = True
+    base_losses = base.train(corpus, niters=3, batch_size=64)
+    qkw = dict(kw, cluster=dict(kw["cluster"], wire_quant="int8"))
+    q = w2v_model(**qkw)
+    q.transfer.count_traffic = True
+    q_losses = q.train(corpus, niters=3, batch_size=64)
+    assert q_losses[-1] < q_losses[0]
+    for a, b in zip(q_losses, base_losses):
+        assert abs(a - b) <= 1e-5 + 1e-3 * abs(b), (q_losses,
+                                                    base_losses)
+    tr_q, tr_b = q.transfer.traffic(), base.transfer.traffic()
+    assert tr_q["window_fmt_q"] > 0, tr_q
+    assert tr_b["window_fmt_q"] == 0 and tr_b["window_fmt_bitmap"] == 0
+    # every window went sparse_q and was booked at ENCODED size: the
+    # int8 row (4B index + 16+4B values/scale + 4B counts = 28B) against
+    # the 72B lossless row — >2x fewer wire bytes for the same routed
+    # rows.  (Cross-run wire_bytes totals are not comparable on xla: its
+    # per-step dense push books eagerly per trace, a pre-existing
+    # ledger quirk outside the window path.)
+    rows_out = tr_q["coalesced_rows_out"]
+    assert rows_out > 0 and tr_q["wire_bytes"] == rows_out * 28, tr_q
+    assert 2 * tr_q["wire_bytes"] < rows_out * 72, tr_q
+
+
+def test_checkpoint_roundtrip_carries_ef_planes(tmp_path, devices8):
+    """Satellite: @ef residual planes ride the binary checkpoint both
+    ways, and an EF arming mismatch between checkpoint and table is a
+    LOUD error in either direction — silent drops of pending residual
+    mass are exactly the failure the telescope identity forbids."""
+    from swiftmpi_tpu.io.checkpoint import load_checkpoint, save_checkpoint
+
+    table, ki, access = make_table()
+    table.ensure_ef(("h", "v"))
+    rng = np.random.default_rng(18)
+    res = (rng.normal(size=(ki.capacity, DIM)) * 1e-3).astype(np.float32)
+    state = dict(table.state)
+    state[ef_name("h")] = jnp.asarray(res)
+    table.state = state
+    path = str(tmp_path / "ck")
+    save_checkpoint(table, path, extra={"iter": np.int64(1)})
+
+    back, _, _ = make_table(seed=1)
+    back.ensure_ef(("h", "v"))
+    load_checkpoint(back, path)
+    np.testing.assert_array_equal(np.asarray(back.state[ef_name("h")]),
+                                  res)
+    assert not np.asarray(back.state[ef_name("v")]).any()
+
+    # EF checkpoint into a quant-off table: pending residuals would
+    # silently vanish -> refuse loudly
+    plain, _, _ = make_table(seed=2)
+    with pytest.raises(ValueError, match="wire_quant"):
+        load_checkpoint(plain, path)
+    # mirror image: non-EF checkpoint into an EF-armed table
+    p2 = str(tmp_path / "ck2")
+    save_checkpoint(make_table(seed=3)[0], p2)
+    armed, _, _ = make_table(seed=4)
+    armed.ensure_ef(("h",))
+    with pytest.raises(ValueError, match="wire_quant"):
+        load_checkpoint(armed, p2)
+
+
+def test_chaos_resume_mid_window_preserves_ef(tmp_path, devices8):
+    """Satellite chaos scenario: a crash mid-stream with wire_quant
+    armed restarts from the checkpoint WITH its @ef planes (no silent
+    zero-reseed) and trains on to finite losses."""
+    from swiftmpi_tpu.data.text import CBOWBatcher, synthetic_corpus
+    from swiftmpi_tpu.io.checkpoint import npz_path
+    from swiftmpi_tpu.io.resilience import train_with_resume
+
+    corpus = synthetic_corpus(60, vocab_size=200, length=12, seed=22)
+    m = w2v_model(cluster={"transfer": "xla", "push_window": 2,
+                           "wire_quant": "int8"},
+                  worker={"inner_steps": 4, "minibatch": 64})
+    m.build(corpus)
+    assert sorted(m.table.ef_fields) == ["h@ef", "v@ef"]
+
+    class Flaky:
+        def __init__(self, inner):
+            self.inner = inner
+            self.epoch_i = 0
+
+        def epoch(self, batch_size):
+            self.epoch_i += 1
+            for i, b in enumerate(self.inner.epoch(batch_size)):
+                if self.epoch_i == 2 and i == 1:
+                    raise RuntimeError("injected crash mid-stream")
+                yield b
+
+    flaky = Flaky(CBOWBatcher(corpus, m.vocab, m.window))
+    ckpt = str(tmp_path / "qck")
+    losses = train_with_resume(m, niters=3, checkpoint_path=ckpt,
+                               checkpoint_every=1, max_restarts=2,
+                               batcher=flaky, batch_size=64)
+    # crash in epoch 2, checkpoint at iter 1 restored, 2 iters rerun
+    assert len(losses) == 2 and np.isfinite(losses).all()
+    with np.load(npz_path(ckpt)) as z:
+        assert "field__h@ef" in z.files and "field__v@ef" in z.files
+    assert sorted(m.table.ef_fields) == ["h@ef", "v@ef"]
