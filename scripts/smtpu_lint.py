@@ -1,0 +1,15 @@
+#!/usr/bin/env python
+"""smtpu-lint entry point as a script (same CLI as
+``python -m swiftmpi_tpu.analysis.lint``); keeps the gate runnable
+from a checkout without installing the package."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from swiftmpi_tpu.analysis.lint import main  # noqa: E402
+
+if __name__ == "__main__":
+    sys.exit(main())

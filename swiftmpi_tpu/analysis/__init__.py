@@ -1,0 +1,35 @@
+"""smtpu-lint: repo-native static invariant checker (ISSUE 11).
+
+The repo's host-side concurrency invariants — donated buffers never
+escape their dispatch, serve readers never launch device programs, the
+pipeline producer owns no RNG, traffic ledgers never reset, telemetry
+series match the declared catalog, lock-guarded fields mutate under
+their lock, config knobs are documented — were each discovered as a
+real bug (see docs/ARCHITECTURE.md "Invariant catalog").  This package
+encodes them as AST lint rules so refactors that churn the carrying
+files (multi-host scale-out, the TrafficPlan compiler) get machine
+checking instead of archaeology.
+
+Entry points:
+
+* ``python -m swiftmpi_tpu.analysis.lint`` — the gate run by
+  scripts/run_tier1.sh (text or ``--format json``, rc 1 on new
+  findings).
+* ``scripts/smtpu_lint.py`` — the same CLI as a script.
+* :func:`run_lint` — programmatic API (tests, tooling).
+
+Suppression: ``# smtpu-lint: disable=RULE[,RULE...]`` on the offending
+line (on a ``def``/``class``/``with`` header it covers the whole
+block); ``# smtpu-lint: disable-file=RULE`` anywhere covers the file.
+Grandfathered findings live in the checked-in baseline
+(``lint_baseline.json`` at the repo root) — benign legacy patterns
+only, never actual bugs.
+"""
+
+from swiftmpi_tpu.analysis.core import (Finding, LintContext, LintFile,
+                                        load_baseline, run_lint,
+                                        write_baseline)
+from swiftmpi_tpu.analysis.rules import RULES
+
+__all__ = ["Finding", "LintContext", "LintFile", "RULES", "run_lint",
+           "load_baseline", "write_baseline"]

@@ -1,0 +1,845 @@
+"""The invariant rules.  Each encodes one hard-won repo contract; the
+origin incident and enforcement rationale per rule live in
+docs/ARCHITECTURE.md "Invariant catalog".
+
+Rules are deliberately *syntactic with narrow scopes* rather than
+whole-program dataflow: each invariant names the files that carry it
+(the serve read path, the pipeline producer, the transfer ledger), so
+a per-file AST pass with light intra-function tracking catches the
+regression classes that actually happened without drowning the gate in
+false positives.  Where a rule cannot decide statically (a series name
+held in a bare variable), it stays silent rather than guessing — the
+fixtures in tests/test_lint.py pin exactly what each rule sees.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from swiftmpi_tpu.analysis.core import Finding, LintContext, LintFile
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+
+
+def attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted chain for Name/Attribute trees: ``jax.random.split`` —
+    None when the root is not a plain Name (calls, subscripts...)."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def parent_map(tree: ast.AST) -> Dict[ast.AST, ast.AST]:
+    p: Dict[ast.AST, ast.AST] = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            p[child] = node
+    return p
+
+
+def _const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def _target_names(target: ast.AST) -> Set[str]:
+    """Plain names (re)bound by an assignment target (tuples unpacked)."""
+    out: Set[str] = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+    return out
+
+
+def _target_chains(target: ast.AST) -> Set[str]:
+    """Dotted chains (self.x ...) rebound by an assignment target."""
+    out: Set[str] = set()
+    if isinstance(target, (ast.Tuple, ast.List)):
+        for e in target.elts:
+            out |= _target_chains(e)
+    else:
+        c = attr_chain(target)
+        if c:
+            out.add(c)
+    return out
+
+
+class Rule:
+    id: str = ""
+    description: str = ""
+
+    def check(self, f: LintFile, ctx: LintContext) -> Iterator[Finding]:
+        raise NotImplementedError
+
+    def finding(self, f: LintFile, node: ast.AST, msg: str) -> Finding:
+        return Finding(self.id, f.rel, getattr(node, "lineno", 0),
+                       getattr(node, "col_offset", 0), msg)
+
+
+# ---------------------------------------------------------------------------
+# DONATE-ESCAPE
+
+
+def _donate_positions(call: ast.Call) -> Optional[Tuple[int, ...]]:
+    """Donated argnums from a ``jax.jit(...)`` / ``partial(jax.jit,...)``
+    call node, or None when it doesn't donate."""
+    chain = attr_chain(call.func)
+    inner = None
+    if chain in ("jax.jit", "jit"):
+        inner = call
+    elif chain in ("partial", "functools.partial") and call.args:
+        if attr_chain(call.args[0]) in ("jax.jit", "jit"):
+            inner = call
+    if inner is None:
+        return None
+    for kw in inner.keywords:
+        if kw.arg == "donate_argnums":
+            v = kw.value
+            if isinstance(v, ast.Constant) and isinstance(v.value, int):
+                return (v.value,)
+            if isinstance(v, (ast.Tuple, ast.List)):
+                out = []
+                for e in v.elts:
+                    if isinstance(e, ast.Constant) and \
+                            isinstance(e.value, int):
+                        out.append(e.value)
+                return tuple(out)
+            return ()          # dynamic donate spec: treat as unknown
+    return None
+
+
+class DonateEscape(Rule):
+    """A buffer passed at a donated position of a jitted function must
+    not be read afterwards, nor captured by a closure/thread: the NEXT
+    dispatch deletes the donated device buffer outright (the PR-8
+    serve-plane bug class: a snapshot holding the live table state went
+    ``Array has been deleted`` under readers)."""
+
+    id = "DONATE-ESCAPE"
+    description = ("donated-buffer argument read or captured after a "
+                   "donating jit call")
+
+    def check(self, f, ctx):
+        tree = f.tree
+        parents = parent_map(tree)
+        donating: Dict[str, Tuple[int, ...]] = {}     # module-level names
+        factories: Dict[str, Dict[str, Tuple[int, ...]]] = {}  # per class
+        donating_attrs: Dict[str, Tuple[int, ...]] = {}        # self.X
+
+        # pass 1: module-level donating defs/assignments + class factories
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                for dec in node.decorator_list:
+                    if isinstance(dec, ast.Call):
+                        pos = _donate_positions(dec)
+                        if pos is not None:
+                            donating[node.name] = pos
+            elif isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                pos = _donate_positions(node.value)
+                if pos is not None:
+                    for t in node.targets:
+                        for n in _target_names(t):
+                            donating[n] = pos
+            elif isinstance(node, ast.ClassDef):
+                factories[node.name] = self._class_factories(node)
+                for meth_pos in [factories[node.name]]:
+                    pass
+                # self.X = self.<factory>() anywhere in the class
+                for sub in ast.walk(node):
+                    if isinstance(sub, ast.Assign) and \
+                            isinstance(sub.value, ast.Call):
+                        fchain = attr_chain(sub.value.func)
+                        if fchain and fchain.startswith("self."):
+                            meth = fchain[len("self."):]
+                            pos = factories[node.name].get(meth)
+                            if pos is not None:
+                                for t in sub.targets:
+                                    for c in _target_chains(t):
+                                        if c.startswith("self."):
+                                            donating_attrs[c] = pos
+                        else:
+                            pos = _donate_positions(sub.value)
+                            if pos is not None:
+                                for t in sub.targets:
+                                    for c in _target_chains(t):
+                                        if c.startswith("self."):
+                                            donating_attrs[c] = pos
+
+        # pass 2: per-scope read-after-donation analysis
+        scopes = [tree] + [n for n in ast.walk(tree)
+                           if isinstance(n, (ast.FunctionDef,
+                                             ast.AsyncFunctionDef))]
+        all_factories: Dict[str, Tuple[int, ...]] = {}
+        for per_class in factories.values():
+            all_factories.update(per_class)
+        for scope in scopes:
+            yield from self._scan_scope(f, scope, parents, donating,
+                                        donating_attrs, all_factories)
+
+    @staticmethod
+    def _class_factories(cls: ast.ClassDef
+                         ) -> Dict[str, Tuple[int, ...]]:
+        """Methods that RETURN a donating jitted function (directly, via
+        a local name, or via another factory of the same class) — one
+        fixpoint pass so ``_fused_for -> _build_multi_step`` chains
+        resolve."""
+        out: Dict[str, Tuple[int, ...]] = {}
+        changed = True
+        while changed:
+            changed = False
+            for meth in cls.body:
+                if not isinstance(meth, ast.FunctionDef) or meth.name in out:
+                    continue
+                local: Dict[str, Tuple[int, ...]] = {}
+                for node in ast.walk(meth):
+                    if isinstance(node, ast.FunctionDef) and node is not meth:
+                        for dec in node.decorator_list:
+                            if isinstance(dec, ast.Call):
+                                pos = _donate_positions(dec)
+                                if pos is not None:
+                                    local[node.name] = pos
+                    elif isinstance(node, ast.Assign) and \
+                            isinstance(node.value, ast.Call):
+                        pos = _donate_positions(node.value)
+                        fchain = attr_chain(node.value.func)
+                        if pos is None and fchain and \
+                                fchain.startswith("self."):
+                            pos = out.get(fchain[len("self."):])
+                        if pos is not None:
+                            for t in node.targets:
+                                for n in _target_names(t):
+                                    local[n] = pos
+                for node in ast.walk(meth):
+                    if isinstance(node, ast.Return) and node.value is not None:
+                        pos = None
+                        if isinstance(node.value, ast.Name):
+                            pos = local.get(node.value.id)
+                        elif isinstance(node.value, ast.Call):
+                            pos = _donate_positions(node.value)
+                            fchain = attr_chain(node.value.func)
+                            if pos is None and fchain and \
+                                    fchain.startswith("self."):
+                                pos = out.get(fchain[len("self."):])
+                        if pos is not None:
+                            out[meth.name] = pos
+                            changed = True
+                            break
+        return out
+
+    def _scan_scope(self, f, scope, parents, donating, donating_attrs,
+                    factories):
+        body_nodes: List[ast.AST] = []     # nodes outside nested defs
+        nested_defs: List[ast.AST] = []
+        for node in ast.iter_child_nodes(scope):
+            self._split(node, body_nodes, nested_defs, top=scope)
+        # local donating names: n = self._factory(...) / n = jax.jit(...)
+        local = dict(donating)
+        for node in body_nodes:
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Call):
+                pos = _donate_positions(node.value)
+                fchain = attr_chain(node.value.func)
+                if pos is None and fchain and fchain.startswith("self."):
+                    pos = factories.get(fchain[len("self."):])
+                if pos is not None:
+                    for t in node.targets:
+                        for n in _target_names(t):
+                            local[n] = pos
+        # rebind lines per chain
+        rebinds: Dict[str, List[int]] = {}
+        for node in body_nodes:
+            if isinstance(node, (ast.Assign, ast.AugAssign, ast.For)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for c in _target_names(t) | _target_chains(t):
+                        rebinds.setdefault(c, []).append(node.lineno)
+        # loads per chain (outermost attribute/name only)
+        loads: Dict[str, List[ast.AST]] = {}
+        for node in body_nodes:
+            if isinstance(node, (ast.Name, ast.Attribute)) and \
+                    isinstance(getattr(node, "ctx", None), ast.Load):
+                if isinstance(parents.get(node), ast.Attribute):
+                    continue                   # inner part of a chain
+                c = attr_chain(node)
+                if c:
+                    loads.setdefault(c, []).append(node)
+
+        for node in body_nodes:
+            if not isinstance(node, ast.Call):
+                continue
+            pos = None
+            fchain = attr_chain(node.func)
+            if isinstance(node.func, ast.Name):
+                pos = local.get(node.func.id)
+            elif fchain and fchain in donating_attrs:
+                pos = donating_attrs[fchain]
+            if not pos:
+                continue
+            stmt = node
+            while stmt in parents and not isinstance(
+                    stmt, (ast.Assign, ast.AugAssign, ast.Expr,
+                           ast.Return)):
+                stmt = parents[stmt]
+            bound = set()
+            if isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    bound = bound | _target_names(t) | _target_chains(t)
+            for p in pos:
+                if p >= len(node.args):
+                    continue
+                arg = node.args[p]
+                chain = attr_chain(arg)
+                if chain is None:
+                    continue
+                if chain in bound:
+                    continue           # canonical x = step(x, ...) rebind
+                call_line = node.lineno
+                next_rebind = min(
+                    [ln for ln in rebinds.get(chain, [])
+                     if ln > call_line] or [10 ** 9])
+                for ld in loads.get(chain, []):
+                    if ld is arg:
+                        continue
+                    if call_line < ld.lineno < next_rebind:
+                        yield self.finding(
+                            f, ld,
+                            f"`{chain}` was donated to "
+                            f"`{fchain or '<fn>'}` on line {call_line} "
+                            "(donate_argnums) and is read afterwards — "
+                            "the next dispatch deletes the buffer; copy "
+                            "before donating or rebind the name")
+                for nd in nested_defs:
+                    names = {n.id for n in ast.walk(nd)
+                             if isinstance(n, ast.Name)
+                             and isinstance(n.ctx, ast.Load)}
+                    argnames = set()
+                    a = getattr(nd, "args", None)
+                    if a is not None:
+                        argnames = {x.arg for x in
+                                    a.args + a.kwonlyargs +
+                                    ([a.vararg] if a.vararg else []) +
+                                    ([a.kwarg] if a.kwarg else [])}
+                    root = chain.split(".")[0]
+                    if root in names - argnames and \
+                            chain not in bound and \
+                            not rebinds.get(chain):
+                        yield self.finding(
+                            f, nd,
+                            f"closure captures `{root}` which is donated "
+                            f"to `{fchain or '<fn>'}` on line "
+                            f"{call_line} — a thread/callback reading it "
+                            "races buffer deletion; capture a host copy "
+                            "instead")
+
+    def _split(self, node, body_nodes, nested_defs, top):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)) and node is not top:
+            nested_defs.append(node)
+            return
+        body_nodes.append(node)
+        for child in ast.iter_child_nodes(node):
+            self._split(child, body_nodes, nested_defs, top)
+
+
+# ---------------------------------------------------------------------------
+# READER-PURE-HOST
+
+_SERVE_ALLOW = {
+    "serve/snapshot.py": ("jax.device_get", "jax.tree_util"),
+    "serve/reader.py": (),
+    "serve/query.py": (),
+}
+
+
+class ReaderPureHost(Rule):
+    """Serve read-path modules are pure host: no ``jax.``/``jnp.``
+    device ops.  Reader threads launching device programs against the
+    trainer's dispatches rendezvous-deadlock XLA:CPU (PR-8); snapshots
+    may use exactly ``jax.device_get``/``jax.tree_util`` — the
+    trainer-thread D2H copy."""
+
+    id = "READER-PURE-HOST"
+    description = "device op reachable from the serve read path"
+
+    def check(self, f, ctx):
+        allow = None
+        for suffix, al in _SERVE_ALLOW.items():
+            if f.rel.endswith(suffix):
+                allow = al
+        if allow is None:
+            return
+        parents = parent_map(f.tree)
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = ([a.name for a in node.names]
+                        if isinstance(node, ast.Import)
+                        else [node.module or ""])
+                for m in mods:
+                    if m == "jax" and "jax.device_get" in allow:
+                        continue
+                    if m.split(".")[0] == "jax" or m == "jnp":
+                        yield self.finding(
+                            f, node,
+                            f"import of `{m}` in a pure-host serve "
+                            "module — readers must never touch the "
+                            "device runtime")
+            elif isinstance(node, (ast.Name, ast.Attribute)):
+                if isinstance(parents.get(node), ast.Attribute):
+                    continue
+                chain = attr_chain(node)
+                if not chain:
+                    continue
+                root = chain.split(".")[0]
+                if root not in ("jax", "jnp"):
+                    continue
+                if any(chain == a or chain.startswith(a + ".")
+                       for a in allow):
+                    continue
+                yield self.finding(
+                    f, node,
+                    f"`{chain}` in a pure-host serve module — reader "
+                    "threads must not launch device programs "
+                    "(XLA:CPU rendezvous deadlock class); gather from "
+                    "the snapshot's host replica instead")
+
+
+# ---------------------------------------------------------------------------
+# PRODUCER-NO-RNG / PRODUCER-NO-DEVICE
+
+_PIPELINE_SUFFIX = "io/pipeline.py"
+
+
+class ProducerNoRng(Rule):
+    """The pipeline producer owns no RNG: all key splitting happens on
+    the consumer in consumption order (PR-5 bit-identity contract), so
+    nothing under io/pipeline.py may touch an RNG."""
+
+    id = "PRODUCER-NO-RNG"
+    description = "RNG use inside the input-pipeline producer module"
+
+    def check(self, f, ctx):
+        if not f.rel.endswith(_PIPELINE_SUFFIX):
+            return
+        parents = parent_map(f.tree)
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                if isinstance(parents.get(node), ast.Attribute):
+                    continue
+                chain = attr_chain(node) or ""
+                if chain.startswith(("jax.random", "np.random",
+                                     "numpy.random", "random.")):
+                    yield self.finding(
+                        f, node,
+                        f"`{chain}` in the pipeline module — the "
+                        "producer owns no RNG; split keys on the "
+                        "consumer in consumption order")
+            elif isinstance(node, (ast.Import, ast.ImportFrom)):
+                mods = ([a.name for a in node.names]
+                        if isinstance(node, ast.Import)
+                        else [node.module or ""])
+                for m in mods:
+                    if m == "random" or m.startswith("jax.random"):
+                        yield self.finding(
+                            f, node,
+                            f"import of `{m}` in the pipeline module — "
+                            "the producer owns no RNG")
+
+
+class ProducerNoDevice(Rule):
+    """The producer thread must not consult thread-local device context
+    (``jax.default_device`` is consumer-thread state) or place arrays
+    implicitly: ``device_put`` needs the explicit sharding captured by
+    the consumer at build time."""
+
+    id = "PRODUCER-NO-DEVICE"
+    description = ("implicit device placement / default_device consult "
+                   "in the pipeline module")
+
+    def check(self, f, ctx):
+        if not f.rel.endswith(_PIPELINE_SUFFIX):
+            return
+        parents = parent_map(f.tree)
+        for node in ast.walk(f.tree):
+            if isinstance(node, (ast.Name, ast.Attribute)):
+                if isinstance(parents.get(node), ast.Attribute):
+                    continue
+                chain = attr_chain(node) or ""
+                if chain.startswith(("jax.default_device", "jax.devices",
+                                     "jnp.", "jax.numpy")):
+                    yield self.finding(
+                        f, node,
+                        f"`{chain}` in the pipeline module — "
+                        "jax.default_device is thread-local consumer "
+                        "state and implicit placement races it; use "
+                        "the sharding captured at pipeline build time")
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func) or ""
+                if chain.endswith("device_put") and \
+                        len(node.args) + len(node.keywords) < 2:
+                    yield self.finding(
+                        f, node,
+                        "`device_put` without an explicit "
+                        "sharding/device in the pipeline module — "
+                        "implicit placement reads the consumer's "
+                        "thread-local default_device from the producer "
+                        "thread")
+
+
+# ---------------------------------------------------------------------------
+# LEDGER-MONOTONIC
+
+_LEDGER_KEYS = None  # resolved lazily from obs.catalog
+
+
+def _ledger_keys() -> Set[str]:
+    global _LEDGER_KEYS
+    if _LEDGER_KEYS is None:
+        from swiftmpi_tpu.obs.catalog import TRANSFER_KEYS
+        _LEDGER_KEYS = set(TRANSFER_KEYS) | {
+            "window_fmt_dense", "window_fmt_sparse", "window_fmt_q",
+            "window_fmt_bitmap"}
+    return _LEDGER_KEYS
+
+
+class LedgerMonotonic(Rule):
+    """Traffic ledgers are monotonic totals: backends never reset or
+    assign counters (PR-6 contract — interval numbers are
+    snapshot-and-subtract), and call sites outside the transfer layer
+    use ``traffic_delta`` instead of hand-rolled subtraction (PR-9
+    migrated every one; hand-rolling races the eager-count drain)."""
+
+    id = "LEDGER-MONOTONIC"
+    description = ("ledger counter reset, or hand-rolled traffic delta "
+                   "outside transfer/")
+
+    def check(self, f, ctx):
+        in_transfer = "/transfer/" in "/" + f.rel
+        if in_transfer:
+            yield from self._check_backend(f)
+        yield from self._check_hand_rolled(f)
+
+    def _check_backend(self, f):
+        keys = _ledger_keys()
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign):
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript):
+                        k = _const_str(t.slice)
+                        if k in keys:
+                            yield self.finding(
+                                f, node,
+                                f"assignment to ledger counter "
+                                f"[{k!r}] — ledgers are monotonic "
+                                "totals with no reset; use += and let "
+                                "readers snapshot-and-subtract")
+            elif isinstance(node, ast.AugAssign):
+                if isinstance(node.target, ast.Subscript) and \
+                        isinstance(node.op, ast.Sub):
+                    k = _const_str(node.target.slice)
+                    if k in keys:
+                        yield self.finding(
+                            f, node,
+                            f"`-=` on ledger counter [{k!r}] — "
+                            "monotonic totals never decrease")
+            elif isinstance(node, ast.FunctionDef):
+                if re.match(r"(reset|clear)_.*(traffic|ledger|wire)",
+                            node.name):
+                    yield self.finding(
+                        f, node,
+                        f"method `{node.name}` — there is no reset in "
+                        "the ledger contract (monotonic totals; "
+                        "readers use traffic_delta)")
+
+    def _check_hand_rolled(self, f):
+        scopes = [f.tree] + [n for n in ast.walk(f.tree)
+                             if isinstance(n, (ast.FunctionDef,
+                                               ast.AsyncFunctionDef))]
+        for scope in scopes:
+            tracked: Set[str] = set()
+            for node in scope.body if isinstance(scope, ast.Module) \
+                    else ast.walk(scope):
+                if isinstance(node, ast.Assign) and \
+                        isinstance(node.value, ast.Call) and \
+                        isinstance(node.value.func, ast.Attribute) and \
+                        node.value.func.attr in ("traffic",
+                                                 "wire_traffic"):
+                    for t in node.targets:
+                        tracked |= _target_names(t)
+            if len(tracked) < 2:
+                continue
+            for node in ast.walk(scope):
+                if isinstance(node, ast.BinOp) and \
+                        isinstance(node.op, ast.Sub):
+                    lr = (self._root(node.left), self._root(node.right))
+                    if lr[0] in tracked and lr[1] in tracked and \
+                            lr[0] != lr[1]:
+                        yield self.finding(
+                            f, node,
+                            f"hand-rolled traffic delta "
+                            f"`{lr[0]} - {lr[1]}` — use "
+                            "Transfer.traffic_delta(since), which "
+                            "reconstructs the interval without racing "
+                            "the eager-count drain")
+
+    @staticmethod
+    def _root(node) -> Optional[str]:
+        while isinstance(node, (ast.Subscript, ast.Call, ast.Attribute)):
+            node = node.func if isinstance(node, ast.Call) else node.value
+        if isinstance(node, ast.Name):
+            return node.id
+        return None
+
+
+# ---------------------------------------------------------------------------
+# TELEMETRY-CATALOG
+
+_INSTRUMENT_ATTRS = ("counter", "gauge", "histogram")
+_CATALOG_EXEMPT = ("obs/registry.py", "obs/catalog.py", "obs/recorder.py",
+                   "analysis/")
+
+
+class TelemetryCatalog(Rule):
+    """Every telemetry series registered with a literal name must be
+    declared in :mod:`swiftmpi_tpu.obs.catalog` — catching label drift
+    across the four transfer-backend mirrors (incl. the tpu backend's
+    eager-drain paths) and dashboard-silent typos.  Dynamic f-string
+    names must fall inside a declared prefix family; bare-variable
+    names are invisible to the checker and pass."""
+
+    id = "TELEMETRY-CATALOG"
+    description = "telemetry series name not in the declared catalog"
+
+    def check(self, f, ctx):
+        if any(x in f.rel for x in _CATALOG_EXEMPT):
+            return
+        from swiftmpi_tpu.obs import catalog
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = node.func
+            wrapper = None
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in _INSTRUMENT_ATTRS:
+                wrapper = ""
+            elif isinstance(fn, (ast.Attribute, ast.Name)):
+                name = fn.attr if isinstance(fn, ast.Attribute) else fn.id
+                if name == "_obs_inc":
+                    wrapper = "transfer/"
+                elif name == "_obs_count":
+                    wrapper = ""
+            if wrapper is None:
+                continue
+            for cand in self._name_candidates(node.args[0]):
+                kind, value = cand
+                if kind == "exact":
+                    if not catalog.declared(wrapper + value):
+                        yield self.finding(
+                            f, node,
+                            f"series `{wrapper + value}` is not "
+                            "declared in swiftmpi_tpu/obs/catalog.py — "
+                            "declare it (or fix the typo) so the "
+                            "four backend mirrors stay in sync")
+                elif kind == "prefix":
+                    if not catalog.declared_prefix(wrapper + value):
+                        yield self.finding(
+                            f, node,
+                            f"dynamic series name with stem "
+                            f"`{wrapper + value}` matches no declared "
+                            "prefix family in obs/catalog.py")
+
+    @staticmethod
+    def _name_candidates(arg):
+        s = _const_str(arg)
+        if s is not None:
+            yield ("exact", s)
+            return
+        if isinstance(arg, ast.IfExp):
+            for side in (arg.body, arg.orelse):
+                s = _const_str(side)
+                if s is not None:
+                    yield ("exact", s)
+            return
+        if isinstance(arg, ast.JoinedStr):
+            stem = ""
+            for v in arg.values:
+                s = _const_str(v)
+                if s is None:
+                    break
+                stem += s
+            yield ("prefix", stem)
+            return
+        if isinstance(arg, ast.BinOp) and isinstance(arg.op, ast.Add):
+            s = _const_str(arg.left)
+            if s is not None:
+                yield ("prefix", s)
+        # bare variables: statically invisible, skip
+
+
+# ---------------------------------------------------------------------------
+# LOCK-GUARD
+
+_GUARD_RE = re.compile(r"#\s*guarded-by:\s*([A-Za-z_]\w*)")
+_MUTATORS = {"append", "appendleft", "add", "clear", "pop", "popitem",
+             "remove", "update", "extend", "insert", "discard",
+             "setdefault"}
+
+
+class LockGuard(Rule):
+    """Fields annotated ``# guarded-by: <lock>`` on their ``__init__``
+    assignment may only be mutated inside ``with self.<lock>:`` (any
+    method but ``__init__``, which runs happens-before publication).
+    Encodes the SnapshotPublisher swap contract: readers race
+    ``_latest``/``_history``, so every write goes through the
+    Condition."""
+
+    id = "LOCK-GUARD"
+    description = "guarded field mutated outside its lock"
+
+    def check(self, f, ctx):
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.ClassDef):
+                yield from self._check_class(f, node)
+
+    def _check_class(self, f, cls):
+        guards: Dict[str, str] = {}
+        init = None
+        for meth in cls.body:
+            if isinstance(meth, ast.FunctionDef) and \
+                    meth.name == "__init__":
+                init = meth
+        if init is None:
+            return
+        for node in ast.walk(init):
+            if isinstance(node, ast.Assign):
+                m = _GUARD_RE.search(f.lines[node.lineno - 1]
+                                     if node.lineno <= len(f.lines) else "")
+                if not m:
+                    continue
+                for t in node.targets:
+                    for c in _target_chains(t):
+                        if c.startswith("self."):
+                            guards[c[len("self."):]] = m.group(1)
+        if not guards:
+            return
+        parents = parent_map(cls)
+        for meth in cls.body:
+            if not isinstance(meth, ast.FunctionDef) or \
+                    meth.name == "__init__":
+                continue
+            for node in ast.walk(meth):
+                field = self._mutated_field(node, guards)
+                if field is None:
+                    continue
+                lock = guards[field]
+                if not self._under_lock(node, parents, lock):
+                    yield self.finding(
+                        f, node,
+                        f"`self.{field}` is guarded-by `{lock}` but "
+                        f"mutated outside `with self.{lock}:` — "
+                        "readers race this field")
+
+    @staticmethod
+    def _mutated_field(node, guards) -> Optional[str]:
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for c in _target_chains(t):
+                    if c.startswith("self.") and \
+                            c[len("self."):] in guards:
+                        return c[len("self."):]
+        elif isinstance(node, ast.Call) and \
+                isinstance(node.func, ast.Attribute) and \
+                node.func.attr in _MUTATORS:
+            c = attr_chain(node.func.value)
+            if c and c.startswith("self.") and \
+                    c[len("self."):] in guards:
+                return c[len("self."):]
+        return None
+
+    @staticmethod
+    def _under_lock(node, parents, lock: str) -> bool:
+        cur = parents.get(node)
+        while cur is not None:
+            if isinstance(cur, ast.With):
+                for item in cur.items:
+                    c = attr_chain(item.context_expr)
+                    if c == f"self.{lock}":
+                        return True
+            cur = parents.get(cur)
+        return False
+
+
+# ---------------------------------------------------------------------------
+# KNOB-DOC
+
+_CONFIG_RECEIVERS = ("config", "conf", "cfg", "_config")
+_CONFIG_METHODS = ("get", "get_or", "has")
+
+
+class KnobDoc(Rule):
+    """Every ``[section] key`` config read must be documented in
+    docs/OPERATIONS.md (the knob reference carries the default and the
+    operational meaning).  A knob that exists only in code is a knob
+    operators discover during an incident."""
+
+    id = "KNOB-DOC"
+    description = "config knob read without an OPERATIONS.md entry"
+
+    def check(self, f, ctx):
+        ops = ctx.operations_md
+        aliases: Set[str] = set()
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Assign) and \
+                    isinstance(node.value, ast.Attribute) and \
+                    node.value.attr in _CONFIG_METHODS and \
+                    self._config_receiver(node.value.value):
+                for t in node.targets:
+                    aliases |= _target_names(t)
+        for node in ast.walk(f.tree):
+            if not isinstance(node, ast.Call) or len(node.args) < 2:
+                continue
+            fn = node.func
+            is_knob = False
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in _CONFIG_METHODS and \
+                    self._config_receiver(fn.value):
+                is_knob = True
+            elif isinstance(fn, ast.Name) and fn.id in aliases:
+                is_knob = True
+            if not is_knob:
+                continue
+            section = _const_str(node.args[0])
+            key = _const_str(node.args[1])
+            if section is None or key is None:
+                continue
+            if f"[{section}] {key}" not in ops:
+                yield self.finding(
+                    f, node,
+                    f"config knob `[{section}] {key}` has no "
+                    "`[section] key` entry in docs/OPERATIONS.md — "
+                    "add it to the knob reference (with its default)")
+
+    @staticmethod
+    def _config_receiver(node) -> bool:
+        c = attr_chain(node)
+        if not c:
+            return False
+        last = c.split(".")[-1]
+        return last in _CONFIG_RECEIVERS
+
+
+RULES = (DonateEscape(), ReaderPureHost(), ProducerNoRng(),
+         ProducerNoDevice(), LedgerMonotonic(), TelemetryCatalog(),
+         LockGuard(), KnobDoc())

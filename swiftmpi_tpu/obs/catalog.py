@@ -1,0 +1,83 @@
+"""Declared telemetry series catalog — the ONE list of metric names.
+
+Every instrument registration in the codebase (``reg.counter(...)``,
+``reg.gauge(...)``, ``reg.histogram(...)``, the transfer backends'
+``_obs_inc`` mirror, the fault bus's ``_obs_count``) must use a name
+declared here.  The TELEMETRY-CATALOG lint rule
+(:mod:`swiftmpi_tpu.analysis.rules`) enforces the match statically, so
+a typo'd series name — or a new series added to one of the four
+transfer-backend mirrors but not the others — fails the lint gate
+instead of silently forking the dashboard namespace.
+
+Two declaration forms:
+
+* :data:`SERIES` — exact names.  Labels are NOT part of the identity
+  here (the registry's ``name{label=v}`` series keys stay free-form);
+  the catalog pins the *name* half of the contract that
+  docs/ARCHITECTURE.md "Telemetry plane" documents in prose.
+* :data:`PREFIXES` — dynamic families built with f-strings whose
+  stem is static (``control/<knob-name>`` gauges, the microbench
+  ``micro_<gauge>`` context scalars).  The lint rule checks an
+  f-string's leading literal chunk against these.
+
+``transfer/`` series are declared via :data:`TRANSFER_KEYS` (the bare
+ledger key, as passed to ``Transfer._obs_inc``) and expanded into
+``SERIES`` below, so the ledger key list lives in exactly one place.
+"""
+
+from __future__ import annotations
+
+#: Ledger keys mirrored by ``Transfer._obs_inc`` as ``transfer/<key>``.
+#: All four backends (local/xla/tpu/hybrid) — including the tpu
+#: backend's eager-drain paths, which bypass ``_accum_*`` — must book
+#: through keys declared here.
+TRANSFER_KEYS = frozenset({
+    "wire_bytes", "dispatches",
+    "window_sparse", "window_dense",            # legacy 2-way decisions
+    "window_fmt",                               # 4-way, fmt= label
+    "coalesced_rows_in", "coalesced_rows_out",
+    "pull_bytes", "pull_rows", "pull_hot_rows",
+    "routed_rows", "overflow_dropped",          # tpu routing ledger
+    "hot_rows", "psum_bytes",                   # hybrid hot plane
+})
+
+SERIES = frozenset({
+    # host phase spans (obs.span) + bench latency publish default
+    "phase_ms", "step_ms",
+    # input pipeline (io/pipeline.py)
+    "pipeline/produced", "pipeline/consumed", "pipeline/queue_depth",
+    # training loops (word2vec/glove via Throughput sampler bridge)
+    "train/host_stall_ms_total", "train/device_ms_total",
+    "train/words_per_sec",
+    # checkpoints (io/checkpoint.py)
+    "checkpoint/saves", "checkpoint/restores",
+    # health probes (utils/health.py)
+    "health/probe_ok", "health/probe_fail", "health/probe_ms",
+    # fault-injection bus (testing/faults.py)
+    "faults/injected", "faults/step_events", "faults/checkpoint_events",
+    # serving plane (serve/)
+    "serve/queries", "serve/rows_read", "serve/hits", "serve/misses",
+    "serve/topk_queries", "serve/latency_ms", "serve/snapshots",
+    "serve/snapshot_version", "serve/staleness_steps",
+    # control plane (control/controller.py)
+    "control/evaluations", "control/decisions",
+    "control/decisions_applied", "control/sketch_observed",
+}) | frozenset("transfer/" + k for k in TRANSFER_KEYS)
+
+#: Dynamic-name families: an f-string series name passes the catalog
+#: check when its leading literal chunk starts with one of these.
+PREFIXES = (
+    "control/",     # per-knob gauges: control/<knob.name>
+    "micro_",       # microbench context gauges: micro_<key>{cell=}
+)
+
+
+def declared(name: str) -> bool:
+    """True when ``name`` is a declared series (exact or prefix)."""
+    return name in SERIES or any(name.startswith(p) for p in PREFIXES)
+
+
+def declared_prefix(stem: str) -> bool:
+    """True when an f-string whose literal stem is ``stem`` builds
+    names inside a declared dynamic family."""
+    return any(stem.startswith(p) for p in PREFIXES)

@@ -29,12 +29,18 @@ import numpy as np
 _topk_unified_jit = None
 
 
-def _topk_unified_device(hot, tail, qt, k):
+def _topk_unified_device(hot, tail, qt, k):  # smtpu-lint: disable=READER-PURE-HOST
     """On-device scores/slots of the top-k unified slots per query
     column.  ``hot`` may be a (0, d) placeholder — concatenation keeps
     one jit signature for hybrid and plain tables alike.  Rows are
     normalized in f32 on device (the table may store bf16), queries
-    arrive pre-normalized."""
+    arrive pre-normalized.
+
+    Lint suppression: this function is the documented exception to the
+    pure-host serve rule — it runs on the TRAINER thread only (offline
+    top-k, never from a reader thread; see docs/ARCHITECTURE.md serve
+    plane), so it cannot rendezvous-deadlock against training
+    dispatches."""
     import jax
     import jax.numpy as jnp
 

@@ -194,8 +194,11 @@ class SnapshotPublisher:
             raise ValueError("[serve] depth must be >= 1")
         self.every = int(every)
         self.depth = int(depth)
-        self._latest: Optional[TableSnapshot] = None
-        self._history: deque = deque(maxlen=depth)
+        # reader-visible fields: query threads race the publish swap,
+        # so every mutation outside __init__ holds the Condition
+        # (enforced by the LOCK-GUARD lint rule)
+        self._latest: Optional[TableSnapshot] = None   # guarded-by: _cond
+        self._history: deque = deque(maxlen=depth)     # guarded-by: _cond
         self._version = 0
         self._train_step = 0
         self._last_published_step = 0

@@ -273,8 +273,10 @@ class Transfer:
         ck = (key,) + tuple(sorted(labels.items())) if labels else key
         c = cache[1].get(ck)
         if c is None:
-            c = cache[1][ck] = reg.counter("transfer/" + key,
-                                           backend=self.name, **labels)
+            # the one legit dynamic transfer/ name: TELEMETRY-CATALOG
+            # validates `key` at every _obs_inc call site instead
+            c = cache[1][ck] = reg.counter(  # smtpu-lint: disable=TELEMETRY-CATALOG
+                "transfer/" + key, backend=self.name, **labels)
         c.inc(n)
 
     def _count_decision(self, st: dict, decision: str) -> None:

@@ -1,0 +1,514 @@
+"""smtpu-lint engine tests (ISSUE 11): per-rule golden fixtures (each
+origin bug reproduced as a tiny snippet that must trip, plus the
+corrected twin that must pass), suppression and baseline semantics,
+JSON schema, and the repo-wide lint-clean assertion that IS the gate.
+"""
+
+import json
+import textwrap
+
+import pytest
+
+from swiftmpi_tpu.analysis import core
+from swiftmpi_tpu.analysis.lint import main as lint_main
+
+
+def lint_src(tmp_path, rel, src, ops=None):
+    """Write ``src`` at ``tmp_path/rel`` (path scoping matters — rules
+    key off serve/, io/pipeline.py, transfer/) and lint just that file;
+    returns the NEW findings."""
+    p = tmp_path / rel
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    if ops is not None:
+        d = tmp_path / "docs"
+        d.mkdir(exist_ok=True)
+        (d / "OPERATIONS.md").write_text(ops)
+    new, _ = core.run_lint(paths=[str(p)], root=str(tmp_path))
+    return new
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# DONATE-ESCAPE (the PR-8 bug class)
+
+_DONATE_HEADER = """\
+    from functools import partial
+    import jax
+
+    @partial(jax.jit, donate_argnums=0)
+    def step(state, x):
+        return state
+"""
+
+
+def test_donate_escape_trips_on_read_after_donation(tmp_path):
+    new = lint_src(tmp_path, "pkg/train.py", _DONATE_HEADER + """
+    def train(state, xs):
+        out = step(state, xs)
+        stash = state
+        return out, stash
+    """)
+    assert [f.rule for f in new] == ["DONATE-ESCAPE"]
+    assert "donated" in new[0].message
+
+
+def test_donate_escape_passes_on_rebind(tmp_path):
+    new = lint_src(tmp_path, "pkg/train.py", _DONATE_HEADER + """
+    def train(state, xs):
+        for x in xs:
+            state = step(state, x)
+        return state
+    """)
+    assert "DONATE-ESCAPE" not in rules_of(new)
+
+
+def test_donate_escape_trips_on_closure_capture(tmp_path):
+    new = lint_src(tmp_path, "pkg/train.py", _DONATE_HEADER + """
+    def train(state, xs):
+        out = step(state, xs)
+        def snapshot():
+            return state
+        return out, snapshot
+    """)
+    assert "DONATE-ESCAPE" in rules_of(new)
+    assert any("closure" in f.message for f in new)
+
+
+def test_donate_escape_traces_factory_method_chain(tmp_path):
+    # the literal PR-8 shape: a donating step built by a factory and
+    # bound to self, with the pre-step state stashed after dispatch
+    new = lint_src(tmp_path, "pkg/model.py", """
+    from functools import partial
+    import jax
+
+    class Model:
+        def __init__(self):
+            self._step = self._build_step()
+
+        def _build_step(self):
+            @partial(jax.jit, donate_argnums=0)
+            def f(state):
+                return state
+            return f
+
+        def train(self, state):
+            new_state = self._step(state)
+            self.snapshot = state
+            return new_state
+    """)
+    assert "DONATE-ESCAPE" in rules_of(new)
+
+
+def test_donate_escape_passes_when_copied_before(tmp_path):
+    new = lint_src(tmp_path, "pkg/model.py", _DONATE_HEADER + """
+    import jax
+
+    def train(state, xs):
+        host_copy = jax.device_get(state)
+        state = step(state, xs)
+        return state, host_copy
+    """)
+    assert "DONATE-ESCAPE" not in rules_of(new)
+
+
+# ---------------------------------------------------------------------------
+# READER-PURE-HOST (the XLA:CPU rendezvous-deadlock class)
+
+def test_reader_pure_host_trips_on_device_ops(tmp_path):
+    new = lint_src(tmp_path, "pkg/serve/reader.py", """
+    import jax.numpy as jnp
+
+    def read_rows(table, idx):
+        return jnp.take(table, idx, axis=0)
+    """)
+    assert rules_of(new) == {"READER-PURE-HOST"}
+    assert len(new) >= 2          # the import and the use
+
+
+def test_reader_pure_host_passes_on_numpy(tmp_path):
+    new = lint_src(tmp_path, "pkg/serve/reader.py", """
+    import numpy as np
+
+    def read_rows(table, idx):
+        return np.take(table, idx, axis=0)
+    """)
+    assert new == []
+
+
+def test_snapshot_allows_device_get_but_not_jit(tmp_path):
+    new = lint_src(tmp_path, "pkg/serve/snapshot.py", """
+    import jax
+
+    def copy_out(x):
+        return jax.device_get(x)
+
+    def bad(fn):
+        return jax.jit(fn)
+    """)
+    assert [f.rule for f in new] == ["READER-PURE-HOST"]
+    assert "jax.jit" in new[0].message
+
+
+# ---------------------------------------------------------------------------
+# PRODUCER-NO-RNG / PRODUCER-NO-DEVICE (the PR-5 bit-identity contract)
+
+def test_producer_no_rng_trips(tmp_path):
+    new = lint_src(tmp_path, "pkg/io/pipeline.py", """
+    import jax
+
+    def produce(key, batch):
+        key, sub = jax.random.split(key)
+        return sub, batch
+    """)
+    assert "PRODUCER-NO-RNG" in rules_of(new)
+
+
+def test_producer_no_rng_passes_outside_pipeline(tmp_path):
+    new = lint_src(tmp_path, "pkg/models/w2v.py", """
+    import jax
+
+    def draw(key):
+        return jax.random.split(key)
+    """)
+    assert "PRODUCER-NO-RNG" not in rules_of(new)
+
+
+def test_producer_no_device_trips_on_default_device(tmp_path):
+    new = lint_src(tmp_path, "pkg/io/pipeline.py", """
+    import jax
+
+    def place(x):
+        with jax.default_device(jax.devices()[0]):
+            return jax.device_put(x)
+    """)
+    msgs = [f for f in new if f.rule == "PRODUCER-NO-DEVICE"]
+    assert len(msgs) >= 2         # default_device consult + 1-arg put
+
+
+def test_producer_no_device_passes_with_explicit_sharding(tmp_path):
+    new = lint_src(tmp_path, "pkg/io/pipeline.py", """
+    import jax
+
+    def place(x, sharding):
+        return jax.device_put(x, sharding)
+    """)
+    assert "PRODUCER-NO-DEVICE" not in rules_of(new)
+
+
+# ---------------------------------------------------------------------------
+# LEDGER-MONOTONIC (the PR-6 traffic()-never-resets contract)
+
+def test_ledger_trips_on_counter_reset(tmp_path):
+    new = lint_src(tmp_path, "pkg/transfer/fancy.py", """
+    class FancyTransfer:
+        def finish_epoch(self):
+            st = self._wire_state()
+            st["wire_bytes"] = 0
+
+        def reset_traffic(self):
+            pass
+    """)
+    assert [f.rule for f in new] == ["LEDGER-MONOTONIC"] * 2
+
+
+def test_ledger_passes_on_increment(tmp_path):
+    new = lint_src(tmp_path, "pkg/transfer/fancy.py", """
+    class FancyTransfer:
+        def push(self, n):
+            st = self._wire_state()
+            st["wire_bytes"] += n
+    """)
+    assert new == []
+
+
+def test_ledger_trips_on_hand_rolled_delta(tmp_path):
+    new = lint_src(tmp_path, "pkg/bench_thing.py", """
+    def measure(tr, run):
+        before = tr.traffic()
+        run()
+        after = tr.traffic()
+        return after["wire_bytes"] - before["wire_bytes"]
+    """)
+    assert "LEDGER-MONOTONIC" in rules_of(new)
+    assert "traffic_delta" in new[0].message
+
+
+def test_ledger_passes_on_traffic_delta(tmp_path):
+    new = lint_src(tmp_path, "pkg/bench_thing.py", """
+    def measure(tr, run):
+        before = tr.traffic()
+        run()
+        return tr.traffic_delta(before)
+    """)
+    assert new == []
+
+
+# ---------------------------------------------------------------------------
+# TELEMETRY-CATALOG
+
+def test_telemetry_trips_on_undeclared_series(tmp_path):
+    new = lint_src(tmp_path, "pkg/thing.py", """
+    def record(reg):
+        reg.counter("transfer/wire_bytez").inc(1)
+    """)
+    assert rules_of(new) == {"TELEMETRY-CATALOG"}
+
+
+def test_telemetry_passes_on_declared_series_and_prefix(tmp_path):
+    new = lint_src(tmp_path, "pkg/thing.py", """
+    def record(reg, knob, k):
+        reg.histogram("phase_ms").observe(1.0)
+        reg.gauge(f"control/{knob}").set(2)
+        reg.gauge(f"micro_{k}", cell="c").set(3)
+    """)
+    assert new == []
+
+
+def test_telemetry_trips_on_undeclared_fstring_stem(tmp_path):
+    new = lint_src(tmp_path, "pkg/thing.py", """
+    def record(reg, k):
+        reg.gauge(f"bogus_{k}").set(1)
+    """)
+    assert rules_of(new) == {"TELEMETRY-CATALOG"}
+
+
+def test_telemetry_checks_obs_inc_wrapper(tmp_path):
+    new = lint_src(tmp_path, "pkg/transfer/fancy.py", """
+    class FancyTransfer:
+        def push(self):
+            self._obs_inc("wire_bytes", 1)
+            self._obs_inc("not_a_ledger_key", 1)
+    """)
+    assert [f.rule for f in new] == ["TELEMETRY-CATALOG"]
+    assert "transfer/not_a_ledger_key" in new[0].message
+
+
+def test_telemetry_checks_both_ifexp_branches(tmp_path):
+    new = lint_src(tmp_path, "pkg/thing.py", """
+    def record(reg, ok):
+        reg.counter(
+            "health/probe_ok" if ok else "health/probe_typo").inc(1)
+    """)
+    assert rules_of(new) == {"TELEMETRY-CATALOG"}
+
+
+# ---------------------------------------------------------------------------
+# LOCK-GUARD
+
+_LOCK_CLASS = """\
+    import threading
+
+    class Publisher:
+        def __init__(self):
+            self._lock = threading.Lock()
+            self._latest = None      # guarded-by: _lock
+            self._history = []       # guarded-by: _lock
+            self._free = 0           # no annotation
+"""
+
+
+def test_lock_guard_trips_outside_lock(tmp_path):
+    new = lint_src(tmp_path, "pkg/pub.py", _LOCK_CLASS + """
+        def publish(self, snap):
+            self._history.append(snap)
+            self._latest = snap
+    """)
+    assert [f.rule for f in new] == ["LOCK-GUARD"] * 2
+
+
+def test_lock_guard_passes_inside_lock(tmp_path):
+    new = lint_src(tmp_path, "pkg/pub.py", _LOCK_CLASS + """
+        def publish(self, snap):
+            with self._lock:
+                self._history.append(snap)
+                self._latest = snap
+            self._free += 1
+    """)
+    assert new == []
+
+
+def test_lock_guard_ignores_wrong_lock(tmp_path):
+    new = lint_src(tmp_path, "pkg/pub.py", _LOCK_CLASS + """
+        def publish(self, snap, other_lock):
+            with other_lock:
+                self._latest = snap
+    """)
+    assert "LOCK-GUARD" in rules_of(new)
+
+
+# ---------------------------------------------------------------------------
+# KNOB-DOC
+
+def test_knob_doc_trips_without_entry(tmp_path):
+    new = lint_src(tmp_path, "pkg/mod.py", """
+    def setup(config):
+        return config.get_or("fancy", "speed", 3).to_int32()
+    """, ops="# Operations\n\nnothing here\n")
+    assert rules_of(new) == {"KNOB-DOC"}
+    assert "[fancy] speed" in new[0].message
+
+
+def test_knob_doc_passes_with_entry_and_tracks_alias(tmp_path):
+    new = lint_src(tmp_path, "pkg/mod.py", """
+    def setup(config):
+        g = config.get_or
+        a = g("fancy", "speed", 3).to_int32()
+        b = config.get("fancy", "mode")
+        return a, b
+    """, ops="| `[fancy] speed` | 3 | x |\n`[fancy] mode` docs\n")
+    assert new == []
+
+
+def test_knob_doc_ignores_plain_dict_get(tmp_path):
+    new = lint_src(tmp_path, "pkg/mod.py", """
+    def lookup(meta):
+        return meta.get("query_field", "vectors")
+    """, ops="")
+    assert "KNOB-DOC" not in rules_of(new)
+
+
+# ---------------------------------------------------------------------------
+# suppression + baseline semantics
+
+def test_line_suppression(tmp_path):
+    new = lint_src(tmp_path, "pkg/serve/reader.py", """
+    import jax.numpy as jnp  # smtpu-lint: disable=READER-PURE-HOST
+
+    def f(x):
+        return jnp.sum(x)    # smtpu-lint: disable=READER-PURE-HOST
+    """)
+    assert new == []
+
+
+def test_block_suppression_covers_def_body(tmp_path):
+    new = lint_src(tmp_path, "pkg/serve/reader.py", """
+    def f(x):  # smtpu-lint: disable=READER-PURE-HOST
+        import jax.numpy as jnp
+        return jnp.sum(x)
+
+    def g(x):
+        import jax.numpy as jnp
+        return jnp.sum(x)
+    """)
+    assert rules_of(new) == {"READER-PURE-HOST"}
+    assert all(f.line >= 6 for f in new)       # only g() trips
+
+
+def test_file_suppression(tmp_path):
+    new = lint_src(tmp_path, "pkg/serve/reader.py", """
+    # smtpu-lint: disable-file=READER-PURE-HOST
+    import jax.numpy as jnp
+
+    def f(x):
+        return jnp.sum(x)
+    """)
+    assert new == []
+
+
+def test_suppression_is_per_rule(tmp_path):
+    new = lint_src(tmp_path, "pkg/io/pipeline.py", """
+    import jax
+
+    def produce(key, x):
+        k = jax.random.split(key)  # smtpu-lint: disable=PRODUCER-NO-DEVICE
+        return k, x
+    """)
+    # suppressing the WRONG rule leaves the real finding standing
+    assert "PRODUCER-NO-RNG" in rules_of(new)
+
+
+def test_baseline_roundtrip_and_line_drift(tmp_path):
+    src = """
+    import jax.numpy as jnp
+    """
+    p = tmp_path / "pkg" / "serve" / "reader.py"
+    p.parent.mkdir(parents=True)
+    p.write_text(textwrap.dedent(src))
+    new, old = core.run_lint(paths=[str(p)], root=str(tmp_path))
+    assert len(new) == 1 and old == []
+
+    bl_path = tmp_path / core.BASELINE_NAME
+    core.write_baseline(str(bl_path), new, justification="fixture")
+    bl = core.load_baseline(str(bl_path))
+    assert set(bl) == {new[0].fingerprint}
+
+    # same finding now lands in `baselined`, even after line drift
+    p.write_text("# a new leading comment\n" + textwrap.dedent(src))
+    new2, old2 = core.run_lint(paths=[str(p)], root=str(tmp_path),
+                               baseline=bl)
+    assert new2 == [] and len(old2) == 1
+    assert old2[0].fingerprint == new[0].fingerprint
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    new = lint_src(tmp_path, "pkg/broken.py", """
+    def f(:
+    """)
+    assert [f.rule for f in new] == ["PARSE"]
+
+
+# ---------------------------------------------------------------------------
+# CLI: JSON schema + exit codes
+
+def test_cli_json_schema_and_exit_codes(tmp_path, capsys):
+    p = tmp_path / "pkg" / "serve" / "reader.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import jax.numpy as jnp\n")
+    out_json = tmp_path / "report.json"
+
+    rc = lint_main(["--root", str(tmp_path), "--format", "json",
+                    "--out", str(out_json), str(p)])
+    assert rc == 1
+    payload = json.loads(capsys.readouterr().out)
+    assert payload["schema"] == core.JSON_SCHEMA
+    assert payload["counts"] == {"new": 1, "baselined": 0}
+    f = payload["new"][0]
+    assert set(f) == {"rule", "path", "line", "col", "message",
+                      "fingerprint"}
+    assert f["rule"] == "READER-PURE-HOST"
+    # --out archive matches stdout
+    assert json.loads(out_json.read_text()) == payload
+
+    p.write_text("import numpy as np\n")
+    rc = lint_main(["--root", str(tmp_path), str(p)])
+    assert rc == 0
+
+
+def test_cli_write_baseline(tmp_path, capsys):
+    p = tmp_path / "pkg" / "serve" / "reader.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import jax.numpy as jnp\n")
+    rc = lint_main(["--root", str(tmp_path), "--write-baseline",
+                    str(p)])
+    assert rc == 0
+    bl = json.loads((tmp_path / core.BASELINE_NAME).read_text())
+    assert bl["schema"] == core.JSON_SCHEMA
+    assert len(bl["findings"]) == 1
+    # with the baseline in place the same lint run is clean
+    rc = lint_main(["--root", str(tmp_path), str(p)])
+    assert rc == 0
+
+
+# ---------------------------------------------------------------------------
+# the gate itself
+
+def test_repo_is_lint_clean():
+    """The repo must lint clean against its checked-in baseline — this
+    assertion IS the tier-1 gate's contract."""
+    root = core.repo_root()
+    baseline = core.load_baseline(
+        str(__import__("os").path.join(root, core.BASELINE_NAME)))
+    new, _ = core.run_lint(root=root, baseline=baseline)
+    assert new == [], "\n".join(f.render() for f in new)
+
+
+def test_every_rule_has_a_fixture():
+    """Each registered rule id appears in at least one test above."""
+    import swiftmpi_tpu.analysis.rules as rules_mod
+    src = open(__file__, encoding="utf-8").read()
+    for rule in rules_mod.RULES:
+        assert rule.id in src, f"no fixture exercises {rule.id}"

@@ -1,0 +1,70 @@
+"""Native TSan lane: hammer SmtpuPrefetcher's producer/consumer queue
+under ThreadSanitizer (ISSUE 11).  The C++ loader is the one component
+whose races no amount of JAX purity can absorb — this is the pytest
+face of `make -C native tsan`, capability-probed so containers without
+a TSan-capable toolchain skip instead of fail.
+"""
+
+import os
+import shutil
+import subprocess
+import tempfile
+
+import pytest
+
+NATIVE = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "native")
+
+_PROBE_SRC = """
+#include <thread>
+int x;
+int main() { std::thread t([]{ x = 1; }); t.join(); return x - 1; }
+"""
+
+
+def _cxx():
+    return os.environ.get("CXX") or shutil.which("g++") or \
+        shutil.which("clang++")
+
+
+def _tsan_capable(cxx: str) -> bool:
+    """Compile-and-run a trivial threaded program under -fsanitize=thread;
+    any failure (unsupported flag, missing runtime lib, blocked ptrace)
+    means skip, not fail."""
+    with tempfile.TemporaryDirectory() as td:
+        src = os.path.join(td, "probe.cpp")
+        exe = os.path.join(td, "probe")
+        with open(src, "w") as f:
+            f.write(_PROBE_SRC)
+        try:
+            r = subprocess.run(
+                [cxx, "-fsanitize=thread", "-O1", "-std=c++17", src,
+                 "-o", exe],
+                capture_output=True, timeout=120)
+            if r.returncode != 0:
+                return False
+            r = subprocess.run([exe], capture_output=True, timeout=60)
+            return r.returncode == 0
+        except (OSError, subprocess.TimeoutExpired):
+            return False
+
+
+def test_prefetcher_clean_under_tsan():
+    cxx = _cxx()
+    if cxx is None:
+        pytest.skip("no C++ compiler")
+    if not _tsan_capable(cxx):
+        pytest.skip("toolchain cannot build/run -fsanitize=thread")
+    build = subprocess.run(
+        ["make", "-C", NATIVE, "tsan"],
+        capture_output=True, text=True, timeout=300)
+    assert build.returncode == 0, build.stderr[-2000:]
+    env = dict(os.environ,
+               TSAN_OPTIONS="halt_on_error=0 exitcode=66")
+    run = subprocess.run(
+        [os.path.join(NATIVE, "tsan_prefetcher")],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert run.returncode == 0, (
+        f"rc={run.returncode} (66 = TSan-detected race)\n"
+        f"{run.stdout[-1000:]}\n{run.stderr[-4000:]}")
+    assert "ok (" in run.stdout

@@ -1,0 +1,61 @@
+"""Retrace-count guard (ISSUE 11 satellite): the w2v fused-scan hot
+loop must compile a bounded number of times — ≤1 trace per declared
+variant (one fused fn per distinct group length), and re-running
+training must hit the jit cache, not retrace.  Pins the PR-4 "jit
+cached per-sharding" class: a shape/dtype/sharding leak in the carry
+would show up here as cache growth before it shows up as a slow run.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+from swiftmpi_tpu.models.word2vec import Word2Vec
+from swiftmpi_tpu.utils.config import ConfigParser
+from tests.test_word2vec import make_model, synthetic_corpus
+
+
+def _cache_sizes(model):
+    """jit-cache entry count per fused group length."""
+    return {k: f._cache_size() for k, f in model._fused_cache.items()}
+
+
+def test_fused_scan_traces_bounded():
+    model = make_model(worker={"minibatch": 512, "inner_steps": 4})
+    corpus = synthetic_corpus(60, vocab_size=100, length=18, seed=2)
+    model.train(corpus, niters=2, batch_size=512)
+
+    sizes = _cache_sizes(model)
+    assert sizes, "fused path did not engage (inner_steps=4)"
+    # one trace per declared variant: each cached fused fn was built
+    # for exactly one group length, so its jit cache holds ≤1 entry
+    for n_inner, n_traces in sizes.items():
+        assert n_traces <= 1, (
+            f"fused fn for group length {n_inner} traced "
+            f"{n_traces} times — carry shape/dtype is leaking into "
+            "the jit key (PR-4 retrace class)")
+
+    # a second pass over the same corpus must be cache-hits only
+    model.train(corpus, niters=1, batch_size=512)
+    sizes2 = _cache_sizes(model)
+    for n_inner, n_traces in sizes2.items():
+        assert n_traces <= 1, (
+            f"second epoch retraced group length {n_inner} "
+            f"({n_traces} cache entries)")
+
+
+def test_step_trace_count_stable_across_epochs():
+    model = make_model()
+    corpus = synthetic_corpus(40, vocab_size=80, length=12, seed=3)
+    model.train(corpus, niters=1, batch_size=256)
+    step = model._step
+    if not hasattr(step, "_cache_size"):
+        return  # unfused path wraps differently on this jax version
+    first = step._cache_size()
+    assert first >= 1
+    model.train(corpus, niters=2, batch_size=256)
+    assert model._step is step or True  # train may rebuild; guard below
+    if model._step is step:
+        assert step._cache_size() == first, (
+            f"step retraced across epochs: {first} -> "
+            f"{step._cache_size()}")
