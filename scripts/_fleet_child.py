@@ -1,0 +1,73 @@
+#!/usr/bin/env python
+"""Fleet-smoke worker: a telemetry-armed step loop for launch.py drills.
+
+The 4-process fleet smoke (scripts/fleet_smoke.py, tests/test_fleet.py)
+needs children that exercise the whole per-rank telemetry surface —
+step records, spans, wire counters, heartbeats, fault injection — while
+needing NOTHING cross-process: no jax.distributed init, no collectives.
+That keeps the smoke's capability probe down to "can this container
+spawn subprocesses", instead of the much rarer "do cross-process
+collectives work here".
+
+Each rank runs ``SMTPU_FLEET_STEPS`` steps of ``SMTPU_FLEET_STEP_S``
+seconds of (slept) dispatch work, booking rank-skewed wire traffic —
+rank r books ``1000 * (r + 1)`` bytes/step, so the fleet's
+``wire_bytes_imbalance`` is deterministic and nonzero — and calls the
+fault bus at the top of every step, which is where a launcher-installed
+``SMTPU_FAULT_PLAN`` (hang / kill drills) fires.  Telemetry lands in
+``SMTPU_FLEET_DIR`` (obs.configure's fleet redirect); heartbeat cadence
+comes from ``SMTPU_FLEET_HB_S``.
+
+Prints ``FLEET_CHILD_OK rank=<r> steps=<n>`` on a clean finish.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# launched as `python scripts/_fleet_child.py`: sys.path[0] is scripts/,
+# so the package root must be added by hand
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from swiftmpi_tpu import obs                          # noqa: E402
+from swiftmpi_tpu.testing import faults              # noqa: E402
+from swiftmpi_tpu.utils.config import ConfigParser   # noqa: E402
+
+
+def main() -> int:
+    steps = int(os.environ.get("SMTPU_FLEET_STEPS", "60"))
+    step_s = float(os.environ.get("SMTPU_FLEET_STEP_S", "0.02"))
+    hb_s = float(os.environ.get("SMTPU_FLEET_HB_S", "0.25"))
+
+    cfg = ConfigParser().update({
+        "worker": {"telemetry": 1},
+        "obs": {"heartbeat_s": hb_s},
+    })
+    rec = obs.configure(cfg, run="fleet_child")
+    if rec is None:
+        print("fleet_child: telemetry failed to arm", file=sys.stderr)
+        return 2
+    rank = obs.process_rank() or 0
+    reg = obs.get_registry()
+
+    for step in range(steps):
+        faults.step_event(step)         # hang/kill drills fire here
+        with obs.span("dispatch"):
+            time.sleep(step_s)
+        reg.counter("transfer/wire_bytes",
+                    backend="xla").inc(1000 * (rank + 1))
+        reg.counter("transfer/dispatches", backend="xla").inc(1)
+        reg.counter("transfer/window_fmt", backend="xla",
+                    fmt="sparse").inc(1)
+        obs.record_step(1)
+
+    rec.close()
+    print(f"FLEET_CHILD_OK rank={rank} steps={steps}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

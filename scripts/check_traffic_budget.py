@@ -51,7 +51,8 @@ import sys
 TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
                    "dispatches_per_window", "stall_ms_per_step",
                    "kernel_ms", "serve_p99_ms", "serve_miss_ratio",
-                   "pull_bytes_per_step", "control_decisions_per_1k_steps")
+                   "pull_bytes_per_step", "control_decisions_per_1k_steps",
+                   "fleet_step_ms_skew_pct", "fleet_wire_bytes_imbalance")
 DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "window_fmt_sparse", "window_fmt_q",
                   "window_fmt_bitmap", "wire_quant", "coalesce_ratio",
@@ -60,7 +61,9 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "hit_ratio", "streams", "snapshots",
                   "staleness_bound_steps", "pull_hot_rows",
                   "control_applied", "control_evaluations",
-                  "steps_to_reconverge", "recompiles", "hot_k")
+                  "steps_to_reconverge", "recompiles", "hot_k",
+                  "straggler_rank", "members_dead", "unnoticed_deaths",
+                  "fleet_restarts", "aligned_steps")
 #: absolute increase a metric must clear before it can regress: wall-
 #: clock metrics jitter run to run while the counter metrics are exact,
 #: so only the former get a floor (ms for the stall split; kernel_ms is
@@ -73,7 +76,15 @@ ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1, "kernel_ms": 0.05,
                    # a quiet baseline (0 decisions) must tolerate the
                    # occasional legitimate retune; only a flapping tuner
                    # (> 2 decisions per 1k steps above baseline) fails
-                   "control_decisions_per_1k_steps": 2.0}
+                   "control_decisions_per_1k_steps": 2.0,
+                   # cross-rank skew is OS-scheduler wall-clock noise on
+                   # the shared dev host the fleet smoke runs on; only a
+                   # persistent straggler-scale widening (> 15 points of
+                   # the median step time) is a real fleet regression,
+                   # and a wire-imbalance wobble under 0.2 (max/mean-1)
+                   # is batch-composition variance, not a placement bug
+                   "fleet_step_ms_skew_pct": 15.0,
+                   "fleet_wire_bytes_imbalance": 0.2}
 
 
 def load_telemetry_cells(path: str) -> dict:
@@ -133,19 +144,57 @@ def load_telemetry_cells(path: str) -> dict:
     return cells
 
 
-def _is_telemetry(path: str) -> bool:
-    """Sniff the first line for the StepRecorder schema tag — content,
-    not file extension, decides (bench caches are also .json)."""
+def load_fleet_cells(path: str) -> dict:
+    """Aggregate a merged ``smtpu-fleet/1`` timeline (obs.FleetCollector
+    output) into one bench-shaped cell keyed by the fleet run name: the
+    skew/imbalance gate metrics plus the health details the
+    unnoticed-death hard gate reads."""
+    from telemetry_report import load_fleet
+
+    doc = load_fleet(path)   # SystemExit(2) on unreadable/bad schema
+    s = doc.get("summary")
+    if not s:
+        return {}
+    health = s.get("health") or {}
+    cell = {
+        "fleet_step_ms_skew_pct": float(
+            s.get("fleet_step_ms_skew_pct", 0.0)),
+        "fleet_wire_bytes_imbalance": float(
+            s.get("fleet_wire_bytes_imbalance", 0.0)),
+        "aligned_steps": s.get("aligned_steps", 0),
+        "members_dead": sum(1 for v in health.values() if v == "dead"),
+        "fleet_restarts": sum((s.get("restarts") or {}).values()),
+        "unnoticed_deaths": len(s.get("unnoticed_deaths") or ()),
+    }
+    if s.get("straggler_rank") is not None:
+        cell["straggler_rank"] = s["straggler_rank"]
+    run = str(doc["meta"].get("run", "fleet"))
+    return {run: cell}
+
+
+def _sniff_schema(path: str, prefix: str) -> bool:
+    """Content, not file extension, decides (bench caches are also
+    .json): does the first line carry the given schema tag?"""
     try:
         with open(path) as f:
             head = json.loads(f.readline() or "null")
         return isinstance(head, dict) and str(
-            head.get("schema", "")).startswith("smtpu-telemetry/")
+            head.get("schema", "")).startswith(prefix)
     except (OSError, ValueError):
         return False
 
 
+def _is_telemetry(path: str) -> bool:
+    return _sniff_schema(path, "smtpu-telemetry/")
+
+
+def _is_fleet(path: str) -> bool:
+    return _sniff_schema(path, "smtpu-fleet/")
+
+
 def load_cells(path: str) -> dict:
+    if _is_fleet(path):
+        return load_fleet_cells(path)
     if _is_telemetry(path):
         return load_telemetry_cells(path)
     try:
@@ -211,6 +260,21 @@ def decision_mix_violations(cells: dict) -> list:
     return bad
 
 
+def fleet_violations(cells: dict) -> list:
+    """Candidate cells where a member died UNNOTICED — heartbeat gap
+    says dead, supervisor log has no exit event.  That is not a
+    performance number to tolerance-check; it means the fleet lost a
+    rank and the observability layer was the only thing that caught it,
+    so the run fails outright (the decision-mix pattern: a hard
+    candidate-side property, not a baseline comparison)."""
+    bad = []
+    for cell, m in sorted(cells.items()):
+        n = m.get("unnoticed_deaths")
+        if n is not None and float(n) > 0:
+            bad.append((cell, int(n)))
+    return bad
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fail when bench traffic counters regressed")
@@ -262,6 +326,15 @@ def main(argv=None) -> int:
         for cell, quant, total in mix:
             print(f"  {cell}: wire_quant={quant} with {total:g} window "
                   "decisions but zero sparse_q/bitmap picks")
+        return 1
+
+    deaths = fleet_violations(
+        {c: m for c, m in cand.items() if not only or c in only})
+    if deaths:
+        print("FLEET UNNOTICED-DEATH FAILURE:")
+        for cell, n in deaths:
+            print(f"  {cell}: {n} member(s) went silent past the dead "
+                  "threshold with NO supervisor exit event")
         return 1
 
     regressions = compare(base, cand, args.tolerance, only)

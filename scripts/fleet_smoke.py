@@ -1,0 +1,143 @@
+#!/usr/bin/env python
+"""4-process fleet observability smoke (ISSUE 12 acceptance drill).
+
+Launches 4 ``_fleet_child.py`` ranks under ``swiftmpi_tpu.launch`` with
+a fleet directory and an injected-stall FaultPlan (rank 1 hangs ~6x the
+stall threshold mid-run), then merges the world with a FleetCollector
+and checks the cross-rank story end-to-end:
+
+* one merged ``smtpu-fleet/1`` timeline (``fleet.jsonl``) exists and
+  carries all 4 members;
+* the hung rank is flagged as the fleet straggler (correct attribution)
+  with at least one recorded stall episode;
+* wire imbalance is nonzero (children book rank-skewed traffic);
+* every member reached a clean exit (supervisor exit events, rc 0).
+
+Capability-probed: containers that cannot spawn subprocesses (or where
+the launcher cannot run) print ``FLEET_SMOKE SKIP: <reason>`` and exit
+0, the same convention as the multiprocess pytest markers — CI treats
+a skip as advisory, never as a pass.  Exit 1 = the world ran but the
+fleet story is wrong, which IS a failure worth looking at.
+
+Usage::
+
+    python scripts/fleet_smoke.py --out runs/fleet_smoke
+    python scripts/fleet_smoke.py --out /tmp/f --steps 40 --json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)
+
+from swiftmpi_tpu import launch as smtpu_launch          # noqa: E402
+from swiftmpi_tpu.obs.collector import FleetCollector    # noqa: E402
+from swiftmpi_tpu.testing.faults import FaultPlan        # noqa: E402
+
+
+def _probe(timeout_s: float = 60.0) -> str:
+    """'' when this container can spawn a python child that imports the
+    package; else the reason to skip."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import swiftmpi_tpu; print('ok')"],
+            capture_output=True, text=True, timeout=timeout_s,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"}, cwd=_REPO)
+    except (OSError, subprocess.TimeoutExpired) as e:
+        return f"cannot spawn python subprocess: {e}"
+    if r.returncode != 0 or "ok" not in r.stdout:
+        return (f"child import failed rc={r.returncode}: "
+                f"{(r.stderr or r.stdout).strip()[:200]}")
+    return ""
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description="4-process fleet smoke")
+    ap.add_argument("--out", default="runs/fleet_smoke",
+                    help="fleet directory (created; default "
+                         "runs/fleet_smoke)")
+    ap.add_argument("--np", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=60)
+    ap.add_argument("--step-s", type=float, default=0.02)
+    ap.add_argument("--hang-rank", type=int, default=1)
+    ap.add_argument("--hang-s", type=float, default=1.2)
+    ap.add_argument("--stall-after", type=float, default=0.8)
+    ap.add_argument("--json", action="store_true",
+                    help="dump the fleet summary as JSON")
+    args = ap.parse_args(argv)
+
+    reason = _probe()
+    if reason:
+        print(f"FLEET_SMOKE SKIP: {reason}")
+        return 0
+
+    fleet_dir = os.path.abspath(args.out)
+    os.makedirs(fleet_dir, exist_ok=True)
+    plan = FaultPlan().hang_at_step(5, seconds=args.hang_s,
+                                    rank=args.hang_rank)
+    os.environ["SMTPU_FAULT_PLAN"] = plan.to_json()
+    os.environ["SMTPU_FLEET_STEPS"] = str(args.steps)
+    os.environ["SMTPU_FLEET_STEP_S"] = str(args.step_s)
+    os.environ["SMTPU_FLEET_HB_S"] = "0.25"
+    t0 = time.time()
+    rc = smtpu_launch.supervise(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "_fleet_child.py")],
+        nprocs=args.np, cpu_devices=1, fleet_dir=fleet_dir)
+    elapsed = time.time() - t0
+    if rc != 0:
+        print(f"FLEET_SMOKE FAIL: world exited rc={rc}")
+        return 1
+
+    fc = FleetCollector(fleet_dir, stall_after_s=args.stall_after,
+                        dead_after_s=4 * args.stall_after)
+    fc.poll(final=True)
+    timeline = fc.write_timeline()
+    s = fc.summary()
+    failures = []
+    if sorted(s["ranks"]) != [str(r) for r in range(args.np)]:
+        failures.append(f"expected {args.np} members, got {s['ranks']}")
+    hung = str(args.hang_rank)
+    if s["straggler_rank"] != hung:
+        failures.append(f"straggler attribution wrong: expected rank "
+                        f"{hung}, got {s['straggler_rank']}")
+    members = fc.members()
+    if hung in members and not fc.stall_episodes(members[hung]):
+        failures.append(f"no stall episode recorded on rank {hung}")
+    if s["fleet_wire_bytes_imbalance"] <= 0:
+        failures.append("wire imbalance is zero despite rank-skewed "
+                        "children")
+    bad_health = {k: v for k, v in s["health"].items() if v != "exited"}
+    if bad_health:
+        failures.append(f"members not cleanly exited: {bad_health}")
+    if s["unnoticed_deaths"]:
+        failures.append(f"unnoticed deaths: {s['unnoticed_deaths']}")
+
+    if args.json:
+        json.dump(s, sys.stdout, indent=2, default=str)
+        print()
+    else:
+        print(f"fleet smoke: {args.np} ranks x {args.steps} steps in "
+              f"{elapsed:.1f}s -> {timeline}")
+        print(f"  straggler=rank {s['straggler_rank']} "
+              f"(score {s['straggler_score']:.2f}x)  "
+              f"skew_p50={s['fleet_step_ms_skew_ms']:.1f}ms  "
+              f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}  "
+              f"health={s['health']}")
+    if failures:
+        for f in failures:
+            print(f"FLEET_SMOKE FAIL: {f}")
+        return 1
+    print("FLEET_SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

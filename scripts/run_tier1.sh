@@ -56,6 +56,23 @@ if printf 'int main(){return 0;}' | ${CXX:-g++} -fsanitize=thread -x c++ - -o /t
   fi
 fi
 rm -f /tmp/_tsan_probe
+# Advisory 4-process fleet observability smoke (ISSUE 12): launches 4
+# _fleet_child ranks with an injected stall, merges them with a
+# FleetCollector, and checks straggler attribution + member health.
+# Capability-probed inside fleet_smoke.py (prints FLEET_SMOKE SKIP with
+# the reason and exits 0 where subprocess spawning is unavailable).
+# Artifacts (per-rank streams + supervisor.jsonl + merged fleet.jsonl)
+# land under runs/ next to the lint report, followed by an advisory
+# `telemetry_report.py --fleet` read of the merged timeline.
+FLEET_OUT="$REPO_DIR/runs/fleet_$(date +%Y%m%d_%H%M%S)"
+echo "--- fleet smoke (advisory) ---"
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.py" --out "$FLEET_OUT"; then
+  if [ -r "$FLEET_OUT/fleet.jsonl" ]; then
+    python "$(dirname "$0")/telemetry_report.py" --fleet "$FLEET_OUT/fleet.jsonl" || echo "fleet report ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
+else
+  echo "fleet smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
+fi
 # Advisory calibration staleness check: verdicts recorded under another
 # jaxlib/libtpu stack no longer steer data-plane gates — say so next to
 # the verdict (exit code unchanged; the CLI always exits 0).

@@ -1,0 +1,206 @@
+#!/usr/bin/env python
+"""Live fleet run inspector — ``top`` for a launch.py world.
+
+Points a :class:`~swiftmpi_tpu.obs.collector.FleetCollector` at a fleet
+directory (the ``launch.py -fleet-dir`` target) and renders one row per
+rank: health, step progress and rate, phase p50/p95, wire traffic and
+decision mix, restart count, and a STRAGGLER flag from the collector's
+cross-rank attribution.  Refreshes in place until interrupted; the
+``--once`` mode renders a single frame and exits — that is what tests
+and CI call, and it works post-hoc on a finished run's directory
+(health is evaluated at the run's own end, see FleetCollector.now).
+
+Usage::
+
+    python scripts/smtpu_top.py runs/fleet_dev            # refresh loop
+    python scripts/smtpu_top.py runs/fleet_dev --once     # one frame
+    python scripts/smtpu_top.py runs/fleet_dev --once --json
+    python scripts/smtpu_top.py runs/fleet_dev --stall-after 2 \
+        --dead-after 8 --interval 1.0
+
+Unlike telemetry_report.py this DOES import the repo (it runs on the
+host that ran the fleet); the off-host analysis story stays with
+``telemetry_report.py --fleet``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+# launched as `python scripts/smtpu_top.py`: sys.path[0] is scripts/,
+# so the package root must be added by hand
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from swiftmpi_tpu.obs.collector import FleetCollector        # noqa: E402
+from swiftmpi_tpu.obs.registry import (parse_series_key,     # noqa: E402
+                                       quantile_from_buckets)
+
+_HEALTH_ORDER = {"live": 0, "stalled": 1, "exited": 2, "dead": 3}
+
+
+def _member_phases(member: dict) -> dict:
+    """Aggregate ``phase_ms`` buckets across one member's records
+    (bounds ride the first appearance of each series, recorder.py)."""
+    acc = {}
+    for s in member["_streams"]:
+        for rec in s.records:
+            for key, h in (rec.get("hists") or {}).items():
+                name, labels = parse_series_key(key)
+                if name != "phase_ms":
+                    continue
+                a = acc.setdefault(labels.get("phase", "?"),
+                                   {"bounds": None, "counts": None})
+                if h.get("bounds") is not None:
+                    a["bounds"] = list(h["bounds"])
+                counts = h.get("counts") or []
+                if a["counts"] is None:
+                    a["counts"] = list(counts)
+                else:
+                    for i, c in enumerate(counts):
+                        a["counts"][i] += c
+    out = {}
+    for phase, a in acc.items():
+        if a["bounds"] is None or not a["counts"]:
+            continue
+        out[phase] = {
+            "p50_ms": quantile_from_buckets(a["bounds"], a["counts"],
+                                            0.50),
+            "p95_ms": quantile_from_buckets(a["bounds"], a["counts"],
+                                            0.95)}
+    return out
+
+
+def _member_fmt_mix(member: dict) -> dict:
+    """Wire decision mix: total window_fmt picks per fmt label (with
+    the legacy 2-way counters folded in when the 4-way is absent)."""
+    mix = {}
+    legacy = {}
+    for s in member["_streams"]:
+        for rec in s.records:
+            for key, delta in (rec.get("counters") or {}).items():
+                name, labels = parse_series_key(key)
+                if name == "transfer/window_fmt":
+                    f = labels.get("fmt", "?")
+                    mix[f] = mix.get(f, 0) + int(delta)
+                elif name in ("transfer/window_sparse",
+                              "transfer/window_dense"):
+                    f = name[len("transfer/window_"):]
+                    legacy[f] = legacy.get(f, 0) + int(delta)
+    return mix or legacy
+
+
+def frame(fc: FleetCollector) -> dict:
+    """One machine-shaped inspector frame (the --json payload)."""
+    members = fc.members()
+    summary = fc.summary()
+    health = summary["health"]
+    rows = []
+    for key in sorted(members, key=lambda k: (len(k), k)):
+        m = members[key]
+        span_s = max((m["last_seen"] or 0.0) - (m["first_seen"] or 0.0),
+                     1e-9)
+        per = fc._per_step(m)
+        step_ms = sorted(v[1] for v in per.values() if v[1] > 0)
+        rows.append({
+            "rank": key,
+            "ident": m["ident"],
+            "pid": m["pids"][-1] if m["pids"] else None,
+            "health": health.get(key, "?"),
+            "step": m["last_step"],
+            "steps_per_s": (m["last_step"] or 0) / span_s,
+            "step_ms_p50": step_ms[len(step_ms) // 2] if step_ms else 0.0,
+            "step_ms_p95": step_ms[min(int(0.95 * len(step_ms)),
+                                       len(step_ms) - 1)]
+            if step_ms else 0.0,
+            "phases": _member_phases(m),
+            "wire_bytes": summary["wire_bytes"].get(key, 0.0),
+            "fmt_mix": _member_fmt_mix(m),
+            "restarts": m["restarts"],
+            "heartbeats": m["heartbeats"],
+            "stalls": len(fc.stall_episodes(m)),
+            "straggler": key == summary["straggler_rank"],
+        })
+    rows.sort(key=lambda r: (_HEALTH_ORDER.get(r["health"], 9),
+                             r["rank"]))
+    return {"summary": summary, "members": rows}
+
+
+def render(fr: dict) -> str:
+    s = fr["summary"]
+    lines = [
+        f"fleet {s['run']}  ranks={len(s['ranks'])}  "
+        f"aligned_steps={s['aligned_steps']}  "
+        f"skew_p50={s['fleet_step_ms_skew_ms']:.1f}ms "
+        f"({s['fleet_step_ms_skew_pct']:.1f}%)  "
+        f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}",
+        f"{'RANK':<6}{'PID':>8}{'HEALTH':>9}{'STEP':>7}{'ST/S':>8}"
+        f"{'P50MS':>8}{'P95MS':>8}{'WIRE':>12}{'HB':>5}{'RST':>4}  "
+        f"FMT-MIX / FLAGS",
+    ]
+    for r in fr["members"]:
+        mix = ",".join(f"{k}:{v}" for k, v in sorted(r["fmt_mix"].items()))
+        flags = []
+        if r["straggler"]:
+            flags.append("STRAGGLER")
+        if r["stalls"]:
+            flags.append(f"stalls={r['stalls']}")
+        lines.append(
+            f"{r['rank']:<6}{r['pid'] or 0:>8}{r['health']:>9}"
+            f"{r['step'] if r['step'] is not None else '-':>7}"
+            f"{r['steps_per_s']:>8.2f}{r['step_ms_p50']:>8.1f}"
+            f"{r['step_ms_p95']:>8.1f}{r['wire_bytes']:>12,.0f}"
+            f"{r['heartbeats']:>5}{r['restarts']:>4}  "
+            f"{mix or '-'}"
+            + (("  " + " ".join(flags)) if flags else ""))
+    if s["unnoticed_deaths"]:
+        lines.append(f"!! UNNOTICED DEATHS: {s['unnoticed_deaths']}")
+    if s["straggler_rank"] is not None:
+        lines.append(f"straggler: rank {s['straggler_rank']} "
+                     f"({s['straggler_score']:.2f}x median step time)")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="per-rank live view over a fleet telemetry dir")
+    ap.add_argument("fleet_dir", help="launch.py -fleet-dir target")
+    ap.add_argument("--once", action="store_true",
+                    help="render one frame and exit (tests/CI)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit the frame as JSON instead of a table")
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh period seconds (default 2)")
+    ap.add_argument("--stall-after", type=float, default=5.0,
+                    help="proof-of-life gap that flags a stall")
+    ap.add_argument("--dead-after", type=float, default=15.0,
+                    help="trailing silence that flags a death")
+    args = ap.parse_args(argv)
+
+    fc = FleetCollector(args.fleet_dir, stall_after_s=args.stall_after,
+                        dead_after_s=args.dead_after)
+    if args.once:
+        fc.poll(final=True)
+        fr = frame(fc)
+        if args.json:
+            json.dump(fr, sys.stdout, indent=2, default=str)
+            print()
+        else:
+            print(render(fr))
+        return 0
+    try:
+        while True:
+            fc.poll()
+            sys.stdout.write("\x1b[2J\x1b[H" + render(frame(fc)) + "\n")
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

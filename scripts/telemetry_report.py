@@ -81,10 +81,58 @@ def _quantile(counts: List[int], bounds: List[float], q: float) -> float:
 
 
 # -- load -----------------------------------------------------------------
+def repair_json_line(line: str) -> Optional[dict]:
+    """Best-effort parse of a truncated JSON object line — the tail a
+    crashed rank left mid-``write``.  Balances an unterminated string
+    and unclosed brackets, retrying progressively shorter prefixes; a
+    twin of obs/collector.repair_json_line (this script must stay free
+    of repo imports) — keep the two in sync."""
+    s = line.strip()
+    if not s.startswith("{"):
+        return None
+    for cut in range(len(s), max(len(s) - 4096, 0), -1):
+        prefix = s[:cut]
+        stack: List[str] = []
+        in_str = esc = False
+        for ch in prefix:
+            if esc:
+                esc = False
+            elif ch == "\\":
+                esc = True
+            elif ch == '"':
+                in_str = not in_str
+            elif not in_str and ch in "{[":
+                stack.append(ch)
+            elif not in_str and ch in "}]":
+                if not stack:
+                    break
+                stack.pop()
+        else:
+            if esc:
+                continue
+            closed = prefix + ('"' if in_str else "")
+            for b in reversed(stack):
+                closed += "}" if b == "{" else "]"
+            try:
+                obj = json.loads(closed)
+            except ValueError:
+                continue
+            if isinstance(obj, dict):
+                return obj
+    return None
+
+
 def load(path: str) -> dict:
     """Parse the JSONL into {"meta", "steps": [...], "events": [...],
-    "summary"|None} — "events" collects the out-of-band ``control/*``
-    lines.  SystemExit(2) on unreadable / non-telemetry input."""
+    "heartbeats": n, "summary"|None, "recovery": {...}} — "events"
+    collects the out-of-band ``control/*`` lines.
+
+    Crashed-run tolerance: a truncated FINAL line is repair-parsed
+    (``recovery.recovered``); other undecodable lines are counted as
+    ``recovery.dropped`` instead of aborting, and a stream whose meta
+    line itself was lost still loads (meta synthesized) as long as the
+    surviving records look like telemetry.  SystemExit(2) only on
+    unreadable / empty / provably-not-telemetry input."""
     try:
         with open(path) as f:
             lines = [ln for ln in f if ln.strip()]
@@ -95,33 +143,60 @@ def load(path: str) -> dict:
     if not lines:
         print(f"telemetry_report: {path} is empty", file=sys.stderr)
         raise SystemExit(2)
-    try:
-        head = json.loads(lines[0])
-    except ValueError as e:
-        print(f"telemetry_report: {path}: bad JSON on line 1: {e}",
-              file=sys.stderr)
-        raise SystemExit(2)
-    if not str(head.get("schema", "")).startswith(SCHEMA_PREFIX):
-        print(f"telemetry_report: {path} is not a telemetry stream "
-              f"(schema={head.get('schema')!r})", file=sys.stderr)
-        raise SystemExit(2)
-    steps, events, summary = [], [], None
-    for n, ln in enumerate(lines[1:], start=2):
+    records: List[dict] = []
+    recovered = dropped = 0
+    last = len(lines) - 1
+    for n, ln in enumerate(lines):
         try:
             rec = json.loads(ln)
-        except ValueError as e:
-            print(f"telemetry_report: {path}: bad JSON on line {n}: {e}",
+        except ValueError:
+            if n == last:
+                rec = repair_json_line(ln)
+                if rec is not None:
+                    rec["repaired"] = True
+                    records.append(rec)
+                    recovered += 1
+                    continue
+            dropped += 1
+            print(f"telemetry_report: {path}: dropped bad JSON on "
+                  f"line {n + 1}", file=sys.stderr)
+            continue
+        if isinstance(rec, dict):
+            records.append(rec)
+        else:
+            dropped += 1
+    meta = next((r for r in records if r.get("kind") == "meta"), None)
+    if meta is not None and \
+            not str(meta.get("schema", "")).startswith(SCHEMA_PREFIX):
+        print(f"telemetry_report: {path} is not a telemetry stream "
+              f"(schema={meta.get('schema')!r})", file=sys.stderr)
+        raise SystemExit(2)
+    if meta is None:
+        # truncation ate the first line: accept the stream iff the
+        # surviving records carry the telemetry shape ("v" + step/...)
+        if not any(r.get("kind") in ("step", "summary", "heartbeat")
+                   and "v" in r for r in records):
+            print(f"telemetry_report: {path} is not a telemetry stream "
+                  f"(no meta line, no telemetry records)",
                   file=sys.stderr)
             raise SystemExit(2)
+        meta = {"schema": SCHEMA_PREFIX + "?", "run": "?",
+                "synthesized": True}
+    steps, events, summary = [], [], None
+    heartbeats = 0
+    for rec in records:
         kind = rec.get("kind")
         if kind == "step":
             steps.append(rec)
         elif kind == "summary":
             summary = rec
+        elif kind == "heartbeat":
+            heartbeats += 1
         elif isinstance(kind, str) and kind.startswith("control/"):
             events.append(rec)
-    return {"meta": head, "steps": steps, "events": events,
-            "summary": summary}
+    return {"meta": meta, "steps": steps, "events": events,
+            "heartbeats": heartbeats, "summary": summary,
+            "recovery": {"recovered": recovered, "dropped": dropped}}
 
 
 # -- analyses -------------------------------------------------------------
@@ -296,6 +371,9 @@ def report(doc: dict, phases_only: bool = False) -> dict:
     out = {"meta": {k: doc["meta"].get(k)
                     for k in ("schema", "run", "rank", "ident", "pid")},
            "phases": phase_table(doc)}
+    rec = doc.get("recovery") or {}
+    if rec.get("recovered") or rec.get("dropped"):
+        out["recovery"] = rec
     if not phases_only:
         out["wire_timeline"] = wire_timeline(doc)
         out["traffic"] = traffic_summary(doc)
@@ -304,11 +382,208 @@ def report(doc: dict, phases_only: bool = False) -> dict:
     return out
 
 
+# -- fleet mode (smtpu-fleet/1) -------------------------------------------
+FLEET_SCHEMA_PREFIX = "smtpu-fleet/"
+
+
+def load_fleet(path: str) -> dict:
+    """Load a merged ``smtpu-fleet/1`` timeline (obs.FleetCollector
+    output), or — given a fleet DIRECTORY — its ``fleet.jsonl`` when
+    present, else a lean standalone merge of the per-rank streams (no
+    repo imports, so this works off-host like the rest of the script).
+    """
+    import os
+    if os.path.isdir(path):
+        merged = os.path.join(path, "fleet.jsonl")
+        if os.path.isfile(merged):
+            path = merged
+        else:
+            return _merge_fleet_dir(path)
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if ln.strip()]
+    except OSError as e:
+        print(f"telemetry_report: cannot read {path}: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    doc = {"meta": None, "members": [], "sup": [], "health": [],
+           "rows": [], "summary": None}
+    for n, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            rec = repair_json_line(ln) if n == len(lines) - 1 else None
+            if rec is None:
+                continue
+        kind = rec.get("kind")
+        if kind == "meta":
+            doc["meta"] = rec
+        elif kind == "member":
+            doc["members"].append(rec)
+        elif isinstance(kind, str) and kind.startswith("sup/"):
+            doc["sup"].append(rec)
+        elif kind == "health":
+            doc["health"].append(rec)
+        elif kind == "fleet_step":
+            doc["rows"].append(rec)
+        elif kind == "summary":
+            doc["summary"] = rec
+    meta = doc["meta"]
+    if meta is None or \
+            not str(meta.get("schema", "")).startswith(
+                FLEET_SCHEMA_PREFIX):
+        print(f"telemetry_report: {path} is not a fleet timeline "
+              f"(schema="
+              f"{meta.get('schema') if meta else None!r})",
+              file=sys.stderr)
+        raise SystemExit(2)
+    return doc
+
+
+def _merge_fleet_dir(fleet_dir: str) -> dict:
+    """Per-rank merge from raw streams when no fleet.jsonl exists yet:
+    member rows + step-aligned skew, WITHOUT the collector's health
+    machine (no supervisor correlation off-host — run smtpu_top or the
+    collector on the host for that)."""
+    import glob
+    import os
+    paths = sorted(glob.glob(os.path.join(fleet_dir,
+                                          "telemetry_*.jsonl")))
+    if not paths:
+        print(f"telemetry_report: {fleet_dir}: no telemetry_*.jsonl "
+              f"streams", file=sys.stderr)
+        raise SystemExit(2)
+    members, per_rank = [], {}
+    for p in paths:
+        try:
+            d = load(p)
+        except SystemExit:
+            continue
+        m = d["meta"]
+        rank = str(m.get("rank") if m.get("rank") is not None
+                   else m.get("ident") or os.path.basename(p))
+        t0 = float(m.get("ts", 0.0))
+        steps = {int(r["step"]): t0 + float(r.get("t", 0.0))
+                 for r in d["steps"]}
+        prev = per_rank.setdefault(rank, {})
+        prev.update(steps)
+        members.append({
+            "kind": "member", "rank": rank, "ident": m.get("ident"),
+            "pids": [m.get("pid")], "restarts": 0,
+            "records": len(d["steps"]), "heartbeats": d["heartbeats"],
+            "last_step": max(steps, default=None),
+            "health": "exited" if d["summary"] is not None else "?",
+            "exits": [], "recovered": d["recovery"]["recovered"],
+            "dropped": d["recovery"]["dropped"]})
+    rows = []
+    common = None
+    for table in per_rank.values():
+        common = set(table) if common is None else common & set(table)
+    for step in sorted(common or ()):
+        t = {r: per_rank[r][step] for r in per_rank}
+        rows.append({"kind": "fleet_step", "step": step, "t": t,
+                     "step_ms": {}, "wire": {},
+                     "slowest": max(t, key=t.get)})
+    return {"meta": {"kind": "meta",
+                     "schema": FLEET_SCHEMA_PREFIX + "dir",
+                     "run": os.path.basename(
+                         os.path.normpath(fleet_dir)),
+                     "ranks": sorted(per_rank)},
+            "members": members, "sup": [], "health": [],
+            "rows": rows, "summary": None}
+
+
+def fleet_report(doc: dict) -> dict:
+    """Machine-shaped fleet report: member table, supervisor events,
+    compressed slowest-rank (skew) timeline, and the collector summary
+    when present."""
+    runs: List[dict] = []
+    for row in doc["rows"]:
+        slowest = row.get("slowest")
+        if slowest is None:
+            continue
+        step = int(row["step"])
+        if runs and runs[-1]["slowest"] == slowest:
+            runs[-1]["last"] = step
+            runs[-1]["rows"] += 1
+            runs[-1]["skew_ms_max"] = max(runs[-1]["skew_ms_max"],
+                                          float(row.get("skew_ms", 0.0)))
+        else:
+            runs.append({"slowest": slowest, "first": step,
+                         "last": step, "rows": 1,
+                         "skew_ms_max": float(row.get("skew_ms", 0.0))})
+    return {"meta": {k: doc["meta"].get(k)
+                     for k in ("schema", "run", "ranks")},
+            "members": doc["members"], "sup_events": doc["sup"],
+            "health_transitions": doc["health"],
+            "skew_timeline": runs, "summary": doc["summary"]}
+
+
+def _print_fleet_report(rep: dict) -> None:
+    m = rep["meta"]
+    print(f"fleet run={m.get('run')} schema={m.get('schema')} "
+          f"ranks={m.get('ranks')}")
+    print()
+    print("members:")
+    for mb in rep["members"]:
+        extra = ""
+        if mb.get("restarts"):
+            extra += f" restarts={mb['restarts']}"
+        if mb.get("recovered") or mb.get("dropped"):
+            extra += (f" recovered={mb.get('recovered', 0)}"
+                      f" dropped={mb.get('dropped', 0)}")
+        exits = mb.get("exits") or []
+        if exits:
+            e = exits[-1]
+            extra += (f" exit(rc={e.get('rc')}, by_supervisor="
+                      f"{e.get('by_supervisor')})")
+        print(f"  rank {mb['rank']}: {mb.get('health', '?'):8s}"
+              f" last_step={mb.get('last_step')}"
+              f" records={mb.get('records')}"
+              f" heartbeats={mb.get('heartbeats')}{extra}")
+    if rep["sup_events"]:
+        print()
+        print("supervisor events:")
+        for ev in rep["sup_events"]:
+            kind = str(ev.get("kind", "")).replace("sup/", "")
+            keys = ("rank", "pid", "rc", "by_supervisor", "attempt",
+                    "nprocs", "delay_s")
+            detail = " ".join(f"{k}={ev[k]}" for k in keys if k in ev)
+            print(f"  {kind}: {detail}")
+    print()
+    print("skew timeline (slowest rank per aligned interval):")
+    if not rep["skew_timeline"]:
+        print("  (no aligned steps — single member or no overlap)")
+    for run in rep["skew_timeline"]:
+        span = (f"step {run['first']}" if run["first"] == run["last"]
+                else f"steps {run['first']}-{run['last']}")
+        print(f"  {span}: rank {run['slowest']} slowest "
+              f"(max skew {run['skew_ms_max']:.1f}ms, "
+              f"{run['rows']} row(s))")
+    s = rep["summary"]
+    if s:
+        print()
+        print(f"fleet summary: aligned_steps={s.get('aligned_steps')} "
+              f"skew_p50={s.get('fleet_step_ms_skew_ms', 0.0):.1f}ms "
+              f"({s.get('fleet_step_ms_skew_pct', 0.0):.1f}%) "
+              f"wire_imbalance="
+              f"{s.get('fleet_wire_bytes_imbalance', 0.0):.3f}")
+        if s.get("straggler_rank") is not None:
+            print(f"  STRAGGLER: rank {s['straggler_rank']} "
+                  f"(score {s.get('straggler_score', 0.0):.2f}x median)")
+        if s.get("unnoticed_deaths"):
+            print(f"  UNNOTICED DEATHS: {s['unnoticed_deaths']}")
+
+
 # -- rendering ------------------------------------------------------------
 def _print_report(rep: dict) -> None:
     m = rep["meta"]
     print(f"run={m.get('run')} ident={m.get('ident')} "
           f"schema={m.get('schema')}")
+    if "recovery" in rep:
+        r = rep["recovery"]
+        print(f"crashed-run recovery: {r.get('recovered', 0)} record(s) "
+              f"repaired, {r.get('dropped', 0)} dropped")
     print()
     print("phase latency (ms):")
     if not rep["phases"]:
@@ -381,13 +656,27 @@ def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="per-phase latency, wire-format timeline and "
                     "traffic summary from a telemetry JSONL")
-    ap.add_argument("path", help="telemetry.jsonl from obs.StepRecorder")
+    ap.add_argument("path", help="telemetry.jsonl from obs.StepRecorder "
+                    "(or, with --fleet, a merged fleet.jsonl / a fleet "
+                    "directory)")
     ap.add_argument("--json", action="store_true",
                     help="emit the report as JSON instead of text")
     ap.add_argument("--phases-only", action="store_true",
                     help="only the per-phase latency table")
+    ap.add_argument("--fleet", action="store_true",
+                    help="treat path as an smtpu-fleet/1 merged "
+                    "timeline (or a fleet dir): per-rank columns, "
+                    "supervisor events, skew timeline")
     args = ap.parse_args(argv)
 
+    if args.fleet:
+        rep = fleet_report(load_fleet(args.path))
+        if args.json:
+            json.dump(rep, sys.stdout, indent=2)
+            print()
+        else:
+            _print_fleet_report(rep)
+        return 0
     rep = report(load(args.path), phases_only=args.phases_only)
     if args.json:
         json.dump(rep, sys.stdout, indent=2)

@@ -24,6 +24,11 @@ Environment contract (set by the launcher or the pod scheduler):
 * ``SMTPU_COORDINATOR``    — ``host:port`` of process 0's coordinator.
 * ``SMTPU_NUM_PROCESSES``  — world size.
 * ``SMTPU_PROCESS_ID``     — this process's rank.
+* ``SMTPU_FLEET_DIR``      — shared fleet-telemetry directory: when set,
+  every rank's StepRecorder writes its JSONL stream (plus heartbeats)
+  there and the supervisor appends its spawn/exit events, so a
+  :class:`~swiftmpi_tpu.obs.collector.FleetCollector` can merge the
+  whole world into one timeline (ISSUE 12).
 """
 
 from __future__ import annotations
@@ -39,6 +44,7 @@ log = get_logger(__name__)
 ENV_COORDINATOR = "SMTPU_COORDINATOR"
 ENV_NUM_PROCESSES = "SMTPU_NUM_PROCESSES"
 ENV_PROCESS_ID = "SMTPU_PROCESS_ID"
+ENV_FLEET_DIR = "SMTPU_FLEET_DIR"
 
 _initialized = False
 

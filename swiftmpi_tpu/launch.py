@@ -28,6 +28,17 @@ Flags (reference CMDLine style, ``-key value``):
                     with ``train_with_resume`` in the child).
 * ``-backoff S``  — initial restart backoff seconds (default 1.0,
                     doubling per restart, capped at 60s).
+* ``-fleet-dir D`` — arm fleet observability (ISSUE 12): children get
+                    ``SMTPU_FLEET_DIR=D`` (their StepRecorder writes
+                    per-rank heartbeat'd JSONL streams there, see
+                    obs.configure) and the launcher appends its own
+                    ``smtpu-fleet-sup/1`` events — spawn/exit with
+                    normalized rc and a ``by_supervisor`` flag that
+                    separates organic deaths from teardown kills,
+                    restart, world_start/world_exit — to
+                    ``D/supervisor.jsonl``, so a FleetCollector can
+                    correlate a rank's silence with *why* it went
+                    silent.
 
 Children inherit stdout/stderr with a ``[rank k]`` line prefix; first
 non-zero exit terminates the rest (mpirun semantics): survivors get
@@ -48,6 +59,7 @@ import time
 from typing import Dict, List, Optional
 
 from swiftmpi_tpu.cluster.bootstrap import (ENV_COORDINATOR,
+                                            ENV_FLEET_DIR,
                                             ENV_NUM_PROCESSES,
                                             ENV_PROCESS_ID)
 
@@ -59,10 +71,13 @@ def _free_port() -> int:
 
 
 def _child_env(base: Dict[str, str], port: int, rank: int, nprocs: int,
-               cpu_devices: int) -> Dict[str, str]:
+               cpu_devices: int,
+               fleet_dir: Optional[str] = None) -> Dict[str, str]:
     env = dict(base)
     env[ENV_COORDINATOR] = f"127.0.0.1:{port}"
     env[ENV_NUM_PROCESSES] = str(nprocs)
+    if fleet_dir:
+        env[ENV_FLEET_DIR] = fleet_dir
     # besides the jax.distributed rank, ENV_PROCESS_ID is the process
     # identity every log line and telemetry record carries ("r<rank>",
     # obs/identity.py) — interleaved supervisor output and per-rank
@@ -89,7 +104,9 @@ def _normalize_rc(code: int) -> int:
 
 
 def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
-           port: int = 0, kill_grace_s: float = 5.0) -> int:
+           port: int = 0, kill_grace_s: float = 5.0,
+           fleet_dir: Optional[str] = None, fleet_log=None,
+           attempt: int = 0) -> int:
     """Spawn ``nprocs`` copies of ``argv`` under one coordinator; returns
     the first non-zero child exit code (terminating the others), else 0.
 
@@ -100,10 +117,32 @@ def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
     ``wait``-ed (no zombies), and a reader blocked on a pipe a grandchild
     still holds is unblocked by force-closing the pipe, not abandoned
     mid-pump.
+
+    ``fleet_log`` (a :class:`~swiftmpi_tpu.obs.collector.SupervisorLog`,
+    owned by :func:`supervise` so it spans restarts) receives one
+    ``spawn`` per Popen and exactly one ``exit`` per child —
+    ``by_supervisor`` distinguishes ranks this teardown killed from the
+    rank that died on its own, which is what lets a FleetCollector
+    attribute the world failure to the right member.
     """
     port = port or _free_port()
+    if fleet_dir and fleet_log is None:
+        from swiftmpi_tpu.obs.collector import SupervisorLog
+        fleet_log = SupervisorLog(fleet_dir)
     procs = []
     print_lock = threading.Lock()
+    exited: Dict[int, int] = {}        # rank -> raw code, logged once
+    terminated: set = set()            # ranks we delivered a signal to
+
+    def note_exit(rank: int, p) -> None:
+        code = p.poll()
+        if fleet_log is None or code is None or rank in exited:
+            return
+        exited[rank] = code
+        fleet_log.event("exit", rank=rank, pid=p.pid,
+                        rc=_normalize_rc(code),
+                        by_supervisor=rank in terminated,
+                        attempt=attempt)
 
     def reader(rank: int, stream) -> None:
         try:
@@ -118,9 +157,12 @@ def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
     for rank in range(nprocs):
         p = subprocess.Popen(
             argv, env=_child_env(os.environ, port, rank, nprocs,
-                                 cpu_devices),
+                                 cpu_devices, fleet_dir),
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
         procs.append(p)
+        if fleet_log is not None:
+            fleet_log.event("spawn", rank=rank, pid=p.pid,
+                            attempt=attempt)
         t = threading.Thread(target=reader, args=(rank, p.stdout),
                              daemon=True)
         t.start()
@@ -130,36 +172,44 @@ def launch(argv: List[str], nprocs: int, cpu_devices: int = 0,
     try:
         while any(p.poll() is None for p in procs):
             time.sleep(0.1)
-            for p in procs:
+            for i, p in enumerate(procs):
                 code = p.poll()
+                if code is not None:
+                    note_exit(i, p)    # organic exit: log BEFORE any
+                                       # teardown marks ranks terminated
                 if code not in (None, 0) and rc == 0:
                     rc = _normalize_rc(code)   # first failure wins
-                    for q in procs:
+                    for j, q in enumerate(procs):
                         if q.poll() is None:
+                            terminated.add(j)
                             q.terminate()
                     deadline = time.monotonic() + kill_grace_s
-                    for q in procs:
+                    for j, q in enumerate(procs):
                         try:
                             q.wait(max(0.0, deadline - time.monotonic()))
                         except subprocess.TimeoutExpired:
                             q.kill()   # SIGTERM ignored: escalate
-        for p in procs:
+                        note_exit(j, q)
+        for i, p in enumerate(procs):
             code = p.wait()
+            note_exit(i, p)
             if code and rc == 0:
                 rc = _normalize_rc(code)
     finally:
         # kill: nothing may survive this function, success or raise
-        for p in procs:
+        for i, p in enumerate(procs):
             if p.poll() is None:
+                terminated.add(i)
                 p.kill()
         # reap: every kill needs a wait or the child stays a zombie (the
         # old teardown skipped this — `ps` after a failed launch showed
         # defunct ranks until the launcher itself exited)
-        for p in procs:
+        for i, p in enumerate(procs):
             try:
                 p.wait(timeout=kill_grace_s)
             except subprocess.TimeoutExpired:
                 pass               # unkillable (D-state); nothing to do
+            note_exit(i, p)
         # drain: child death EOFs the pipe, so readers normally finish
         # on their own...
         for t in threads:
@@ -182,7 +232,8 @@ def supervise(argv: List[str], nprocs: int, cpu_devices: int = 0,
               port: int = 0, kill_grace_s: float = 5.0,
               max_restarts: int = 0, backoff_s: float = 1.0,
               backoff_factor: float = 2.0,
-              backoff_max_s: float = 60.0) -> int:
+              backoff_max_s: float = 60.0,
+              fleet_dir: Optional[str] = None) -> int:
     """Restart-the-world supervisor around :func:`launch`.
 
     The SPMD recovery model (io/resilience.py): a failed rank cannot be
@@ -194,28 +245,51 @@ def supervise(argv: List[str], nprocs: int, cpu_devices: int = 0,
     crash-loop exhausts its budget and surfaces the real exit code
     instead of flapping forever.  With the default ``port=0`` every
     attempt picks a fresh coordinator port — the previous coordinator's
-    socket may linger in TIME_WAIT."""
+    socket may linger in TIME_WAIT.
+
+    With ``fleet_dir``, ONE SupervisorLog spans every attempt — restart
+    events land between the attempts' spawn/exit runs, so the collector
+    sees a rank's pre- and post-restart lives as one member history."""
     attempt = 0
-    while True:
-        rc = launch(argv, nprocs, cpu_devices, port, kill_grace_s)
-        if rc == 0:
-            if attempt:
-                print(f"[launch] world recovered after {attempt} "
-                      f"restart(s)", file=sys.stderr)
-            return 0
-        if attempt >= max_restarts:
-            if max_restarts:
-                print(f"[launch] restart budget exhausted "
-                      f"({max_restarts}); giving up with rc={rc}",
-                      file=sys.stderr)
-            return rc
-        delay = min(backoff_s * (backoff_factor ** attempt),
-                    backoff_max_s)
-        attempt += 1
-        print(f"[launch] world failed rc={rc}; restart "
-              f"{attempt}/{max_restarts} in {delay:.1f}s",
-              file=sys.stderr)
-        time.sleep(delay)
+    fleet_log = None
+    if fleet_dir:
+        from swiftmpi_tpu.obs.collector import SupervisorLog
+        fleet_log = SupervisorLog(fleet_dir)
+        fleet_log.event("world_start", nprocs=nprocs,
+                        max_restarts=max_restarts, argv=list(argv))
+    try:
+        while True:
+            rc = launch(argv, nprocs, cpu_devices, port, kill_grace_s,
+                        fleet_dir=fleet_dir, fleet_log=fleet_log,
+                        attempt=attempt)
+            if rc == 0:
+                if attempt:
+                    print(f"[launch] world recovered after {attempt} "
+                          f"restart(s)", file=sys.stderr)
+                if fleet_log is not None:
+                    fleet_log.event("world_exit", rc=0, attempt=attempt)
+                return 0
+            if attempt >= max_restarts:
+                if max_restarts:
+                    print(f"[launch] restart budget exhausted "
+                          f"({max_restarts}); giving up with rc={rc}",
+                          file=sys.stderr)
+                if fleet_log is not None:
+                    fleet_log.event("world_exit", rc=rc, attempt=attempt)
+                return rc
+            delay = min(backoff_s * (backoff_factor ** attempt),
+                        backoff_max_s)
+            attempt += 1
+            print(f"[launch] world failed rc={rc}; restart "
+                  f"{attempt}/{max_restarts} in {delay:.1f}s",
+                  file=sys.stderr)
+            if fleet_log is not None:
+                fleet_log.event("restart", rc=rc, attempt=attempt,
+                                delay_s=delay)
+            time.sleep(delay)
+    finally:
+        if fleet_log is not None:
+            fleet_log.close()
 
 
 def main(args: Optional[List[str]] = None) -> int:
@@ -235,6 +309,8 @@ def main(args: Optional[List[str]] = None) -> int:
     cmd.registerParameter("max-restarts",
                           "restart-the-world budget on failure")
     cmd.registerParameter("backoff", "initial restart backoff seconds")
+    cmd.registerParameter("fleet-dir",
+                          "fleet telemetry directory (ISSUE 12)")
     prog = args[split + 1:]
     if not prog:
         print("launch: nothing to run after --", file=sys.stderr)
@@ -248,7 +324,9 @@ def main(args: Optional[List[str]] = None) -> int:
         max_restarts=int(cmd.get_value("max-restarts"))
         if cmd.hasParameter("max-restarts") else 0,
         backoff_s=float(cmd.get_value("backoff"))
-        if cmd.hasParameter("backoff") else 1.0)
+        if cmd.hasParameter("backoff") else 1.0,
+        fleet_dir=cmd.get_value("fleet-dir")
+        if cmd.hasParameter("fleet-dir") else None)
 
 
 if __name__ == "__main__":

@@ -27,6 +27,7 @@ a cached reference) rather than caching it forever.
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Optional
 
@@ -37,14 +38,19 @@ from swiftmpi_tpu.obs.recorder import SCHEMA, SCHEMA_V, StepRecorder
 from swiftmpi_tpu.obs.registry import (DEFAULT_BUCKETS_MS, MetricsRegistry,
                                        parse_series_key,
                                        quantile_from_buckets, series_key)
+from swiftmpi_tpu.obs.collector import (FLEET_SCHEMA, FleetCollector,
+                                        SupervisorLog, stream_filename)
+from swiftmpi_tpu.cluster.bootstrap import ENV_FLEET_DIR
 from swiftmpi_tpu.utils import profiler
 
 __all__ = [
     "DEFAULT_BUCKETS_MS", "MetricsRegistry", "StepRecorder", "SCHEMA",
-    "SCHEMA_V", "series_key", "parse_series_key", "quantile_from_buckets",
-    "process_ident", "process_rank", "get_registry", "set_enabled",
-    "reset_for_tests", "span", "named_scope", "configure",
-    "install_recorder", "uninstall_recorder", "get_recorder", "record_step",
+    "SCHEMA_V", "FLEET_SCHEMA", "FleetCollector", "SupervisorLog",
+    "stream_filename", "series_key", "parse_series_key",
+    "quantile_from_buckets", "process_ident", "process_rank",
+    "get_registry", "set_enabled", "reset_for_tests", "span",
+    "named_scope", "configure", "install_recorder", "uninstall_recorder",
+    "get_recorder", "record_step",
 ]
 
 #: named scope for *compiled* code — same phase names as :func:`span`,
@@ -162,9 +168,9 @@ def record_step(n: int = 1) -> None:
 
 def configure(config, run: str = "run",
               meta: Optional[dict] = None) -> Optional[StepRecorder]:
-    """Arm the telemetry plane from ``[worker]`` config.
+    """Arm the telemetry plane from ``[worker]`` / ``[obs]`` config.
 
-    Knobs (all under ``[worker]``):
+    Knobs under ``[worker]``:
 
     * ``telemetry: 1``        — master switch (default 0 = everything off)
     * ``telemetry_path:``     — JSONL sink (default ``telemetry.jsonl``;
@@ -173,16 +179,38 @@ def configure(config, run: str = "run",
     * ``telemetry_ring: N``   — ring-buffer retention (default 1024)
     * ``telemetry_flush: N``  — JSONL write-buffer size (default 64)
 
+    Fleet knobs under ``[obs]`` (ISSUE 12):
+
+    * ``fleet_dir:`` — shared fleet-telemetry directory; the
+      ``SMTPU_FLEET_DIR`` environment variable (set by
+      ``launch.py -fleet-dir``) overrides it.  A fleet dir ARMS
+      telemetry even when ``[worker] telemetry`` is off — a launcher
+      asking for fleet observability must not be silently ignored by a
+      worker config that never mentions telemetry — and redirects the
+      JSONL sink to ``<fleet_dir>/telemetry_r<rank>_p<pid>.jsonl`` so
+      every process life gets its own stream for the
+      :class:`FleetCollector` to merge.
+    * ``heartbeat_s: S`` — proof-of-life cadence (default 2.0 in fleet
+      mode, 0 = off otherwise).
+    * ``crash_flush: 1`` — atexit + fatal-signal telemetry flush
+      (default on; see recorder.py).
+
     Returns the installed :class:`StepRecorder`, or ``None`` when
     telemetry is off.  The caller owns ``close()`` (or use it as a
     context manager); close appends the summary line and uninstalls
     nothing — :func:`uninstall_recorder` is explicit.
     """
     g = config.get_or
-    if not g("worker", "telemetry", 0).to_bool():
+    fleet_dir = os.environ.get(ENV_FLEET_DIR) or \
+        g("obs", "fleet_dir", "").to_string()
+    if not g("worker", "telemetry", 0).to_bool() and not fleet_dir:
         return None
     set_enabled(True)
     path = g("worker", "telemetry_path", "telemetry.jsonl").to_string()
+    if fleet_dir:
+        os.makedirs(fleet_dir, exist_ok=True)
+        path = os.path.join(
+            fleet_dir, stream_filename(process_rank(), os.getpid()))
     rec = StepRecorder(
         _REGISTRY,
         path=path or None,
@@ -191,5 +219,8 @@ def configure(config, run: str = "run",
         flush_every=g("worker", "telemetry_flush", 64).to_int32(),
         every=g("worker", "telemetry_every", 1).to_int32(),
         meta=meta,
+        heartbeat_s=g("obs", "heartbeat_s",
+                      2.0 if fleet_dir else 0.0).to_float(),
+        crash_flush=g("obs", "crash_flush", 1).to_bool(),
     )
     return install_recorder(rec)

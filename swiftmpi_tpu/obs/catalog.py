@@ -62,6 +62,11 @@ SERIES = frozenset({
     # control plane (control/controller.py)
     "control/evaluations", "control/decisions",
     "control/decisions_applied", "control/sketch_observed",
+    # fleet observability (obs/collector.py, obs/recorder.py heartbeats)
+    "telemetry/heartbeats",
+    "fleet/step_ms_skew", "fleet/wire_bytes_imbalance",
+    "fleet/members_live", "fleet/members_stalled", "fleet/members_dead",
+    "fleet/straggler_rank",
 }) | frozenset("transfer/" + k for k in TRANSFER_KEYS)
 
 #: Dynamic-name families: an f-string series name passes the catalog

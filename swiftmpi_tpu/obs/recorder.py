@@ -22,6 +22,13 @@ Record schema (one JSON object per line, ``"v": 1`` on every line):
 * ``kind: "summary"`` — last line: cumulative counter totals, final
   gauges, and p50/p95/p99 per histogram — so one-shot consumers (the
   traffic-budget gate) never have to re-sum the deltas.
+* ``kind: "heartbeat"`` — proof-of-life line with a wall-clock ``ts``,
+  emitted inline from :meth:`on_steps` at the ``heartbeat_s`` cadence
+  and flushed IMMEDIATELY (no buffering): a rank that stalls stops
+  heartbeating, and the silence itself is the fleet-health signal a
+  :class:`~swiftmpi_tpu.obs.collector.FleetCollector` reads.  No
+  background thread — a heartbeat that a hung consumer loop cannot emit
+  would defeat the point.
 * other kinds — out-of-band :meth:`StepRecorder.event` lines (the
   control plane's ``control/decision`` records): arbitrary payload
   stamped with the recorder's step/clock, same ``"v"`` versioning.
@@ -30,13 +37,26 @@ Writes happen only on the recording thread (the training loop's consumer
 side); the registry itself is what the producer threads hit, and its
 snapshot is lock-consistent.  ``telemetry_every: K`` thins recording to
 every K-th step when per-step snapshots are too hot for a small step.
+
+Flush-on-crash (ISSUE 12 satellite): a killed rank used to lose exactly
+the buffered tail that explains the kill.  ``crash_flush=True`` enrolls
+the recorder in a process-wide atexit + fatal-signal (SIGTERM/SIGINT/
+SIGHUP) hook that closes every live recorder — summary line included —
+then restores the previous handler and re-delivers the signal so the
+launcher still sees the normalized 128+signum exit code.  SIGKILL is
+uncatchable by design; the immediate heartbeat flush bounds that loss
+to one flush interval.
 """
 
 from __future__ import annotations
 
+import atexit
 import json
 import os
+import signal
+import threading
 import time
+import weakref
 from collections import deque
 from typing import Callable, Dict, List, Optional
 
@@ -46,6 +66,58 @@ from swiftmpi_tpu.obs.registry import (MetricsRegistry,
 
 SCHEMA = "smtpu-telemetry/1"
 SCHEMA_V = 1
+
+# -- crash-flush machinery ---------------------------------------------------
+# Recorders enrolled for flush-on-crash.  A WeakSet so an abandoned
+# recorder never outlives its owner just because it asked for crash
+# safety; close() is idempotent so double-delivery (atexit after a
+# handled signal) is harmless.
+_CRASH_RECORDERS: "weakref.WeakSet[StepRecorder]" = weakref.WeakSet()
+_CRASH_SIGNALS = (signal.SIGTERM, signal.SIGINT, signal.SIGHUP)
+_crash_hooks_installed = False
+_prev_handlers: Dict[int, object] = {}
+
+
+def _flush_all_recorders() -> None:
+    for rec in list(_CRASH_RECORDERS):
+        try:
+            rec.close()
+        except Exception:       # a broken sink must not mask the signal
+            pass
+
+
+def _crash_signal_handler(signum, frame) -> None:
+    _flush_all_recorders()
+    # Restore whatever was installed before us and re-deliver, so the
+    # process still dies with the correct 128+signum status the
+    # launcher's _normalize_rc expects (default disposition) — or the
+    # application's own handler (e.g. KeyboardInterrupt) still runs.
+    prev = _prev_handlers.get(signum, signal.SIG_DFL)
+    if callable(prev):
+        prev(signum, frame)
+        return
+    signal.signal(signum, signal.SIG_DFL if prev is None else prev)
+    os.kill(os.getpid(), signum)
+
+
+def _install_crash_hooks() -> None:
+    """Idempotent; atexit covers normal interpreter teardown, the signal
+    handlers cover supervisor SIGTERM teardown.  signal.signal only
+    works on the main thread — off-thread enrollment keeps the atexit
+    half and skips signals (ValueError guard)."""
+    global _crash_hooks_installed
+    if _crash_hooks_installed:
+        return
+    atexit.register(_flush_all_recorders)
+    if threading.current_thread() is threading.main_thread():
+        for sig in _CRASH_SIGNALS:
+            try:
+                prev = signal.getsignal(sig)
+                signal.signal(sig, _crash_signal_handler)
+                _prev_handlers[sig] = prev
+            except (ValueError, OSError):
+                pass
+    _crash_hooks_installed = True
 
 
 class StepRecorder:
@@ -62,7 +134,8 @@ class StepRecorder:
 
     def __init__(self, registry: MetricsRegistry, path: Optional[str] = None,
                  run: str = "run", ring: int = 1024, flush_every: int = 64,
-                 every: int = 1, meta: Optional[dict] = None):
+                 every: int = 1, meta: Optional[dict] = None,
+                 heartbeat_s: float = 0.0, crash_flush: bool = False):
         if ring < 1:
             raise ValueError(f"telemetry ring must be >= 1, got {ring}")
         if every < 1:
@@ -88,6 +161,13 @@ class StepRecorder:
                       "pid": os.getpid(), "ident": process_ident(),
                       "ts": time.time(), **(meta or {})}
         self._buf.append(json.dumps(self._meta, sort_keys=True))
+        self.heartbeat_s = float(heartbeat_s)
+        self._last_hb = 0.0
+        if crash_flush:
+            _CRASH_RECORDERS.add(self)
+            _install_crash_hooks()
+        if self.heartbeat_s > 0:
+            self.heartbeat()            # first proof of life ASAP
 
     # -- samplers ----------------------------------------------------------
     def add_sampler(self, fn: Callable[[MetricsRegistry], None]) -> None:
@@ -104,6 +184,30 @@ class StepRecorder:
         self._steps_unrecorded += n
         if self._steps_unrecorded >= self.every:
             self._record()
+        if self.heartbeat_s > 0 and \
+                time.monotonic() - self._last_hb >= self.heartbeat_s:
+            self.heartbeat()
+
+    def heartbeat(self) -> Optional[dict]:
+        """Write a proof-of-life line NOW and flush it — unlike every
+        other record this must hit the disk immediately, because its
+        absence is what a FleetCollector reads as a stall.  Carries the
+        wall clock (``ts``) so cross-rank heartbeat ages are comparable
+        without reconstructing from the meta line."""
+        if self._closed:
+            return None
+        self._last_hb = time.monotonic()
+        if self.registry.enabled:
+            self.registry.counter("telemetry/heartbeats").inc()
+        rec = {"v": SCHEMA_V, "kind": "heartbeat",
+               "step": self._step_total,
+               "t": self._last_hb - self._t0,
+               "ts": time.time(),
+               "rank": self._meta["rank"], "ident": self._meta["ident"]}
+        if self.path:
+            self._buf.append(json.dumps(rec, sort_keys=True))
+            self.flush()
+        return rec
 
     def _record(self) -> None:
         for fn in self._samplers:
@@ -202,6 +306,7 @@ class StepRecorder:
                            if h["count"] else 0.0}
                        for k, h in snap["hists"].items()}}
         self._closed = True
+        _CRASH_RECORDERS.discard(self)
         if self.path:
             self._buf.append(json.dumps(summary, sort_keys=True))
             self.flush()

@@ -119,6 +119,14 @@ class EmbeddingReader:
             "queries": 0, "rows_read": 0, "hot_hits": 0,
             "front_hits": 0, "tail_misses": 0, "topk_queries": 0}
         self._lat_ms: list = []
+        # Launched replicas (SMTPU_PROCESS_ID set) label every serve/*
+        # series with their identity, so a FleetCollector merging the
+        # fleet's streams can attribute per-replica p99/hit-ratio
+        # (ROADMAP item 2's gate needs the data source).  Bare
+        # single-process runs keep the unlabeled series untouched.
+        rank = obs.process_rank()
+        self._labels: Dict[str, str] = (
+            {"replica": obs.process_ident()} if rank is not None else {})
 
     # -- internals --------------------------------------------------------
     def _front_for(self, snap: TableSnapshot) -> LruTailFront:
@@ -134,9 +142,10 @@ class EmbeddingReader:
         self._lat_ms.append(dt_ms)
         reg = obs.get_registry()
         if reg.enabled:
-            reg.histogram("serve/latency_ms").observe(dt_ms)
-            reg.counter("serve/queries").inc(1)
-            reg.gauge("serve/staleness_steps").set(
+            reg.histogram("serve/latency_ms",
+                          **self._labels).observe(dt_ms)
+            reg.counter("serve/queries", **self._labels).inc(1)
+            reg.gauge("serve/staleness_steps", **self._labels).set(
                 self.publisher.train_step - snap.step)
 
     # -- the pull-only read path -----------------------------------------
@@ -185,10 +194,11 @@ class EmbeddingReader:
         st["tail_misses"] += misses
         reg = obs.get_registry()
         if reg.enabled:
-            reg.counter("serve/rows_read").inc(int(valid.sum()))
-            reg.counter("serve/hits").inc(
+            reg.counter("serve/rows_read",
+                        **self._labels).inc(int(valid.sum()))
+            reg.counter("serve/hits", **self._labels).inc(
                 int(is_hot.sum()) + front_hits)
-            reg.counter("serve/misses").inc(misses)
+            reg.counter("serve/misses", **self._labels).inc(misses)
         self._observe((time.perf_counter() - t0) * 1e3, snap)
         return out
 
@@ -214,7 +224,8 @@ class EmbeddingReader:
         st["topk_queries"] += len(keys)
         reg = obs.get_registry()
         if reg.enabled:
-            reg.counter("serve/topk_queries").inc(len(keys))
+            reg.counter("serve/topk_queries",
+                        **self._labels).inc(len(keys))
         self._observe((time.perf_counter() - t0) * 1e3, snap)
         return nkeys, scores
 

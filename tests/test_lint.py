@@ -287,6 +287,28 @@ def test_telemetry_checks_obs_inc_wrapper(tmp_path):
     assert "transfer/not_a_ledger_key" in new[0].message
 
 
+def test_telemetry_covers_collector_module(tmp_path):
+    """ISSUE 12 satellite: the fleet collector's registry mirror is NOT
+    exempt from the catalog — its fleet/* gauges must be declared like
+    any other series, and a typo'd fleet series trips the rule."""
+    new = lint_src(tmp_path, "pkg/obs/collector.py", """
+    def mirror(reg, summary):
+        reg.gauge("fleet/step_ms_skew").set(summary["skew"])
+        reg.gauge("fleet/wire_bytes_imbalance").set(summary["imb"])
+        reg.gauge("fleet/members_dead").set(0)
+    """)
+    assert new == []
+
+
+def test_telemetry_trips_on_undeclared_fleet_series(tmp_path):
+    new = lint_src(tmp_path, "pkg/obs/collector.py", """
+    def mirror(reg):
+        reg.gauge("fleet/step_ms_skoo").set(1.0)
+    """)
+    assert rules_of(new) == {"TELEMETRY-CATALOG"}
+    assert "fleet/step_ms_skoo" in new[0].message
+
+
 def test_telemetry_checks_both_ifexp_branches(tmp_path):
     new = lint_src(tmp_path, "pkg/thing.py", """
     def record(reg, ok):

@@ -234,6 +234,29 @@ def test_serve_metrics_mirrored_into_registry(devices8):
     assert g["serve/staleness_steps"] == 0
 
 
+def test_serve_metrics_replica_labeled_when_launched(devices8, monkeypatch):
+    """Launched replicas (SMTPU_PROCESS_ID set) label every serve/*
+    series with their identity so a FleetCollector merging the fleet's
+    streams can attribute per-replica latency/hit-ratio; bare processes
+    (the test above) keep the unlabeled series bit-identical."""
+    monkeypatch.setenv("SMTPU_PROCESS_ID", "2")
+    obs.set_enabled(True)
+    reg = obs.get_registry()
+    table, keys, slots = _plain_table()
+    pub = SnapshotPublisher(every=1)
+    _publish(pub, table, keys)
+    reader = EmbeddingReader(pub)
+    reader.read(keys)
+    snap = reg.snapshot()
+    c = snap["counters"]
+    assert c["serve/queries{replica=r2}"] == 1
+    assert c["serve/rows_read{replica=r2}"] == len(keys)
+    assert "serve/latency_ms{replica=r2}" in snap["hists"]
+    assert "serve/staleness_steps{replica=r2}" in snap["gauges"]
+    # no unlabeled reader-side twin series leaked alongside
+    assert "serve/queries" not in c
+
+
 # -- pull-side wire ledger (satellite: all four backends) -------------------
 
 @pytest.mark.parametrize("backend_name", ["local", "xla", "tpu", "hybrid"])
