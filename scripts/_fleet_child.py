@@ -18,6 +18,14 @@ fault bus at the top of every step, which is where a launcher-installed
 ``SMTPU_FLEET_DIR`` (obs.configure's fleet redirect); heartbeat cadence
 comes from ``SMTPU_FLEET_HB_S``.
 
+``SMTPU_FLEET_NUMERICS=1`` additionally arms the numerics health plane
+(obs/numerics.py) with synthetic per-rank gradient norms and a live
+AnomalyDetector, so the fleet merge carries ``numerics/*`` gauges and
+anomaly events end to end without any real training;
+``SMTPU_FLEET_NUMERICS_SPIKE=<step>`` injects a 40x grad-norm spike on
+``SMTPU_FLEET_NUMERICS_SPIKE_RANK`` (default 0) at that step — the
+drill that must surface as an anomaly in the member table.
+
 Prints ``FLEET_CHILD_OK rank=<r> steps=<n>`` on a clean finish.
 """
 
@@ -53,6 +61,16 @@ def main() -> int:
     rank = obs.process_rank() or 0
     reg = obs.get_registry()
 
+    det = None
+    spike_at = spike_rank = -1
+    if os.environ.get("SMTPU_FLEET_NUMERICS", "0") not in ("", "0"):
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        det = obs_numerics.AnomalyDetector()
+        spike_at = int(os.environ.get("SMTPU_FLEET_NUMERICS_SPIKE",
+                                      "-1"))
+        spike_rank = int(os.environ.get(
+            "SMTPU_FLEET_NUMERICS_SPIKE_RANK", "0"))
+
     for step in range(steps):
         faults.step_event(step)         # hang/kill drills fire here
         with obs.span("dispatch"):
@@ -62,6 +80,17 @@ def main() -> int:
         reg.counter("transfer/dispatches", backend="xla").inc(1)
         reg.counter("transfer/window_fmt", backend="xla",
                     fmt="sparse").inc(1)
+        if det is not None:
+            # deterministic per-rank norms (mild skew, below the
+            # cross-rank divergence factor) + optional injected spike
+            g = 1.0 + 0.1 * rank
+            if step == spike_at and rank == spike_rank:
+                g *= 40.0
+            loss = 2.0 / (1.0 + 0.05 * step)
+            reg.gauge("numerics/grad_norm").set(g)
+            reg.gauge("numerics/loss").set(loss)
+            det.on_sample(reg, {"numerics/grad_norm": g,
+                                "numerics/loss": loss}, 0.0)
         obs.record_step(1)
 
     rec.close()

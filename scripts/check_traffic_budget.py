@@ -52,7 +52,8 @@ TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
                    "dispatches_per_window", "stall_ms_per_step",
                    "kernel_ms", "serve_p99_ms", "serve_miss_ratio",
                    "pull_bytes_per_step", "control_decisions_per_1k_steps",
-                   "fleet_step_ms_skew_pct", "fleet_wire_bytes_imbalance")
+                   "fleet_step_ms_skew_pct", "fleet_wire_bytes_imbalance",
+                   "ef_mass_growth", "fleet_grad_norm_divergence")
 DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "window_fmt_sparse", "window_fmt_q",
                   "window_fmt_bitmap", "wire_quant", "coalesce_ratio",
@@ -63,7 +64,9 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "control_applied", "control_evaluations",
                   "steps_to_reconverge", "recompiles", "hot_k",
                   "straggler_rank", "members_dead", "unnoticed_deaths",
-                  "fleet_restarts", "aligned_steps")
+                  "fleet_restarts", "aligned_steps",
+                  "numerics_anomalies", "numerics_critical",
+                  "numerics_nonfinite", "cross_rank_anomalies")
 #: absolute increase a metric must clear before it can regress: wall-
 #: clock metrics jitter run to run while the counter metrics are exact,
 #: so only the former get a floor (ms for the stall split; kernel_ms is
@@ -84,7 +87,15 @@ ABS_NOISE_FLOOR = {"stall_ms_per_step": 0.1, "kernel_ms": 0.05,
                    # and a wire-imbalance wobble under 0.2 (max/mean-1)
                    # is batch-composition variance, not a placement bug
                    "fleet_step_ms_skew_pct": 15.0,
-                   "fleet_wire_bytes_imbalance": 0.2}
+                   "fleet_wire_bytes_imbalance": 0.2,
+                   # error-feedback residual mass drifts with batch
+                   # composition; only a sustained growth factor (> 0.5
+                   # above baseline's last/mean ratio) is a compounding-
+                   # quantization-error signal worth failing on, and a
+                   # cross-rank grad-norm spread under 2x is ordinary
+                   # hot/tail sampling asymmetry between ranks
+                   "ef_mass_growth": 0.5,
+                   "fleet_grad_norm_divergence": 2.0}
 
 
 def load_telemetry_cells(path: str) -> dict:
@@ -92,8 +103,9 @@ def load_telemetry_cells(path: str) -> dict:
     by the run name.  Counters are summed across backends (the gate
     budgets the run's total wire, not the split) and normalized by the
     recorded step count; window decision totals ride along as detail."""
-    from telemetry_report import (control_summary, load, phase_table,
-                                  traffic_summary)
+    from telemetry_report import (control_summary, load,
+                                  numerics_summary, parse_series_key,
+                                  phase_table, traffic_summary)
 
     doc = load(path)     # SystemExit(2) on unreadable/bad schema
     t = traffic_summary(doc)
@@ -130,6 +142,23 @@ def load_telemetry_cells(path: str) -> dict:
             ctl.get("decisions_per_1k_steps", 0.0)
         cell["control_applied"] = ctl["applied"]
         cell["control_evaluations"] = ctl["evaluations"]
+    # numerics health plane (obs/numerics.py): nonfinite/critical are
+    # hard candidate-side gates (numerics_violations); the EF residual
+    # growth factor (last/mean of the worst field) is advisory — a
+    # lower-is-better tolerance metric, absent when numerics was off so
+    # a numerics-off baseline never blocks a numerics-on candidate
+    num = numerics_summary(doc)
+    if num["series"] or num["anomalies"]:
+        cell["numerics_anomalies"] = len(num["anomalies"])
+        cell["numerics_critical"] = num["severities"].get("critical", 0)
+        cell["numerics_nonfinite"] = num["nonfinite_total"]
+        growth = 0.0
+        for row in num["series"]:
+            if parse_series_key(row["series"])[0] == "numerics/ef_mass":
+                growth = max(growth,
+                             row["last"] / max(row["mean"], 1e-12))
+        if growth:
+            cell["ef_mass_growth"] = growth
     run = str(doc["meta"].get("run", "telemetry"))
     cells = {run: cell} if cell else {}
     # kernel microbench streams (obs.micro.MicroTelemetry): every
@@ -168,6 +197,14 @@ def load_fleet_cells(path: str) -> dict:
     }
     if s.get("straggler_rank") is not None:
         cell["straggler_rank"] = s["straggler_rank"]
+    if s.get("numerics_anomaly_total") is not None:
+        cell["numerics_anomalies"] = int(s["numerics_anomaly_total"])
+        cell["numerics_critical"] = int(
+            s.get("numerics_critical_total", 0))
+        cell["fleet_grad_norm_divergence"] = float(
+            s.get("fleet_grad_norm_divergence", 0.0))
+        cell["cross_rank_anomalies"] = int(
+            s.get("cross_rank_anomalies", 0))
     run = str(doc["meta"].get("run", "fleet"))
     return {run: cell}
 
@@ -275,6 +312,23 @@ def fleet_violations(cells: dict) -> list:
     return bad
 
 
+def numerics_violations(cells: dict) -> list:
+    """Candidate cells whose run produced nonfinite values or a
+    critical numerics anomaly (obs/numerics.py).  A NaN in the
+    parameter table or a critical-severity health event is not a
+    performance number to tolerance-check — the training run is
+    numerically broken regardless of how the baseline looked, so it
+    fails outright (the unnoticed-death pattern: a hard candidate-side
+    property, not a comparison)."""
+    bad = []
+    for cell, m in sorted(cells.items()):
+        nonfin = float(m.get("numerics_nonfinite", 0) or 0)
+        crit = float(m.get("numerics_critical", 0) or 0)
+        if nonfin > 0 or crit > 0:
+            bad.append((cell, int(nonfin), int(crit)))
+    return bad
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fail when bench traffic counters regressed")
@@ -335,6 +389,16 @@ def main(argv=None) -> int:
         for cell, n in deaths:
             print(f"  {cell}: {n} member(s) went silent past the dead "
                   "threshold with NO supervisor exit event")
+        return 1
+
+    broken = numerics_violations(
+        {c: m for c, m in cand.items() if not only or c in only})
+    if broken:
+        print("NUMERICS HEALTH FAILURE:")
+        for cell, nonfin, crit in broken:
+            print(f"  {cell}: {nonfin} nonfinite value(s), {crit} "
+                  "critical anomaly event(s) — run is numerically "
+                  "broken")
         return 1
 
     regressions = compare(base, cand, args.tolerance, only)

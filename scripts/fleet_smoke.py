@@ -69,6 +69,13 @@ def main(argv=None) -> int:
     ap.add_argument("--hang-rank", type=int, default=1)
     ap.add_argument("--hang-s", type=float, default=1.2)
     ap.add_argument("--stall-after", type=float, default=0.8)
+    ap.add_argument("--numerics", action="store_true",
+                    help="arm the numerics health plane in every child "
+                         "(synthetic grad norms + AnomalyDetector)")
+    ap.add_argument("--numerics-spike", type=int, default=-1,
+                    help="inject a 40x grad-norm spike on rank 0 at "
+                         "this step (implies --numerics); the merged "
+                         "timeline must carry the anomaly")
     ap.add_argument("--json", action="store_true",
                     help="dump the fleet summary as JSON")
     args = ap.parse_args(argv)
@@ -86,6 +93,12 @@ def main(argv=None) -> int:
     os.environ["SMTPU_FLEET_STEPS"] = str(args.steps)
     os.environ["SMTPU_FLEET_STEP_S"] = str(args.step_s)
     os.environ["SMTPU_FLEET_HB_S"] = "0.25"
+    numerics = args.numerics or args.numerics_spike >= 0
+    if numerics:
+        os.environ["SMTPU_FLEET_NUMERICS"] = "1"
+        if args.numerics_spike >= 0:
+            os.environ["SMTPU_FLEET_NUMERICS_SPIKE"] = \
+                str(args.numerics_spike)
     t0 = time.time()
     rc = smtpu_launch.supervise(
         [sys.executable, os.path.join(_REPO, "scripts",
@@ -119,6 +132,15 @@ def main(argv=None) -> int:
         failures.append(f"members not cleanly exited: {bad_health}")
     if s["unnoticed_deaths"]:
         failures.append(f"unnoticed deaths: {s['unnoticed_deaths']}")
+    if numerics and not any(
+            "numerics/grad_norm" in (r.get("gauges") or {})
+            for m in members.values()
+            for st in m["_streams"] for r in st.records):
+        failures.append("numerics armed but no numerics/grad_norm "
+                        "gauge in any rank's stream")
+    if args.numerics_spike >= 0 and not s.get("numerics_anomaly_total"):
+        failures.append("grad-norm spike injected but no anomaly in "
+                        "the merged timeline")
 
     if args.json:
         json.dump(s, sys.stdout, indent=2, default=str)
@@ -131,6 +153,13 @@ def main(argv=None) -> int:
               f"skew_p50={s['fleet_step_ms_skew_ms']:.1f}ms  "
               f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}  "
               f"health={s['health']}")
+        if numerics:
+            print(f"  numerics: anomalies="
+                  f"{s.get('numerics_anomaly_total', 0)} "
+                  f"(critical={s.get('numerics_critical_total', 0)})  "
+                  f"grad_norm_divergence="
+                  f"{s.get('fleet_grad_norm_divergence', 0.0):.1f}x  "
+                  f"per_member={s.get('numerics_anomalies', {})}")
     if failures:
         for f in failures:
             print(f"FLEET_SMOKE FAIL: {f}")

@@ -73,6 +73,26 @@ if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.p
 else
   echo "fleet smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
 fi
+# Advisory numerics-health smoke (ISSUE 13): the same 4-process fleet
+# drill with the numerics plane armed and a 40x grad-norm spike
+# injected on rank 0 at step 30 — the merged timeline must carry the
+# anomaly (fleet_smoke.py fails otherwise), and the rendered
+# `telemetry_report.py --numerics` read of rank 0's stream shows the
+# series stats + anomaly timeline an operator would triage from
+# (docs/OPERATIONS.md "Numerics anomaly triage").
+NUM_OUT="$REPO_DIR/runs/numerics_$(date +%Y%m%d_%H%M%S)"
+echo "--- numerics smoke (advisory) ---"
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.py" --out "$NUM_OUT" --numerics-spike 30; then
+  NUM_STREAM=$(ls "$NUM_OUT"/telemetry_*.jsonl 2>/dev/null | head -1)
+  if [ -n "$NUM_STREAM" ]; then
+    python "$(dirname "$0")/telemetry_report.py" --numerics "$NUM_STREAM" || echo "numerics report ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
+  if [ -r "$NUM_OUT/fleet.jsonl" ]; then
+    python "$(dirname "$0")/telemetry_report.py" --fleet "$NUM_OUT/fleet.jsonl" || echo "numerics fleet report ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
+else
+  echo "numerics smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
+fi
 # Advisory calibration staleness check: verdicts recorded under another
 # jaxlib/libtpu stack no longer steer data-plane gates — say so next to
 # the verdict (exit code unchanged; the CLI always exits 0).

@@ -106,6 +106,8 @@ def frame(fc: FleetCollector) -> dict:
                      1e-9)
         per = fc._per_step(m)
         step_ms = sorted(v[1] for v in per.values() if v[1] > 0)
+        norms = fc._grad_norms(m)
+        anomalies = fc._member_anomalies(m)
         rows.append({
             "rank": key,
             "ident": m["ident"],
@@ -124,6 +126,8 @@ def frame(fc: FleetCollector) -> dict:
             "heartbeats": m["heartbeats"],
             "stalls": len(fc.stall_episodes(m)),
             "straggler": key == summary["straggler_rank"],
+            "grad_norm": norms[max(norms)] if norms else None,
+            "anomalies": anomalies,
         })
     rows.sort(key=lambda r: (_HEALTH_ORDER.get(r["health"], 9),
                              r["rank"]))
@@ -139,8 +143,8 @@ def render(fr: dict) -> str:
         f"({s['fleet_step_ms_skew_pct']:.1f}%)  "
         f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}",
         f"{'RANK':<6}{'PID':>8}{'HEALTH':>9}{'STEP':>7}{'ST/S':>8}"
-        f"{'P50MS':>8}{'P95MS':>8}{'WIRE':>12}{'HB':>5}{'RST':>4}  "
-        f"FMT-MIX / FLAGS",
+        f"{'P50MS':>8}{'P95MS':>8}{'WIRE':>12}{'GNORM':>9}{'HB':>5}"
+        f"{'RST':>4}  FMT-MIX / FLAGS",
     ]
     for r in fr["members"]:
         mix = ",".join(f"{k}:{v}" for k, v in sorted(r["fmt_mix"].items()))
@@ -149,11 +153,18 @@ def render(fr: dict) -> str:
             flags.append("STRAGGLER")
         if r["stalls"]:
             flags.append(f"stalls={r['stalls']}")
+        anom = r.get("anomalies") or {}
+        if anom:
+            flags.append("ANOM=" + ",".join(
+                f"{k}:{anom[k]}" for k in sorted(anom)))
+        gnorm = (f"{r['grad_norm']:>9.3g}" if r.get("grad_norm")
+                 is not None else f"{'-':>9}")
         lines.append(
             f"{r['rank']:<6}{r['pid'] or 0:>8}{r['health']:>9}"
             f"{r['step'] if r['step'] is not None else '-':>7}"
             f"{r['steps_per_s']:>8.2f}{r['step_ms_p50']:>8.1f}"
             f"{r['step_ms_p95']:>8.1f}{r['wire_bytes']:>12,.0f}"
+            f"{gnorm}"
             f"{r['heartbeats']:>5}{r['restarts']:>4}  "
             f"{mix or '-'}"
             + (("  " + " ".join(flags)) if flags else ""))
@@ -162,6 +173,12 @@ def render(fr: dict) -> str:
     if s["straggler_rank"] is not None:
         lines.append(f"straggler: rank {s['straggler_rank']} "
                      f"({s['straggler_score']:.2f}x median step time)")
+    if s.get("numerics_anomaly_total"):
+        lines.append(
+            f"numerics: {s['numerics_anomaly_total']} anomalies "
+            f"({s.get('numerics_critical_total', 0)} critical), "
+            f"grad_norm divergence "
+            f"{s.get('fleet_grad_norm_divergence', 0.0):.1f}x")
     return "\n".join(lines)
 
 

@@ -125,7 +125,7 @@ def repair_json_line(line: str) -> Optional[dict]:
 def load(path: str) -> dict:
     """Parse the JSONL into {"meta", "steps": [...], "events": [...],
     "heartbeats": n, "summary"|None, "recovery": {...}} — "events"
-    collects the out-of-band ``control/*`` lines.
+    collects the out-of-band ``control/*`` and ``numerics/*`` lines.
 
     Crashed-run tolerance: a truncated FINAL line is repair-parsed
     (``recovery.recovered``); other undecodable lines are counted as
@@ -192,7 +192,8 @@ def load(path: str) -> dict:
             summary = rec
         elif kind == "heartbeat":
             heartbeats += 1
-        elif isinstance(kind, str) and kind.startswith("control/"):
+        elif isinstance(kind, str) and (kind.startswith("control/")
+                                        or kind.startswith("numerics/")):
             events.append(rec)
     return {"meta": meta, "steps": steps, "events": events,
             "heartbeats": heartbeats, "summary": summary,
@@ -321,6 +322,73 @@ def control_summary(doc: dict) -> dict:
     return out
 
 
+def numerics_summary(doc: dict) -> dict:
+    """The training-numerics health plane (obs/numerics.py): per-series
+    min/mean/max/last over the ``numerics/*`` gauges sampled into step
+    records, cumulative nonfinite/quant-error counters, and the
+    out-of-band ``numerics/anomaly`` event timeline with severity
+    counts.  Empty when ``[obs] numerics`` was off for the run."""
+    series: Dict[str, dict] = {}
+    for rec in doc["steps"]:
+        step = int(rec.get("step", 0))
+        for key, v in (rec.get("gauges") or {}).items():
+            if not key.startswith("numerics/"):
+                continue
+            try:
+                v = float(v)
+            except (TypeError, ValueError):
+                continue
+            s = series.setdefault(key, {"n": 0, "sum": 0.0,
+                                        "min": v, "max": v,
+                                        "last": v, "last_step": step})
+            s["n"] += 1
+            s["sum"] += v
+            s["min"] = min(s["min"], v)
+            s["max"] = max(s["max"], v)
+            s["last"], s["last_step"] = v, step
+    rows = []
+    for key in sorted(series):
+        s = series[key]
+        rows.append({"series": key, "n": s["n"],
+                     "mean": s["sum"] / s["n"], "min": s["min"],
+                     "max": s["max"], "last": s["last"],
+                     "last_step": s["last_step"]})
+    counters: Dict[str, float] = {}
+    if doc["summary"] is not None:
+        totals = doc["summary"].get("counters") or {}
+    else:
+        totals = {}
+        for rec in doc["steps"]:
+            for key, delta in (rec.get("counters") or {}).items():
+                totals[key] = totals.get(key, 0.0) + delta
+    for key, v in totals.items():
+        name, _ = parse_series_key(key)
+        if name.startswith("numerics/"):
+            counters[key] = counters.get(key, 0.0) + float(v)
+    anomalies = []
+    severities: Dict[str, int] = {}
+    for rec in doc["events"]:
+        if rec.get("kind") != "numerics/anomaly":
+            continue
+        sev = str(rec.get("severity", "?"))
+        severities[sev] = severities.get(sev, 0) + 1
+        anomalies.append({
+            "step": int(rec.get("step", 0)),
+            "anomaly": rec.get("anomaly", "?"),
+            "severity": sev,
+            "series": rec.get("series"),
+            "value": rec.get("value"),
+            "baseline": rec.get("baseline"),
+            "z": rec.get("z"),
+        })
+    anomalies.sort(key=lambda a: a["step"])
+    return {"series": rows, "counters": counters,
+            "anomalies": anomalies, "severities": severities,
+            "nonfinite_total": sum(
+                v for k, v in counters.items()
+                if parse_series_key(k)[0] == "numerics/nonfinite")}
+
+
 def traffic_summary(doc: dict) -> dict:
     """Cumulative counters (prefer the summary line's authoritative
     totals; fall back to summing step deltas for a crashed run) grouped
@@ -379,6 +447,7 @@ def report(doc: dict, phases_only: bool = False) -> dict:
         out["traffic"] = traffic_summary(doc)
         out["decisions"] = decision_timeline(doc)
         out["control"] = control_summary(doc)
+        out["numerics"] = numerics_summary(doc)
     return out
 
 
@@ -407,7 +476,7 @@ def load_fleet(path: str) -> dict:
               file=sys.stderr)
         raise SystemExit(2)
     doc = {"meta": None, "members": [], "sup": [], "health": [],
-           "rows": [], "summary": None}
+           "rows": [], "numerics": [], "summary": None}
     for n, ln in enumerate(lines):
         try:
             rec = json.loads(ln)
@@ -426,6 +495,8 @@ def load_fleet(path: str) -> dict:
             doc["health"].append(rec)
         elif kind == "fleet_step":
             doc["rows"].append(rec)
+        elif isinstance(kind, str) and kind.startswith("numerics/"):
+            doc["numerics"].append(rec)
         elif kind == "summary":
             doc["summary"] = rec
     meta = doc["meta"]
@@ -467,13 +538,19 @@ def _merge_fleet_dir(fleet_dir: str) -> dict:
                  for r in d["steps"]}
         prev = per_rank.setdefault(rank, {})
         prev.update(steps)
+        anom: Dict[str, int] = {}
+        for ev in d["events"]:
+            if ev.get("kind") == "numerics/anomaly":
+                sev = str(ev.get("severity", "?"))
+                anom[sev] = anom.get(sev, 0) + 1
         members.append({
             "kind": "member", "rank": rank, "ident": m.get("ident"),
             "pids": [m.get("pid")], "restarts": 0,
             "records": len(d["steps"]), "heartbeats": d["heartbeats"],
             "last_step": max(steps, default=None),
             "health": "exited" if d["summary"] is not None else "?",
-            "exits": [], "recovered": d["recovery"]["recovered"],
+            "exits": [], "anomalies": anom,
+            "recovered": d["recovery"]["recovered"],
             "dropped": d["recovery"]["dropped"]})
     rows = []
     common = None
@@ -516,7 +593,9 @@ def fleet_report(doc: dict) -> dict:
                      for k in ("schema", "run", "ranks")},
             "members": doc["members"], "sup_events": doc["sup"],
             "health_transitions": doc["health"],
-            "skew_timeline": runs, "summary": doc["summary"]}
+            "skew_timeline": runs,
+            "numerics_events": doc.get("numerics") or [],
+            "summary": doc["summary"]}
 
 
 def _print_fleet_report(rep: dict) -> None:
@@ -537,6 +616,10 @@ def _print_fleet_report(rep: dict) -> None:
             e = exits[-1]
             extra += (f" exit(rc={e.get('rc')}, by_supervisor="
                       f"{e.get('by_supervisor')})")
+        anom = mb.get("anomalies") or {}
+        if anom:
+            extra += " anomalies=" + ",".join(
+                f"{k}:{anom[k]}" for k in sorted(anom))
         print(f"  rank {mb['rank']}: {mb.get('health', '?'):8s}"
               f" last_step={mb.get('last_step')}"
               f" records={mb.get('records')}"
@@ -560,6 +643,15 @@ def _print_fleet_report(rep: dict) -> None:
         print(f"  {span}: rank {run['slowest']} slowest "
               f"(max skew {run['skew_ms_max']:.1f}ms, "
               f"{run['rows']} row(s))")
+    if rep.get("numerics_events"):
+        print()
+        print("cross-rank numerics divergence:")
+        for ev in rep["numerics_events"]:
+            print(f"  step {ev.get('step')}: grad_norm ratio "
+                  f"{ev.get('ratio', 0.0):.1f}x "
+                  f"[{ev.get('severity', '?')}] "
+                  f"(rank {ev.get('max_rank')} vs rank "
+                  f"{ev.get('min_rank')})")
     s = rep["summary"]
     if s:
         print()
@@ -573,9 +665,53 @@ def _print_fleet_report(rep: dict) -> None:
                   f"(score {s.get('straggler_score', 0.0):.2f}x median)")
         if s.get("unnoticed_deaths"):
             print(f"  UNNOTICED DEATHS: {s['unnoticed_deaths']}")
+        if s.get("numerics_anomaly_total"):
+            print(f"  numerics anomalies: "
+                  f"{s['numerics_anomaly_total']} "
+                  f"({s.get('numerics_critical_total', 0)} critical), "
+                  f"grad_norm divergence "
+                  f"{s.get('fleet_grad_norm_divergence', 0.0):.1f}x "
+                  f"across ranks")
 
 
 # -- rendering ------------------------------------------------------------
+def _print_numerics(num: dict) -> None:
+    print()
+    print("numerics health:")
+    if not num["series"] and not num["anomalies"]:
+        print("  (no numerics/* series — [obs] numerics off for this run)")
+        return
+    if num["series"]:
+        w = max(len(r["series"]) for r in num["series"]) + 2
+        print(f"  {'series'.ljust(w)}{'n':>6}{'mean':>12}{'min':>12}"
+              f"{'max':>12}{'last':>12}")
+        for r in num["series"]:
+            print(f"  {r['series'].ljust(w)}{r['n']:>6}"
+                  f"{r['mean']:>12.4g}{r['min']:>12.4g}"
+                  f"{r['max']:>12.4g}{r['last']:>12.4g}")
+    for key, v in sorted(num["counters"].items()):
+        print(f"  {key}: {v:,.0f} (cumulative)")
+    if num["nonfinite_total"]:
+        print(f"  NONFINITE VALUES SEEN: {num['nonfinite_total']:,.0f}")
+    sev = num["severities"]
+    if not num["anomalies"]:
+        print("  anomalies: none")
+    else:
+        counts = " ".join(f"{k}={sev[k]}" for k in sorted(sev))
+        print(f"  anomalies: {len(num['anomalies'])} ({counts})")
+        for a in num["anomalies"]:
+            detail = ""
+            if a.get("baseline") is not None:
+                detail += f" baseline={a['baseline']:.4g}"
+            if a.get("z") is not None:
+                detail += f" z={a['z']:.1f}"
+            val = a.get("value")
+            val_s = f"{val:.4g}" if isinstance(val, (int, float)) else val
+            print(f"    step {a['step']}: {a['anomaly']} "
+                  f"[{a['severity']}] {a.get('series')}="
+                  f"{val_s}{detail}")
+
+
 def _print_report(rep: dict) -> None:
     m = rep["meta"]
     print(f"run={m.get('run')} ident={m.get('ident')} "
@@ -634,6 +770,8 @@ def _print_report(rep: dict) -> None:
                       f"(win={d['win']:.4f}, streak={d['streak']})")
                 if ev_s:
                     print(f"      evidence: {ev_s}")
+    if "numerics" in rep:
+        _print_numerics(rep["numerics"])
     if "traffic" in rep:
         t = rep["traffic"]
         print()
@@ -663,6 +801,10 @@ def main(argv=None) -> int:
                     help="emit the report as JSON instead of text")
     ap.add_argument("--phases-only", action="store_true",
                     help="only the per-phase latency table")
+    ap.add_argument("--numerics", action="store_true",
+                    help="only the numerics-health section: numerics/* "
+                    "series stats, nonfinite totals and the anomaly "
+                    "timeline (smtpu-numerics/1 events)")
     ap.add_argument("--fleet", action="store_true",
                     help="treat path as an smtpu-fleet/1 merged "
                     "timeline (or a fleet dir): per-rank columns, "
@@ -676,6 +818,19 @@ def main(argv=None) -> int:
             print()
         else:
             _print_fleet_report(rep)
+        return 0
+    if args.numerics:
+        doc = load(args.path)
+        num = numerics_summary(doc)
+        if args.json:
+            json.dump({"meta": doc["meta"], "numerics": num},
+                      sys.stdout, indent=2)
+            print()
+        else:
+            m = doc["meta"]
+            print(f"run={m.get('run')} ident={m.get('ident')} "
+                  f"schema={m.get('schema')}")
+            _print_numerics(num)
         return 0
     rep = report(load(args.path), phases_only=args.phases_only)
     if args.json:

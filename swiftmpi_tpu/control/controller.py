@@ -183,12 +183,52 @@ class Controller:
         self._evals = 0
         self._streak: Dict[str, int] = {}
         self._prev_traffic: Optional[dict] = None
+        self._numerics_pending: Optional[dict] = None
+        self._numerics_demote: Optional[Callable] = None
+
+    # -- numerics health hook (obs/numerics.py, ISSUE 13) ------------------
+    def attach_numerics(self, detector, demote: Callable) -> None:
+        """Close the numerics loop: ``detector``'s sustained-EF-runaway
+        hook parks its anomaly here (it fires on the recorder's flush
+        path, potentially off the trainer thread); the NEXT
+        :meth:`on_steps` call applies ``demote(anomaly)`` at the control
+        plane's safe point and emits a ``control/decision`` event
+        carrying the anomaly as evidence.  ``demote`` returns the
+        previous setting (for the event) or None to decline."""
+        self._numerics_demote = demote
+        detector.add_demote_hook(self._on_numerics_anomaly)
+
+    def _on_numerics_anomaly(self, anomaly: dict) -> None:
+        # record only — applying here would recompile the step from
+        # whatever thread flushed the recorder
+        self._numerics_pending = dict(anomaly)
+
+    def _apply_numerics(self) -> None:
+        anomaly, self._numerics_pending = self._numerics_pending, None
+        if self._numerics_demote is None or anomaly is None:
+            return
+        old = self._numerics_demote(anomaly)
+        if old is None:
+            return
+        reg = obs.get_registry()
+        d = Decision("wire_quant", "apply", old, "off", 0.0, 0,
+                     self._evals, {"numerics": anomaly})
+        self.decisions.append(d)
+        reg.counter("control/decisions").inc()
+        reg.counter("control/decisions_applied").inc()
+        rec = obs.get_recorder()
+        if rec is not None:
+            rec.event("control/decision", d.to_payload())
 
     # -- cadence -----------------------------------------------------------
     def on_steps(self, n: int = 1) -> Optional[List[Decision]]:
         """Account ``n`` consumed steps; run an evaluation when the
         ``every`` cadence is due.  Returns that evaluation's decisions
-        (possibly empty), or None when no evaluation ran."""
+        (possibly empty), or None when no evaluation ran.  A parked
+        numerics demotion applies first — it must not wait out the
+        evaluation cadence."""
+        if self._numerics_pending is not None:
+            self._apply_numerics()
         if not self.settings.enabled:
             return None
         self._since += n

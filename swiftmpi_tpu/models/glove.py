@@ -138,6 +138,12 @@ class GloVe:
         # per-train() observability: stall/device time split (+ the
         # pipeline depth the run actually used) — see utils.timers
         self.train_metrics: dict = {}
+        # [obs] numerics (obs/numerics.py): off constructs and traces
+        # nothing — same bit-identity contract as word2vec
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        self.numerics_on = obs_numerics.enabled(self.config)
+        self._numerics = None
+        self._numerics_rec_id: Optional[int] = None
 
     # -- build: vocab + co-occurrence + table ------------------------------
     def build(self, sentences) -> "GloVe":
@@ -160,6 +166,9 @@ class GloVe:
         # fx/logx arrive precomputed from train() — the weighting
         # function itself never enters the jitted step
         access, transfer = self.access, self.transfer
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        num = self._numerics
+        n_hot = self.table.n_hot if num is not None else 0
 
         def one(state, fs, cs, logx, fx):
             rows_f = transfer.pull(state, fs, access, fields=("w", "b"))
@@ -174,17 +183,38 @@ class GloVe:
             gw = (-g)[:, None] * wt
             gwt = (-g)[:, None] * w
             gb = (-g)[:, None]
+            stats = None
+            if num is not None:
+                s1, h1, n1 = obs_numerics.push_stats(
+                    fs, {"w": gw, "b": gb}, n_hot)
+                s2, h2, n2 = obs_numerics.push_stats(
+                    cs, {"wt": gwt, "bt": gb}, n_hot)
+                stats = (s1 + s2, h1 + h2, n1 + n2)
             state = transfer.push(state, fs, {"w": gw, "b": gb},
                                   access, mean=True)
             state = transfer.push(state, cs, {"wt": gwt, "bt": gb},
                                   access, mean=True)
-            return state, loss
+            return state, loss, stats
 
         def multi(state, fs, cs, logx, fx):
+            if num is None:
+                def body(st, xs):
+                    st, loss, _ = one(st, *xs)
+                    return st, loss
+                state, losses = jax.lax.scan(body, state,
+                                             (fs, cs, logx, fx))
+                return state, losses.sum()
+            state0 = state
+
             def body(st, xs):
-                st, loss = one(st, *xs)
-                return st, loss
-            state, losses = jax.lax.scan(body, state, (fs, cs, logx, fx))
+                st, loss, stats = one(st, *xs)
+                return st, (loss, stats)
+            state, (losses, stats) = jax.lax.scan(body, state,
+                                                  (fs, cs, logx, fx))
+            obs_numerics.stage_step(
+                num, state0, state, tuple(s.sum() for s in stats),
+                losses.sum(), jnp.float32(fs.shape[0] * fs.shape[1]),
+                ("w", "wt", "b", "bt"))
             return state, losses.sum()
 
         return jax.jit(multi, donate_argnums=(0,))
@@ -218,8 +248,6 @@ class GloVe:
             if sentences is None:
                 raise RuntimeError("build() first or pass sentences")
             self.build(sentences)
-        if self._step is None:
-            self._step = self._build_step()
         n = len(self._coo[2])
         if n == 0:
             raise RuntimeError("empty co-occurrence set")
@@ -242,6 +270,12 @@ class GloVe:
                 reg.counter("train/device_ms_total").set_total(
                     _m.device_ms())
             tel_rec.add_sampler(_tel_sample)
+        if self.numerics_on and tel_rec is not None:
+            self._arm_numerics(tel_rec)
+        # compile AFTER arming: _build_step closes over self._numerics
+        # at trace time (a first-time arm drops any pre-arm step)
+        if self._step is None:
+            self._step = self._build_step()
         transfer_fn = None
         if self.pipeline_depth > 0:
             from swiftmpi_tpu.io.pipeline import device_put_transfer
@@ -307,10 +341,34 @@ class GloVe:
             "device_ms": meter.device_ms(),
             "stall_ms_per_step": meter.stall_ms_per_step(),
             "pipeline_depth": self.pipeline_depth}
+        if self._numerics is not None:
+            from swiftmpi_tpu.transfer import api as transfer_api
+            self._numerics.sync()
+            transfer_api.clear_numerics_tap()
+            det = self._numerics.detector
+            self.train_metrics["numerics"] = {
+                "bundles": self._numerics.bundles,
+                "anomalies": det.anomalies_emitted if det else 0}
         if owns_rec and tel_rec is not None:
             tel_rec.close()
             obs.uninstall_recorder()
         return losses
+
+    def _arm_numerics(self, tel_rec) -> None:
+        """Arm the numerics plane (observe-only here: GloVe has no
+        control plane, so anomalies are telemetry events, never knob
+        actions).  Mirrors Word2Vec._arm_numerics minus the controller
+        and checkpoint-carry pieces."""
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        from swiftmpi_tpu.transfer import api as transfer_api
+        if self._numerics is None:
+            self._numerics = obs_numerics.NumericsCollector(
+                detector=obs_numerics.detector_from_config(self.config))
+            self._step = None
+        transfer_api.set_numerics_tap(self._numerics.quant_tap)
+        if id(tel_rec) != self._numerics_rec_id:
+            tel_rec.add_sampler(self._numerics.sampler)
+            self._numerics_rec_id = id(tel_rec)
 
     # -- outputs -----------------------------------------------------------
     def _vectors(self) -> np.ndarray:

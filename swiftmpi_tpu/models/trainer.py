@@ -110,6 +110,10 @@ class Trainer:
         # no sketch, no knobs — which emits control/evaluation events
         # with the traffic delta each cadence tick)
         self.controller = None
+        # numerics health plane: arm_numerics() a NumericsCollector and
+        # the step ships grad/update/param mass + nonfinite counts per
+        # dispatch.  None (default) traces nothing extra
+        self._numerics = None
 
     # -- state ------------------------------------------------------------
     def init_state(self, key) -> TrainState:
@@ -155,16 +159,30 @@ class Trainer:
 
         return walk(shapes)
 
+    # -- numerics health plane (obs/numerics.py) --------------------------
+    def arm_numerics(self, collector) -> None:
+        """Arm the numerics plane: ``collector`` (a
+        ``NumericsCollector``) receives one bundle per dispatched step.
+        Drops the compiled step — the bundle is baked in at trace
+        time.  Call with None to disarm (also recompiles)."""
+        self._numerics = collector
+        self._step_fn = None
+
     # -- the step ---------------------------------------------------------
     def _build_step(self):
         cfg, mesh, opt = self.cfg, self.mesh, self.optimizer
         aux_w = self.aux_weight
+        num = self._numerics
 
         @partial(jax.jit, donate_argnums=(0, 1, 2))
         def train_step(params, opt_state, step, tokens):
             loss, grads = jax.value_and_grad(lm_loss)(
                 params, tokens, cfg, mesh, aux_weight=aux_w)
             updates, opt_state = opt.update(grads, opt_state, params)
+            if num is not None:
+                from swiftmpi_tpu.obs import numerics as obs_numerics
+                obs_numerics.stage_dense(num, params, grads, updates,
+                                         loss)
             params = optax.apply_updates(params, updates)
             return params, opt_state, step + 1, loss
 

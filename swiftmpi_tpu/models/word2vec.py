@@ -326,6 +326,19 @@ class Word2Vec:
         self._control_recompiles = 0
         self._control_dirty = False
 
+        # [obs] numerics: the training-numerics health plane (ISSUE 13,
+        # obs/numerics.py).  Off (the default) constructs NOTHING and
+        # traces NOTHING extra into the step — trajectories are
+        # bit-identical to a build without the plane; on, the fused
+        # step ships a fixed-cost bundle (grad norms, update/param
+        # ratio, EF residual mass, quant error, nonfinite counts) to a
+        # host collector + anomaly detector armed in train().
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        self.numerics_on = obs_numerics.enabled(self.config)
+        self._numerics: Optional[obs_numerics.NumericsCollector] = None
+        self._numerics_restore = None   # checkpointed baseline bytes
+        self._numerics_rec_id: Optional[int] = None
+
         self.cluster = cluster or Cluster(self.config).initialize()
         # [cluster] data_plane (read by Cluster.initialize): steers the
         # stencil step's neu1 between the XLA gather->mask->sum chain
@@ -418,6 +431,12 @@ class Word2Vec:
         table state is donated — the update is in-place in HBM."""
         grads_fn = self._build_grads()
         apply_fn = self._build_apply()
+        # numerics plane: `num is None` (the default) leaves the traced
+        # program untouched — the branches below are Python-time
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        num = self._numerics
+        n_hot = self.table.n_hot
+        gfields = tuple(self.access.grad_fields)
 
         if self.stencil:
             @partial(jax.jit, donate_argnums=0)
@@ -426,7 +445,13 @@ class Word2Vec:
                 pushes, es, ec = grads_fn(
                     state, slot_of_vocab, alias_prob, alias_idx,
                     tokens, sent_id, center_pos, half, key)
-                return apply_fn(state, pushes), es, ec
+                out = apply_fn(state, pushes)
+                if num is not None:
+                    obs_numerics.stage_step(
+                        num, state, out,
+                        obs_numerics.spec_stats(pushes, n_hot),
+                        es, ec, gfields)
+                return out, es, ec
 
             return step_st
 
@@ -436,7 +461,13 @@ class Word2Vec:
             pushes, es, ec = grads_fn(
                 state, slot_of_vocab, alias_prob, alias_idx,
                 centers, contexts, ctx_mask, key)
-            return apply_fn(state, pushes), es, ec
+            out = apply_fn(state, pushes)
+            if num is not None:
+                obs_numerics.stage_step(
+                    num, state, out,
+                    obs_numerics.spec_stats(pushes, n_hot),
+                    es, ec, gfields)
+            return out, es, ec
 
         return step
 
@@ -474,22 +505,41 @@ class Word2Vec:
         if self.push_window_size > 1:
             return self._build_multi_step_windowed(n_inner, grads_fn)
         apply_fn = self._build_apply()
+        # numerics plane: armed, each scan step folds its push stats
+        # into extra scan outputs and ONE bundle ships per dispatch;
+        # off (num None), the traced program is exactly the legacy one
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        num = self._numerics
+        n_hot = self.table.n_hot
+        gfields = tuple(self.access.grad_fields)
 
         if self.stencil:
             @partial(jax.jit, donate_argnums=0)
             def multi_st(state, slot_of_vocab, alias_prob, alias_idx,
                          tokens_s, sids_s, cpos_s, half_s, key):
                 keys = jax.random.split(key, n_inner)
+                state0 = state
 
                 def body(state, xs):
                     t, s, c, h, k = xs
                     pushes, es, ec = grads_fn(
                         state, slot_of_vocab, alias_prob, alias_idx,
                         t, s, c, h, k)
-                    return apply_fn(state, pushes), (es, ec)
+                    if num is None:
+                        return apply_fn(state, pushes), (es, ec)
+                    return apply_fn(state, pushes), (
+                        es, ec, obs_numerics.spec_stats(pushes, n_hot))
 
-                state, (es, ec) = jax.lax.scan(
+                state, outs = jax.lax.scan(
                     body, state, (tokens_s, sids_s, cpos_s, half_s, keys))
+                if num is None:
+                    es, ec = outs
+                else:
+                    es, ec, stats = outs
+                    obs_numerics.stage_step(
+                        num, state0, state,
+                        tuple(s.sum() for s in stats),
+                        es.sum(), ec.sum(), gfields)
                 return state, es.sum(), ec.sum()
 
             return multi_st
@@ -498,15 +548,26 @@ class Word2Vec:
         def multi(state, slot_of_vocab, alias_prob, alias_idx,
                   centers_s, contexts_s, masks_s, key):
             keys = jax.random.split(key, n_inner)
+            state0 = state
 
             def body(state, xs):
                 c, x, m, k = xs
                 pushes, es, ec = grads_fn(
                     state, slot_of_vocab, alias_prob, alias_idx, c, x, m, k)
-                return apply_fn(state, pushes), (es, ec)
+                if num is None:
+                    return apply_fn(state, pushes), (es, ec)
+                return apply_fn(state, pushes), (
+                    es, ec, obs_numerics.spec_stats(pushes, n_hot))
 
-            state, (es, ec) = jax.lax.scan(
+            state, outs = jax.lax.scan(
                 body, state, (centers_s, contexts_s, masks_s, keys))
+            if num is None:
+                es, ec = outs
+            else:
+                es, ec, stats = outs
+                obs_numerics.stage_step(
+                    num, state0, state, tuple(s.sum() for s in stats),
+                    es.sum(), ec.sum(), gfields)
             return state, es.sum(), ec.sum()
 
         return multi
@@ -527,9 +588,19 @@ class Word2Vec:
         mesh = getattr(self.cluster, "mesh", None)
         replicated = (jax.sharding.NamedSharding(
             mesh, jax.sharding.PartitionSpec()) if mesh is not None else None)
+        # numerics plane: the stacked (W, ...) push buffers already
+        # exist per window, so armed stats fold over them with no extra
+        # scan outputs; off (num None) traces the legacy program
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        num = self._numerics
+        n_hot = self.table.n_hot
+        gfields = tuple(self.access.grad_fields)
 
         def run_windows(state, statics, keys, xs_all):
             es_tot, ec_tot = jnp.float32(0), jnp.float32(0)
+            state0 = state
+            if num is not None:
+                gacc = (jnp.float32(0), jnp.float32(0), jnp.int32(0))
             for s, e in bounds:
                 xs = tuple(x[s:e] for x in xs_all) + (keys[s:e],)
 
@@ -549,9 +620,15 @@ class Word2Vec:
                     pushes_s = jax.tree_util.tree_map(
                         lambda x: jax.lax.with_sharding_constraint(
                             x, replicated), pushes_s)
+                if num is not None:
+                    w = obs_numerics.spec_stats(pushes_s, n_hot)
+                    gacc = tuple(a + b for a, b in zip(gacc, w))
                 state = apply_window(state, pushes_s)
                 es_tot += es.sum()
                 ec_tot += ec.sum()
+            if num is not None:
+                obs_numerics.stage_step(num, state0, state, gacc,
+                                        es_tot, ec_tot, gfields)
             return state, es_tot, ec_tot
 
         if self.stencil:
@@ -1512,16 +1589,6 @@ class Word2Vec:
         # fused multi-step only makes sense single-process (distributed
         # batches are global arrays that cannot be host-stacked)
         fuse = sync and self.inner_steps > 1 and nprocs == 1
-        if self._step is None:
-            self._fused_cache = {}
-            if hogwild:
-                self._step = self._build_hogwild_step(
-                    max(self.local_steps, 1))
-            elif sync:
-                self._step = self._build_step()
-            else:
-                self._step = (jax.jit(self._build_grads()),
-                              jax.jit(self._build_apply()))
         batch_size = batch_size or max(
             256, self.minibatch // (2 * self.window))
         if batcher is None:
@@ -1567,6 +1634,21 @@ class Word2Vec:
                     _m.device_ms())
                 reg.gauge("train/words_per_sec").set(_m.rate())
             tel_rec.add_sampler(_tel_sample)
+        if self.numerics_on and tel_rec is not None:
+            self._arm_numerics(tel_rec)
+        # step compile AFTER numerics arming: the builders close over
+        # self._numerics at trace time, and a first-time arm drops any
+        # step compiled without the bundle
+        if self._step is None:
+            self._fused_cache = {}
+            if hogwild:
+                self._step = self._build_hogwild_step(
+                    max(self.local_steps, 1))
+            elif sync:
+                self._step = self._build_step()
+            else:
+                self._step = (jax.jit(self._build_grads()),
+                              jax.jit(self._build_apply()))
         # -- input pipeline setup (tentpole: prefetch-rendered,
         # pre-transferred batches).  The producer is gated to paths
         # where it can own rendering wholesale: hogwild does its own
@@ -1602,6 +1684,9 @@ class Word2Vec:
             # plan's crash-at-step-k means "after k completed steps"
             # regardless of how many attempts it took to get there
             faults.step_event(start_iter + it)
+            if faults.consume_nan():
+                state = self._poison_row(state)
+                frozen = state
             if hogwild:
                 err_sum, err_cnt, it_dropped = self._hogwild_epoch(
                     batcher, batch_size, meter)
@@ -1746,9 +1831,18 @@ class Word2Vec:
                                                         save_checkpoint)
                 # cumulative iteration: a resumed run must not rewind the
                 # counter, or a later resume re-trains finished iters
+                ck_extra = {"iter": np.int64(start_iter + it + 1)}
+                if self._numerics is not None \
+                        and self._numerics.detector is not None:
+                    # baselines ride along so a resumed run scores its
+                    # first windows against the learned regime instead
+                    # of re-warming (and false-alarming) from scratch
+                    self._numerics.sync()
+                    ck_extra["numerics"] = \
+                        self._numerics.detector.state_bytes()
                 save_checkpoint(
                     self.table, checkpoint_path,
-                    extra={"iter": np.int64(start_iter + it + 1)},
+                    extra=ck_extra,
                     retain=checkpoint_retain)
                 log.info("checkpoint @ iter %d -> %s", start_iter + it + 1,
                          checkpoint_path)
@@ -1780,6 +1874,18 @@ class Word2Vec:
             # so the registry mirror is exact before the summary lands
             self.train_metrics["transfer_traffic"] = \
                 self.transfer.traffic()
+        if self._numerics is not None:
+            # drain in-flight bundle callbacks (safe point: dispatches
+            # retired), then disarm the process-global quant tap — a
+            # numerics-off model training next in this process must
+            # trace (and book) nothing
+            from swiftmpi_tpu.transfer import api as transfer_api
+            self._numerics.sync()
+            transfer_api.clear_numerics_tap()
+            det = self._numerics.detector
+            self.train_metrics["numerics"] = {
+                "bundles": self._numerics.bundles,
+                "anomalies": det.anomalies_emitted if det else 0}
         if owns_rec and tel_rec is not None:
             tel_rec.close()
             obs.uninstall_recorder()
@@ -1872,6 +1978,17 @@ class Word2Vec:
         if self.vocab is not None:
             slots = self.table.key_index.lookup(self.vocab.keys)
             self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
+        num_state = extra.get("numerics")
+        if num_state is not None:
+            # detector baselines ride the checkpoint (ISSUE 13): loaded
+            # now if the plane is already armed, else stashed for
+            # _arm_numerics — either way the first post-restore window
+            # scores against the learned regime, not a cold baseline
+            if self._numerics is not None \
+                    and self._numerics.detector is not None:
+                self._numerics.detector.load_state_bytes(num_state)
+            else:
+                self._numerics_restore = num_state
         return int(extra.get("iter", 0))
 
     # -- embeddings out/in (word2vec.h:100-117; cluster.h:41-54) -----------
@@ -2160,6 +2277,65 @@ class Word2Vec:
         # so the new crossover takes effect at this safe point
         self._rebuild_step()
         return True
+
+    # -- numerics health plane (obs/numerics.py; [obs] numerics) -----------
+    def _arm_numerics(self, tel_rec) -> None:
+        """Arm the numerics health plane for this train() call: build
+        the collector + detector once, restore checkpointed baselines,
+        install the registry sampler on the recorder, point the
+        transfer-wide quantization-error tap at the collector, and
+        register the Controller demote hook.  A first-time arm drops
+        any step compiled before it — the traced bundle is baked in at
+        trace time, so train() compiles AFTER this runs."""
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        from swiftmpi_tpu.transfer import api as transfer_api
+        if self._numerics is None:
+            det = obs_numerics.detector_from_config(self.config)
+            if self._numerics_restore is not None:
+                det.load_state_bytes(self._numerics_restore)
+                self._numerics_restore = None
+            self._numerics = obs_numerics.NumericsCollector(detector=det)
+            self._step = None
+            self._fused_cache = {}
+            if self.controller is not None:
+                self.controller.attach_numerics(det, self._numerics_demote)
+        transfer_api.set_numerics_tap(self._numerics.quant_tap)
+        if id(tel_rec) != self._numerics_rec_id:
+            # one sampler per recorder: train() may be called repeatedly
+            # against the same long-lived recorder (bench harness)
+            tel_rec.add_sampler(self._numerics.sampler)
+            self._numerics_rec_id = id(tel_rec)
+
+    def _poison_row(self, state: dict) -> dict:
+        """``nan`` fault consumption (testing/faults.py): overwrite one
+        live parameter row with NaN — the injectable stand-in for a
+        numerics blow-up the health plane must catch.  Returns the new
+        state (also installed on the table)."""
+        f = self.access.grad_fields[0]
+        state = dict(state)
+        state[f] = jnp.asarray(state[f]).at[0].set(jnp.nan)
+        self.table.state = state
+        log.warning("fault injection: poisoned %s row 0 with NaN", f)
+        return state
+
+    def _numerics_demote(self, anomaly: dict) -> Optional[str]:
+        """Controller-applied numerics action: sustained EF-residual
+        runaway drops ``wire_quant`` to lossless at the control plane's
+        safe point — the quantizer is banking error faster than the
+        residual drains, and kept on int8 the model walks away from the
+        lossless trajectory.  Returns the previous setting (for the
+        decision event) or None when already lossless."""
+        old = self.wire_quant
+        if old == "off":
+            return None
+        log.warning(
+            "numerics: sustained EF residual runaway on %s — demoting "
+            "wire_quant %s -> off", anomaly.get("series"), old)
+        self.wire_quant = "off"
+        if hasattr(self.transfer, "wire_quant"):
+            self.transfer.wire_quant = "off"
+        self._rebuild_step()
+        return old
 
     def embedding_index(self, field: str = "v"):
         """Cosine-similarity index over the LIVE table (no dump round

@@ -67,6 +67,15 @@ SERIES = frozenset({
     "fleet/step_ms_skew", "fleet/wire_bytes_imbalance",
     "fleet/members_live", "fleet/members_stalled", "fleet/members_dead",
     "fleet/straggler_rank",
+    # numerics health plane (obs/numerics.py, ISSUE 13): in-jit bundle
+    # gauges mirrored by NumericsCollector.sampler plus the detector's
+    # anomaly severity counter; ef_mass carries a field= label
+    "numerics/grad_norm", "numerics/grad_norm_hot",
+    "numerics/grad_norm_tail", "numerics/update_ratio", "numerics/loss",
+    "numerics/ef_mass", "numerics/nonfinite", "numerics/quant_err",
+    "numerics/anomalies",
+    # fleet-level numerics mirror (obs/collector.py)
+    "fleet/grad_norm_divergence", "fleet/anomalies",
 }) | frozenset("transfer/" + k for k in TRANSFER_KEYS)
 
 #: Dynamic-name families: an f-string series name passes the catalog

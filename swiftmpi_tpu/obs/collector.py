@@ -478,6 +478,43 @@ class FleetCollector:
             rows.append(row)
         return rows
 
+    # -- numerics health (obs/numerics.py, ISSUE 13) -----------------------
+    @staticmethod
+    def _member_anomalies(member: dict) -> Dict[str, int]:
+        """``numerics/anomaly`` event counts by severity for one member
+        (the stream ingest routes event lines into ``s.events``)."""
+        out: Dict[str, int] = {}
+        for s in member["_streams"]:
+            for ev in s.events:
+                if ev.get("kind") != "numerics/anomaly":
+                    continue
+                sev = str(ev.get("severity", "warning"))
+                out[sev] = out.get(sev, 0) + 1
+        return out
+
+    @staticmethod
+    def _grad_norms(member: dict) -> Dict[int, float]:
+        """step -> latest ``numerics/grad_norm`` gauge; later lives
+        overwrite overlapping steps, like :meth:`_per_step`."""
+        out: Dict[int, float] = {}
+        for s in member["_streams"]:
+            for r in s.records:
+                for gkey, v in (r.get("gauges") or {}).items():
+                    name, _ = parse_series_key(gkey)
+                    if name == "numerics/grad_norm":
+                        out[int(r["step"])] = float(v)
+        return out
+
+    def numerics_divergence(self) -> List[dict]:
+        """Cross-rank grad-norm divergence anomalies over the aligned
+        steps — the fleet half of the numerics health plane."""
+        from swiftmpi_tpu.obs import numerics as obs_numerics
+        per_step: Dict[int, Dict[str, float]] = {}
+        for key, m in self.members().items():
+            for step, v in self._grad_norms(m).items():
+                per_step.setdefault(step, {})[key] = v
+        return obs_numerics.cross_rank_divergence(per_step)
+
     # -- fleet summary -----------------------------------------------------
     @staticmethod
     def _p50(vals: List[float]) -> float:
@@ -528,6 +565,9 @@ class FleetCollector:
             if mean > 0:
                 imbalance = max(positive) / mean - 1.0
         unnoticed = self.unnoticed_deaths(at)
+        anomalies = {k: self._member_anomalies(m)
+                     for k, m in members.items()}
+        divergence = self.numerics_divergence()
         return {
             "v": FLEET_SCHEMA_V, "kind": "summary",
             "schema": FLEET_SCHEMA,
@@ -553,6 +593,16 @@ class FleetCollector:
             "recovered": sum(m["recovered"] for m in members.values()),
             "dropped": sum(m["dropped"] for m in members.values()),
             "unnoticed_deaths": unnoticed,
+            # numerics health plane (obs/numerics.py)
+            "numerics_anomalies": {k: v for k, v in anomalies.items()
+                                   if v},
+            "numerics_anomaly_total": sum(
+                sum(v.values()) for v in anomalies.values()),
+            "numerics_critical_total": sum(
+                v.get("critical", 0) for v in anomalies.values()),
+            "fleet_grad_norm_divergence": max(
+                (d["ratio"] for d in divergence), default=0.0),
+            "cross_rank_anomalies": len(divergence),
         }
 
     # -- merged timeline ---------------------------------------------------
@@ -612,10 +662,14 @@ class FleetCollector:
                 "last_step": m["last_step"],
                 "health": health[key], "exits": m["exits"],
                 "stall_episodes": self.stall_episodes(m),
+                "anomalies": self._member_anomalies(m),
                 "recovered": m["recovered"], "dropped": m["dropped"]})
         for ev in self._sup_events:
             recs.append({**ev, "kind": "sup/" + str(ev.get("kind"))})
         recs.extend(self._health_transitions(at))
+        for d in self.numerics_divergence():
+            recs.append({"v": FLEET_SCHEMA_V,
+                         "kind": "numerics/cross_rank", **d})
         rows = self.aligned()
         if max_rows is not None and len(rows) > max_rows:
             rows = rows[-max_rows:]
@@ -659,3 +713,7 @@ class FleetCollector:
             float(s["straggler_rank"])
             if s["straggler_rank"] is not None and
             str(s["straggler_rank"]).isdigit() else -1.0)
+        reg.gauge("fleet/grad_norm_divergence").set(
+            s["fleet_grad_norm_divergence"])
+        reg.gauge("fleet/anomalies").set(
+            float(s["numerics_anomaly_total"]))

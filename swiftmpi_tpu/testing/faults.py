@@ -54,7 +54,7 @@ def _obs_count(name: str, **labels) -> None:
     if reg.enabled:
         reg.counter(name, **labels).inc()
 
-_KINDS = ("crash", "hang", "corrupt_checkpoint", "kill")
+_KINDS = ("crash", "hang", "corrupt_checkpoint", "kill", "nan")
 
 
 class InjectedFault(RuntimeError):
@@ -185,10 +185,22 @@ class FaultPlan:
                                  signum=int(signum), marker=marker))
         return self
 
+    def nan_at_step(self, step: int, rank: Optional[int] = None,
+                    marker: Optional[str] = None) -> "FaultPlan":
+        """Arm a NaN poisoning at step ``step`` — the numerics-plane
+        fault (obs/numerics.py).  faults.py knows no model state, so
+        the fault only raises the :func:`consume_nan` flag; the
+        training loop that polls it (Word2Vec.train) overwrites one of
+        its own parameter rows with NaN, and the health plane must
+        report a ``nonfinite`` anomaly within one recorder flush."""
+        self.faults.append(Fault("nan", step=step, rank=rank,
+                                 marker=marker))
+        return self
+
     # -- event dispatch ----------------------------------------------------
     def on_step(self, step: int) -> None:
         for f in self.faults:
-            if f.kind not in ("crash", "hang", "kill"):
+            if f.kind not in ("crash", "hang", "kill", "nan"):
                 continue
             if f.step is not None and step != f.step:
                 continue
@@ -204,6 +216,10 @@ class FaultPlan:
                 log.warning("fault injection: killing rank %d (signal %d) "
                             "at step %d", _process_rank(), f.signum, step)
                 os.kill(os.getpid(), f.signum)
+            elif f.kind == "nan":
+                log.warning("fault injection: NaN poison armed at step %d",
+                            step)
+                _raise_nan_flag()
             else:
                 log.warning("fault injection: crashing at step %d", step)
                 raise InjectedFault(f"injected crash at step {step}")
@@ -249,6 +265,23 @@ class FaultPlan:
 _active: Optional[FaultPlan] = None
 _env_checked = False
 _observers: List[Callable[[str, object], None]] = []
+_nan_pending = False
+
+
+def _raise_nan_flag() -> None:
+    global _nan_pending
+    _nan_pending = True
+
+
+def consume_nan() -> bool:
+    """True exactly once per fired ``nan`` fault.  The training loop
+    that sees True poisons one of its own parameter rows — the fault
+    bus owns WHEN, the model owns WHAT (it knows its table layout)."""
+    global _nan_pending
+    if _nan_pending:
+        _nan_pending = False
+        return True
+    return False
 
 
 def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
@@ -260,9 +293,10 @@ def install(plan: Optional[FaultPlan]) -> Optional[FaultPlan]:
 
 
 def clear() -> None:
-    global _active, _env_checked
+    global _active, _env_checked, _nan_pending
     _active = None
     _env_checked = False
+    _nan_pending = False
 
 
 def active() -> Optional[FaultPlan]:

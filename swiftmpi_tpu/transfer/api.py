@@ -112,6 +112,33 @@ def quantize_dequantize(g, quant: str):
     return q * jnp.where(scale > 0, scale, 0.0)
 
 
+# -- numerics health tap (obs/numerics.py, ISSUE 13) ------------------------
+# When the numerics plane is armed it installs a tap here; every
+# EF/quantize path (ef_quantize_window for xla/tpu/hybrid, the local
+# oracle's numpy twin) books its pre-vs-post quantization error
+# sum-of-squares through it.  None (the default) traces NOTHING extra,
+# which is what keeps `[obs] numerics: off` bit-identical — callers
+# must rebuild/retrace their jitted steps when arming or clearing.
+_NUMERICS_TAP = None
+
+
+def set_numerics_tap(fn) -> None:
+    global _NUMERICS_TAP
+    _NUMERICS_TAP = fn
+
+
+def clear_numerics_tap() -> None:
+    set_numerics_tap(None)
+
+
+def numerics_quant_err(err_sq) -> None:
+    """Book one quantized window's error sum-of-squares (traced tracer
+    or eager scalar) into the armed numerics tap; no-op when off."""
+    tap = _NUMERICS_TAP
+    if tap is not None:
+        tap(err_sq)
+
+
 def ef_quantize_window(state, ded_slots, ded_grads, capacity: int,
                        quant: str):
     """Error-feedback quantize of one deduped window: drain each touched
@@ -146,6 +173,7 @@ def ef_quantize_window(state, ded_slots, ded_grads, capacity: int,
     gather_idx = jnp.clip(safe, 0, capacity - 1)
     out_state = dict(state)
     out_grads = dict(ded_grads)
+    err_sq = None
     for f, g in ded_grads.items():
         efk = ef_name(f)
         if efk not in state:
@@ -159,6 +187,11 @@ def ef_quantize_window(state, ded_slots, ded_grads, capacity: int,
         cleared = ef * (~touched)[:, None]
         out_state[efk] = cleared.at[safe].add(err, mode="drop")
         out_grads[f] = deq
+        if _NUMERICS_TAP is not None:
+            fsq = jnp.sum(err ** 2)
+            err_sq = fsq if err_sq is None else err_sq + fsq
+    if err_sq is not None:
+        numerics_quant_err(err_sq)
     return out_state, out_grads
 
 

@@ -14,6 +14,7 @@ import numpy as np
 from swiftmpi_tpu.parameter.access import AccessMethod
 from swiftmpi_tpu.parameter.sparse_table import ef_name
 from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
+                                       numerics_quant_err,
                                        pull_row_bytes,
                                        quant_grad_row_bytes,
                                        quantize_dequantize)
@@ -147,6 +148,8 @@ class LocalTransfer(Transfer):
             # drain residual, quantize the SUM, bank the new error —
             # same order of operations as api.ef_quantize_window
             state = dict(state)
+            err_sq = 0.0
+            banked = False
             for f in list(sums):
                 efk = ef_name(f)
                 if efk not in state:
@@ -159,6 +162,10 @@ class LocalTransfer(Transfer):
                 ef[uniq] = tot - deq
                 state[efk] = ef
                 sums[f] = deq
+                err_sq += float(np.sum((tot - deq) ** 2))
+                banked = True
+            if banked:
+                numerics_quant_err(err_sq)
             wire = (quant_grad_row_bytes(sums, self.wire_quant,
                                          with_counts=True), 0)
         else:       # bitmap: same payload at mask-indexed encoding

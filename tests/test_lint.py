@@ -309,6 +309,33 @@ def test_telemetry_trips_on_undeclared_fleet_series(tmp_path):
     assert "fleet/step_ms_skoo" in new[0].message
 
 
+def test_telemetry_covers_numerics_series(tmp_path):
+    """ISSUE 13 satellite: the numerics health plane's series are
+    catalog-declared like any other — the collector sampler, the
+    detector's severity-labeled anomaly counter, and the ef_mass
+    field-labeled gauge all pass as written."""
+    new = lint_src(tmp_path, "pkg/obs/numerics.py", """
+    def sample(reg, ef_mass, sev):
+        reg.gauge("numerics/grad_norm").set(1.0)
+        reg.gauge("numerics/ef_mass", field="w").set(0.1)
+        reg.counter("numerics/nonfinite").set_total(0.0)
+        reg.counter("numerics/quant_err").set_total(0.0)
+        reg.counter("numerics/anomalies", severity=sev).inc()
+        reg.gauge("fleet/grad_norm_divergence").set(1.0)
+        reg.gauge("fleet/anomalies").set(0.0)
+    """)
+    assert new == []
+
+
+def test_telemetry_trips_on_undeclared_numerics_series(tmp_path):
+    new = lint_src(tmp_path, "pkg/obs/numerics.py", """
+    def sample(reg):
+        reg.gauge("numerics/grad_nrom").set(1.0)
+    """)
+    assert rules_of(new) == {"TELEMETRY-CATALOG"}
+    assert "numerics/grad_nrom" in new[0].message
+
+
 def test_telemetry_checks_both_ifexp_branches(tmp_path):
     new = lint_src(tmp_path, "pkg/thing.py", """
     def record(reg, ok):
