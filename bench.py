@@ -88,25 +88,77 @@ _DEVICE_PEAKS = {
 }
 
 
-def _roofline(device, step_s, hbm_bytes=None, flops=None) -> dict:
+def _catalog_measured(fn) -> dict:
+    """Per-step XLA-measured numbers for one (or the first present of
+    several) cost-catalog fn names (ISSUE 14): the catalog's measured
+    flops/bytes are per *call*, so fused-scan entries divide by their
+    recorded steps_per_call.  Empty when the catalog is disarmed
+    (SMTPU_COSTS unset) or the fn never compiled in this process."""
+    if not fn:
+        return {}
+    from swiftmpi_tpu.obs import costs as obs_costs
+    cat = obs_costs.get_catalog()
+    if not cat.enabled:
+        return {}
+    names = (fn,) if isinstance(fn, str) else tuple(fn)
+    for name in names:
+        e = cat.entry(name)
+        if not e:
+            continue
+        spc = max(int(e.get("steps_per_call", 1)), 1)
+        out = {"fn": name}
+        if e.get("flops"):
+            out["flops"] = e["flops"] / spc
+        if e.get("bytes_accessed"):
+            out["bytes"] = e["bytes_accessed"] / spc
+        if e.get("peak_bytes"):
+            out["peak_bytes"] = e["peak_bytes"]    # per-call, live-at-once
+        if len(out) > 1:
+            return out
+    return {}
+
+
+def _roofline(device, step_s, hbm_bytes=None, flops=None,
+              fn=None) -> dict:
     """Utilization fields for one cell.  ``hbm_bytes``/``flops`` are the
     per-step traffic/work models documented at each call site; MFU is
     against the dense bf16 peak (the standard convention — fp32 cells
-    report conservatively low)."""
+    report conservatively low).  ``fn`` names the cell's cost-catalog
+    entry (or a preference-ordered tuple of candidates): when the
+    catalog is armed, the XLA-measured flops/bytes ship next to the
+    hand model with drift percentages, and cells whose hand FLOP model
+    is absent (mfu_pct "n/a") gain a measured ``mfu_pct_xla``."""
     kind = getattr(device, "device_kind", None)
     peaks = _DEVICE_PEAKS.get(kind)
     if not step_s:
         return {}
+    meas = _catalog_measured(fn)
+    xla = {}
+    if meas:
+        xla["xla_fn"] = meas["fn"]
+        if "flops" in meas:
+            xla["xla_flops"] = round(meas["flops"], 1)
+            if flops:
+                xla["flops_drift_pct"] = round(
+                    100.0 * (flops - meas["flops"]) / meas["flops"], 1)
+        if "bytes" in meas:
+            xla["xla_bytes"] = round(meas["bytes"], 1)
+            if hbm_bytes:
+                xla["bytes_drift_pct"] = round(
+                    100.0 * (hbm_bytes - meas["bytes"]) / meas["bytes"],
+                    1)
+        if "peak_bytes" in meas:
+            xla["xla_peak_hbm_bytes"] = int(meas["peak_bytes"])
     if not peaks:
         # round-4 verdict Weak #4: an unknown device must say so
         # explicitly instead of silently dropping the utilization
         # fields the verdict asked every chip cell to carry
         if getattr(device, "platform", None) == "tpu":
             return {"roofline": f"unavailable: no peak table entry "
-                                f"for device_kind={kind!r}"}
-        return {}
+                                f"for device_kind={kind!r}", **xla}
+        return xla
     hbm_peak, tflops_peak = peaks
-    out = {}
+    out = dict(xla)
     if hbm_bytes:
         gbps = hbm_bytes / step_s / 1e9
         out["hbm_gbps"] = round(gbps, 1)
@@ -128,6 +180,12 @@ def _roofline(device, step_s, hbm_bytes=None, flops=None) -> dict:
             # bound, and a rendered 0.0 reads as "not computed" (r5
             # verdict Next #7): say n/a and let hbm_pct rule the cell
             out["mfu_pct"] = "n/a"
+    if meas.get("flops"):
+        t = meas["flops"] / step_s / 1e12
+        out["tflops_xla"] = round(t, 2)
+        # measured MFU answers the "n/a" cells: XLA counted the flops,
+        # so even transaction-bound programs get a real (tiny) number
+        out["mfu_pct_xla"] = round(100.0 * t / tflops_peak, 2)
     return out
 
 
@@ -350,7 +408,8 @@ def _bench_w2v(device, timed_calls, built=None, inner_steps=None):
            "host_stall_ms": 0.0, "stall_ms_per_step": 0.0}
     out.update(_roofline(
         device, dt / (timed_calls * n_inner),
-        hbm_bytes=_w2v_step_bytes(model, batches[0].centers.shape[0])))
+        hbm_bytes=_w2v_step_bytes(model, batches[0].centers.shape[0]),
+        fn=("w2v_multi", "w2v_step")))
     return out
 
 
@@ -484,7 +543,9 @@ def _bench_lr(device, timed_calls):
         # Next #7: at a9a scale the MXU fraction rounds to n/a)
         bytes_ = (2.0 * LR_BATCH * cap * 4 + 4.0 * cap * 4) * len(prepared)
         out.update(_roofline(device, dt / (timed_calls * E), flops=flops,
-                             hbm_bytes=bytes_))
+                             hbm_bytes=bytes_,
+                             fn=("lr_dense_multi", "lr_dense_step",
+                                 "lr_multi", "lr_step")))
     return out
 
 
@@ -737,7 +798,8 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
                 tr["coalesced_rows_in"] / max(tr["coalesced_rows_out"], 1),
                 2)
     out.update(_roofline(device, dt / (timed_calls * INNER_STEPS),
-                         hbm_bytes=_w2v_step_bytes(model, B)))
+                         hbm_bytes=_w2v_step_bytes(model, B),
+                         fn=("w2v_multi", "w2v_step")))
     return out
 
 
@@ -1560,7 +1622,8 @@ def _bench_glove(device, timed_calls):
     # transaction accounting as _w2v_step_bytes
     row_bytes = (m.len_vec + 1) * 4
     out.update(_roofline(device, dt / (timed_calls * INNER),
-                         hbm_bytes=2 * B * row_bytes * 5))
+                         hbm_bytes=2 * B * row_bytes * 5,
+                         fn="glove_step"))
     return out
 
 
@@ -1655,7 +1718,8 @@ def _bench_tfm(device, timed_calls):
     # recompute is NOT counted as useful work (standard MFU convention)
     flops_per_tok = 6.0 * n_params + 12.0 * cfg.n_layers * S * cfg.d_model
     out.update(_roofline(device, dt / timed_calls,
-                         flops=flops_per_tok * B * S))
+                         flops=flops_per_tok * B * S,
+                         fn="trainer_step"))
     return out
 
 
@@ -1736,6 +1800,17 @@ def _bench_cpp_oracle():
 
 def child_main(which: str) -> None:
     import jax
+
+    if os.environ.get("SMTPU_COSTS", "") not in ("", "0"):
+        # roofline cells report XLA-measured flops/bytes next to the
+        # hand models (ISSUE 14); memory_analysis off — its extra
+        # backend compile would double every cell's warmup
+        from swiftmpi_tpu.obs import costs as obs_costs
+        cat = obs_costs.get_catalog()
+        cat.enabled, cat.memory, cat.run = True, False, "bench"
+        cat.path = os.path.join("runs", "compile_catalog.json")
+        from swiftmpi_tpu import obs
+        obs.set_enabled(True)
 
     devs = jax.devices()           # platform already pinned via child env
     device = devs[0]

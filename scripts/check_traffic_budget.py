@@ -66,7 +66,8 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "straggler_rank", "members_dead", "unnoticed_deaths",
                   "fleet_restarts", "aligned_steps",
                   "numerics_anomalies", "numerics_critical",
-                  "numerics_nonfinite", "cross_rank_anomalies")
+                  "numerics_nonfinite", "cross_rank_anomalies",
+                  "retraces", "compile_ms", "peak_hbm_bytes")
 #: absolute increase a metric must clear before it can regress: wall-
 #: clock metrics jitter run to run while the counter metrics are exact,
 #: so only the former get a floor (ms for the stall split; kernel_ms is
@@ -159,6 +160,40 @@ def load_telemetry_cells(path: str) -> dict:
                              row["last"] / max(row["mean"], 1e-12))
         if growth:
             cell["ef_mass_growth"] = growth
+    # compiler-cost plane (obs/costs.py): steady-state retrace count is
+    # a hard candidate-side gate (retrace_violations); compile_ms and
+    # the peak live-at-once HBM bound are advisory detail cells.  All
+    # absent when [obs] costs was off, so a costs-off baseline never
+    # blocks a costs-on candidate
+    retraces = compile_ms = 0.0
+    peak = 0.0
+    saw_compile = False
+    if doc["summary"] is not None:
+        totals = doc["summary"].get("counters") or {}
+    else:
+        totals = {}
+        for rec in doc["steps"]:
+            for key, delta in (rec.get("counters") or {}).items():
+                totals[key] = totals.get(key, 0.0) + delta
+    for key, v in totals.items():
+        name = parse_series_key(key)[0]
+        if name == "compile/retraces":
+            retraces += float(v)
+            saw_compile = True
+        elif name == "compile/compile_ms":
+            compile_ms += float(v)
+            saw_compile = True
+        elif name == "compile/compiles":
+            saw_compile = True
+    for rec in doc["steps"]:
+        for key, v in (rec.get("gauges") or {}).items():
+            if parse_series_key(key)[0] == "compile/peak_bytes":
+                peak = max(peak, float(v))
+    if saw_compile:
+        cell["retraces"] = retraces
+        cell["compile_ms"] = compile_ms
+        if peak:
+            cell["peak_hbm_bytes"] = peak
     run = str(doc["meta"].get("run", "telemetry"))
     cells = {run: cell} if cell else {}
     # kernel microbench streams (obs.micro.MicroTelemetry): every
@@ -329,6 +364,26 @@ def numerics_violations(cells: dict) -> list:
     return bad
 
 
+def retrace_violations(base: dict, cand: dict) -> list:
+    """Candidate cells whose steady-state retrace count exceeds the
+    baseline's (floor 1: one late retrace — a tail batch, a control
+    safe-point — is tolerated even against a zero baseline).  A retrace
+    storm multiplies step latency by compile time regardless of how the
+    wire counters look, so it fails against the BASELINE count rather
+    than tolerance-scaling: retraces are exact integers, not noisy
+    measurements.  Cells where the candidate lacks the metric (costs
+    off) are skipped."""
+    bad = []
+    for cell in sorted(set(base) & set(cand)):
+        c = cand[cell].get("retraces")
+        if c is None:
+            continue
+        b = float(base[cell].get("retraces", 0.0) or 0.0)
+        if float(c) > max(b, 1.0):
+            bad.append((cell, b, float(c)))
+    return bad
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fail when bench traffic counters regressed")
@@ -399,6 +454,17 @@ def main(argv=None) -> int:
             print(f"  {cell}: {nonfin} nonfinite value(s), {crit} "
                   "critical anomaly event(s) — run is numerically "
                   "broken")
+        return 1
+
+    storms = retrace_violations(
+        {c: m for c, m in base.items() if not only or c in only},
+        {c: m for c, m in cand.items() if not only or c in only})
+    if storms:
+        print("RETRACE BUDGET EXCEEDED:")
+        for cell, b, c in storms:
+            print(f"  {cell}: {c:g} retrace(s) vs baseline {b:g} "
+                  "(floor 1) — a compiled step is re-tracing; look for "
+                  "shape/dtype churn in telemetry_report --compile")
         return 1
 
     regressions = compare(base, cand, args.tolerance, only)

@@ -94,6 +94,20 @@ def _member_fmt_mix(member: dict) -> dict:
     return mix or legacy
 
 
+def _member_retraces(member: dict) -> int:
+    """Total ``compile/retraces`` across one member's records — the
+    retrace-storm column (obs/costs.py); 0 when costs are off OR the
+    run genuinely reached steady state, which render() shows as '-'
+    vs '0' being indistinguishable on purpose (both are healthy)."""
+    total = 0
+    for s in member["_streams"]:
+        for rec in s.records:
+            for key, delta in (rec.get("counters") or {}).items():
+                if parse_series_key(key)[0] == "compile/retraces":
+                    total += int(delta)
+    return total
+
+
 def frame(fc: FleetCollector) -> dict:
     """One machine-shaped inspector frame (the --json payload)."""
     members = fc.members()
@@ -122,6 +136,7 @@ def frame(fc: FleetCollector) -> dict:
             "phases": _member_phases(m),
             "wire_bytes": summary["wire_bytes"].get(key, 0.0),
             "fmt_mix": _member_fmt_mix(m),
+            "retraces": _member_retraces(m),
             "restarts": m["restarts"],
             "heartbeats": m["heartbeats"],
             "stalls": len(fc.stall_episodes(m)),
@@ -144,7 +159,7 @@ def render(fr: dict) -> str:
         f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}",
         f"{'RANK':<6}{'PID':>8}{'HEALTH':>9}{'STEP':>7}{'ST/S':>8}"
         f"{'P50MS':>8}{'P95MS':>8}{'WIRE':>12}{'GNORM':>9}{'HB':>5}"
-        f"{'RST':>4}  FMT-MIX / FLAGS",
+        f"{'RST':>4}{'RTRC':>5}  FMT-MIX / FLAGS",
     ]
     for r in fr["members"]:
         mix = ",".join(f"{k}:{v}" for k, v in sorted(r["fmt_mix"].items()))
@@ -165,7 +180,8 @@ def render(fr: dict) -> str:
             f"{r['steps_per_s']:>8.2f}{r['step_ms_p50']:>8.1f}"
             f"{r['step_ms_p95']:>8.1f}{r['wire_bytes']:>12,.0f}"
             f"{gnorm}"
-            f"{r['heartbeats']:>5}{r['restarts']:>4}  "
+            f"{r['heartbeats']:>5}{r['restarts']:>4}"
+            f"{r.get('retraces', 0):>5}  "
             f"{mix or '-'}"
             + (("  " + " ".join(flags)) if flags else ""))
     if s["unnoticed_deaths"]:

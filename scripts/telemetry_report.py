@@ -193,7 +193,8 @@ def load(path: str) -> dict:
         elif kind == "heartbeat":
             heartbeats += 1
         elif isinstance(kind, str) and (kind.startswith("control/")
-                                        or kind.startswith("numerics/")):
+                                        or kind.startswith("numerics/")
+                                        or kind.startswith("profile/")):
             events.append(rec)
     return {"meta": meta, "steps": steps, "events": events,
             "heartbeats": heartbeats, "summary": summary,
@@ -389,6 +390,69 @@ def numerics_summary(doc: dict) -> dict:
                 if parse_series_key(k)[0] == "numerics/nonfinite")}
 
 
+def compile_summary(doc: dict, catalog: Optional[dict] = None) -> dict:
+    """The compiler-cost plane (obs/costs.py): per-fn compile, retrace
+    and compile-ms totals from the ``compile/*{fn=}`` counters, the
+    last XLA-measured flops/bytes/peak gauges, and — when a
+    ``smtpu-costs/1`` catalog doc is supplied — the catalog's
+    hand-model drift columns merged in.  ``profile/capture`` events
+    (triggered profiler windows) ride along as a timeline.  Empty when
+    ``[obs] costs`` was off for the run."""
+    if doc["summary"] is not None:
+        totals = dict(doc["summary"].get("counters") or {})
+    else:
+        totals = {}
+        for rec in doc["steps"]:
+            for key, delta in (rec.get("counters") or {}).items():
+                totals[key] = totals.get(key, 0.0) + delta
+    fns: Dict[str, dict] = {}
+
+    def fn_row(labels):
+        return fns.setdefault(labels.get("fn", "?"), {
+            "compiles": 0, "retraces": 0, "compile_ms": 0.0})
+
+    for key, v in totals.items():
+        name, labels = parse_series_key(key)
+        if name == "compile/compiles":
+            fn_row(labels)["compiles"] += int(v)
+        elif name == "compile/retraces":
+            fn_row(labels)["retraces"] += int(v)
+        elif name == "compile/compile_ms":
+            fn_row(labels)["compile_ms"] += float(v)
+    for rec in doc["steps"]:
+        for key, v in (rec.get("gauges") or {}).items():
+            name, labels = parse_series_key(key)
+            if name == "compile/flops":
+                fn_row(labels)["flops"] = float(v)
+            elif name == "compile/bytes":
+                fn_row(labels)["bytes"] = float(v)
+            elif name == "compile/peak_bytes":
+                fn_row(labels)["peak_bytes"] = float(v)
+    cat_fns = (catalog or {}).get("fns") or {}
+    for name, e in cat_fns.items():
+        row = fns.setdefault(name, {"compiles": int(e.get("compiles", 0)),
+                                    "retraces": int(e.get("retraces", 0)),
+                                    "compile_ms": float(
+                                        e.get("compile_ms_total", 0.0))})
+        for k in ("flops", "bytes_accessed", "peak_bytes",
+                  "steps_per_call", "hand_flops", "hand_bytes",
+                  "flops_drift_pct", "bytes_drift_pct"):
+            if e.get(k) is not None:
+                row["bytes" if k == "bytes_accessed" else k] = e[k]
+    captures = []
+    for rec in doc["events"]:
+        if rec.get("kind") != "profile/capture":
+            continue
+        captures.append({k: rec.get(k) for k in
+                         ("step", "run_dir", "reason", "start_step",
+                          "steps", "files", "events")})
+    captures.sort(key=lambda c: c.get("step") or 0)
+    return {"fns": fns, "captures": captures,
+            "retraces_total": sum(r["retraces"] for r in fns.values()),
+            "compile_ms_total": sum(r["compile_ms"]
+                                    for r in fns.values())}
+
+
 def traffic_summary(doc: dict) -> dict:
     """Cumulative counters (prefer the summary line's authoritative
     totals; fall back to summing step deltas for a crashed run) grouped
@@ -435,7 +499,8 @@ def traffic_summary(doc: dict) -> dict:
     return out
 
 
-def report(doc: dict, phases_only: bool = False) -> dict:
+def report(doc: dict, phases_only: bool = False,
+           catalog: Optional[dict] = None) -> dict:
     out = {"meta": {k: doc["meta"].get(k)
                     for k in ("schema", "run", "rank", "ident", "pid")},
            "phases": phase_table(doc)}
@@ -448,6 +513,7 @@ def report(doc: dict, phases_only: bool = False) -> dict:
         out["decisions"] = decision_timeline(doc)
         out["control"] = control_summary(doc)
         out["numerics"] = numerics_summary(doc)
+        out["compile"] = compile_summary(doc, catalog=catalog)
     return out
 
 
@@ -712,6 +778,52 @@ def _print_numerics(num: dict) -> None:
                   f"{val_s}{detail}")
 
 
+def _fmt_qty(v, unit="") -> str:
+    if v is None:
+        return "-"
+    for scale, suffix in ((1e12, "T"), (1e9, "G"), (1e6, "M"),
+                          (1e3, "K")):
+        if abs(v) >= scale:
+            return f"{v / scale:.2f}{suffix}{unit}"
+    return f"{v:.0f}{unit}"
+
+
+def _print_compile(comp: dict) -> None:
+    print()
+    print("compile catalog:")
+    if not comp["fns"]:
+        print("  (no compile/* series — [obs] costs off for this run)")
+        return
+    w = max(len(n) for n in comp["fns"]) + 2
+    print(f"  {'fn'.ljust(w)}{'compiles':>9}{'retraces':>9}"
+          f"{'compile_ms':>12}{'flops':>10}{'bytes':>10}"
+          f"{'peak':>10}{'drift':>14}")
+    for name in sorted(comp["fns"]):
+        r = comp["fns"][name]
+        drift = ""
+        if r.get("flops_drift_pct") is not None:
+            drift += f"f{r['flops_drift_pct']:+.1f}%"
+        if r.get("bytes_drift_pct") is not None:
+            drift += f" b{r['bytes_drift_pct']:+.1f}%"
+        print(f"  {name.ljust(w)}{r['compiles']:>9}{r['retraces']:>9}"
+              f"{r['compile_ms']:>12.1f}"
+              f"{_fmt_qty(r.get('flops')):>10}"
+              f"{_fmt_qty(r.get('bytes')):>10}"
+              f"{_fmt_qty(r.get('peak_bytes')):>10}"
+              f"{drift or '-':>14}")
+    print(f"  total: {comp['compile_ms_total']:.1f}ms compiling, "
+          f"{comp['retraces_total']} retrace(s)")
+    if comp["retraces_total"]:
+        print("  RETRACES SEEN: a compiled program re-traced — look for "
+              "shape/dtype churn on the fns above")
+    if comp["captures"]:
+        print("  profile captures:")
+        for c in comp["captures"]:
+            print(f"    step {c.get('start_step')}: {c.get('steps')} "
+                  f"step(s) [{c.get('reason')}] -> {c.get('run_dir')} "
+                  f"({c.get('events')} trace event(s))")
+
+
 def _print_report(rep: dict) -> None:
     m = rep["meta"]
     print(f"run={m.get('run')} ident={m.get('ident')} "
@@ -772,6 +884,8 @@ def _print_report(rep: dict) -> None:
                     print(f"      evidence: {ev_s}")
     if "numerics" in rep:
         _print_numerics(rep["numerics"])
+    if "compile" in rep:
+        _print_compile(rep["compile"])
     if "traffic" in rep:
         t = rep["traffic"]
         print()
@@ -805,6 +919,14 @@ def main(argv=None) -> int:
                     help="only the numerics-health section: numerics/* "
                     "series stats, nonfinite totals and the anomaly "
                     "timeline (smtpu-numerics/1 events)")
+    ap.add_argument("--compile", dest="compile_only",
+                    action="store_true",
+                    help="only the compile-catalog section: per-fn "
+                    "compile/retrace/compile_ms, XLA flops/bytes and "
+                    "profile-capture timeline (compile/* series)")
+    ap.add_argument("--catalog", default=None, metavar="JSON",
+                    help="a runs/compile_catalog.json (smtpu-costs/1) "
+                    "to merge hand-model drift columns from")
     ap.add_argument("--fleet", action="store_true",
                     help="treat path as an smtpu-fleet/1 merged "
                     "timeline (or a fleet dir): per-rank columns, "
@@ -819,6 +941,20 @@ def main(argv=None) -> int:
         else:
             _print_fleet_report(rep)
         return 0
+    catalog = None
+    if args.catalog:
+        try:
+            with open(args.catalog) as f:
+                catalog = json.load(f)
+        except (OSError, ValueError) as e:
+            print(f"telemetry_report: cannot read catalog "
+                  f"{args.catalog}: {e}", file=sys.stderr)
+            raise SystemExit(2)
+        if not str(catalog.get("schema", "")).startswith("smtpu-costs/"):
+            print(f"telemetry_report: {args.catalog} is not a cost "
+                  f"catalog (schema={catalog.get('schema')!r})",
+                  file=sys.stderr)
+            raise SystemExit(2)
     if args.numerics:
         doc = load(args.path)
         num = numerics_summary(doc)
@@ -832,7 +968,21 @@ def main(argv=None) -> int:
                   f"schema={m.get('schema')}")
             _print_numerics(num)
         return 0
-    rep = report(load(args.path), phases_only=args.phases_only)
+    if args.compile_only:
+        doc = load(args.path)
+        comp = compile_summary(doc, catalog=catalog)
+        if args.json:
+            json.dump({"meta": doc["meta"], "compile": comp},
+                      sys.stdout, indent=2)
+            print()
+        else:
+            m = doc["meta"]
+            print(f"run={m.get('run')} ident={m.get('ident')} "
+                  f"schema={m.get('schema')}")
+            _print_compile(comp)
+        return 0
+    rep = report(load(args.path), phases_only=args.phases_only,
+                 catalog=catalog)
     if args.json:
         json.dump(rep, sys.stdout, indent=2)
         print()

@@ -39,6 +39,15 @@ Flags (reference CMDLine style, ``-key value``):
                     ``D/supervisor.jsonl``, so a FleetCollector can
                     correlate a rank's silence with *why* it went
                     silent.
+* ``-profile-at N`` — pre-arm a triggered profiler window on EVERY
+                    rank: children get ``SMTPU_PROFILE_AT=N`` and each
+                    rank's ProfileSession (obs/profiler.py) captures a
+                    bounded ``jax.profiler`` trace when its consumed-
+                    step count reaches N.  For a live run, use
+                    ``python -m swiftmpi_tpu.obs.profiler <fleet_dir>``
+                    instead — the trigger file reaches running ranks.
+* ``-profile-steps K`` — capture window length for ``-profile-at``
+                    (``SMTPU_PROFILE_STEPS``; default 5).
 
 Children inherit stdout/stderr with a ``[rank k]`` line prefix; first
 non-zero exit terminates the rest (mpirun semantics): survivors get
@@ -311,10 +320,24 @@ def main(args: Optional[List[str]] = None) -> int:
     cmd.registerParameter("backoff", "initial restart backoff seconds")
     cmd.registerParameter("fleet-dir",
                           "fleet telemetry directory (ISSUE 12)")
+    cmd.registerParameter("profile-at",
+                          "pre-arm a profiler capture at step N on "
+                          "every rank (ISSUE 14)")
+    cmd.registerParameter("profile-steps",
+                          "profiler capture window length")
     prog = args[split + 1:]
     if not prog:
         print("launch: nothing to run after --", file=sys.stderr)
         return 2
+    # profiler pre-arm rides the inherited environment: _child_env
+    # copies os.environ, so every rank of every restart attempt sees it
+    from swiftmpi_tpu.obs import profiler as obs_profiler
+    if cmd.hasParameter("profile-at"):
+        os.environ[obs_profiler.ENV_PROFILE_AT] = str(
+            int(cmd.get_value("profile-at")))
+    if cmd.hasParameter("profile-steps"):
+        os.environ[obs_profiler.ENV_PROFILE_STEPS] = str(
+            int(cmd.get_value("profile-steps")))
     return supervise(
         prog,
         nprocs=int(cmd.get_value("np")) if cmd.hasParameter("np") else 1,

@@ -217,7 +217,10 @@ class GloVe:
                 ("w", "wt", "b", "bt"))
             return state, losses.sum()
 
-        return jax.jit(multi, donate_argnums=(0,))
+        from swiftmpi_tpu import obs
+        return obs.costs.track("glove_step",
+                               jax.jit(multi, donate_argnums=(0,)),
+                               steps_per_call=max(1, self.inner_steps))
 
     # -- minibatch staging -------------------------------------------------
     def stage_host(self, sel: np.ndarray, inner: int, B: int):

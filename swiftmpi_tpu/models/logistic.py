@@ -114,7 +114,8 @@ class LogisticRegression:
         return new_state, loss, row_valid.sum()
 
     def _build_step(self):
-        return jax.jit(self._step_core)
+        from swiftmpi_tpu import obs
+        return obs.costs.track("lr_step", jax.jit(self._step_core))
 
     def _build_scan(self, core):
         """Scan a fused step over a stack of minibatches in ONE dispatch.
@@ -140,7 +141,9 @@ class LogisticRegression:
         return multi
 
     def _build_multi_step(self):
-        return self._build_scan(self._step_core)
+        from swiftmpi_tpu import obs
+        return obs.costs.track("lr_multi",
+                               self._build_scan(self._step_core))
 
     # -- dense-features rendering -----------------------------------------
     # At a9a scale (123 features, capacity ~160) the padded-sparse step
@@ -210,10 +213,14 @@ class LogisticRegression:
         return state, loss, n
 
     def _build_dense_step(self):
-        return jax.jit(self._dense_core)
+        from swiftmpi_tpu import obs
+        return obs.costs.track("lr_dense_step",
+                               jax.jit(self._dense_core))
 
     def _build_dense_multi(self):
-        return self._build_scan(self._dense_core)
+        from swiftmpi_tpu import obs
+        return obs.costs.track("lr_dense_multi",
+                               self._build_scan(self._dense_core))
 
     # -- training (lr.cpp:157-240) ----------------------------------------
     def train(self, data, niters: int = 1,

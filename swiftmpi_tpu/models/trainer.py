@@ -186,7 +186,8 @@ class Trainer:
             params = optax.apply_updates(params, updates)
             return params, opt_state, step + 1, loss
 
-        return train_step
+        from swiftmpi_tpu import obs
+        return obs.costs.track("trainer_step", train_step)
 
     def step(self, state: TrainState, tokens) -> Tuple[TrainState,
                                                        jax.Array]:

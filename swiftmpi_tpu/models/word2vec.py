@@ -453,7 +453,7 @@ class Word2Vec:
                         es, ec, gfields)
                 return out, es, ec
 
-            return step_st
+            return obs.costs.track("w2v_step", step_st)
 
         @partial(jax.jit, donate_argnums=0)
         def step(state, slot_of_vocab, alias_prob, alias_idx,
@@ -469,7 +469,7 @@ class Word2Vec:
                     es, ec, gfields)
             return out, es, ec
 
-        return step
+        return obs.costs.track("w2v_step", step)
 
     def _fused_for(self, n_inner: int):
         """Compiled fused scan of ``n_inner`` steps, cached per length.
@@ -492,8 +492,12 @@ class Word2Vec:
         if fn is None:
             if self._tail_fuse_frozen and n_inner != self.inner_steps:
                 return None
-            fn = self._fused_cache[n_inner] = self._build_multi_step(
-                n_inner)
+            # cost-catalog funnel (ISSUE 14): one name covers every
+            # fused length — each length is its own handle, so a new
+            # tail length books a compile, never a retrace
+            fn = self._fused_cache[n_inner] = obs.costs.track(
+                "w2v_multi", self._build_multi_step(n_inner),
+                steps_per_call=n_inner)
         return fn
 
     def _build_multi_step(self, n_inner: int):
@@ -809,7 +813,8 @@ class Word2Vec:
             return _workers(state, slot_of_vocab, alias_prob, alias_idx,
                             centers_s, contexts_s, masks_s, key)
 
-        return step, n_workers
+        return obs.costs.track("w2v_hogwild", step,
+                               steps_per_call=n_inner), n_workers
 
     def _build_grads(self):
         """Gradient phase of the step: pull rows, CBOW- or skip-gram-NS
@@ -1647,8 +1652,11 @@ class Word2Vec:
             elif sync:
                 self._step = self._build_step()
             else:
-                self._step = (jax.jit(self._build_grads()),
-                              jax.jit(self._build_apply()))
+                self._step = (
+                    obs.costs.track("w2v_grads",
+                                    jax.jit(self._build_grads())),
+                    obs.costs.track("w2v_apply",
+                                    jax.jit(self._build_apply())))
         # -- input pipeline setup (tentpole: prefetch-rendered,
         # pre-transferred batches).  The producer is gated to paths
         # where it can own rendering wholesale: hogwild does its own
@@ -1886,6 +1894,12 @@ class Word2Vec:
             self.train_metrics["numerics"] = {
                 "bundles": self._numerics.bundles,
                 "anomalies": det.anomalies_emitted if det else 0}
+        prof = obs.get_profiler()
+        if prof is not None:
+            # training ended inside a capture window: stop the trace
+            # and land the summary artifact anyway (short runs,
+            # profile_at near the end)
+            prof.close()
         if owns_rec and tel_rec is not None:
             tel_rec.close()
             obs.uninstall_recorder()
@@ -2141,8 +2155,11 @@ class Word2Vec:
         elif self.local_steps <= 1:
             self._step = self._build_step()
         else:
-            self._step = (jax.jit(self._build_grads()),
-                          jax.jit(self._build_apply()))
+            self._step = (
+                obs.costs.track("w2v_grads",
+                                jax.jit(self._build_grads())),
+                obs.costs.track("w2v_apply",
+                                jax.jit(self._build_apply())))
         self._control_recompiles += 1
         self._control_dirty = True
 

@@ -40,8 +40,16 @@ from swiftmpi_tpu.obs.registry import (DEFAULT_BUCKETS_MS, MetricsRegistry,
                                        quantile_from_buckets, series_key)
 from swiftmpi_tpu.obs.collector import (FLEET_SCHEMA, FleetCollector,
                                         SupervisorLog, stream_filename)
+from swiftmpi_tpu.obs import costs
+from swiftmpi_tpu.obs import profiler as profiler_mod
+from swiftmpi_tpu.obs.costs import CostCatalog, TrackedFn, get_catalog
 from swiftmpi_tpu.cluster.bootstrap import ENV_FLEET_DIR
-from swiftmpi_tpu.utils import profiler
+# aliased import: a bare ``from ...utils import profiler`` would shadow
+# the ``obs.profiler`` SUBMODULE attribute on this package, silently
+# rerouting ``from swiftmpi_tpu.obs import profiler`` to the host-side
+# trace-annotation helpers (numerics.py and launch.py import the
+# submodule that way)
+from swiftmpi_tpu.utils import profiler as _host_profiler
 
 __all__ = [
     "DEFAULT_BUCKETS_MS", "MetricsRegistry", "StepRecorder", "SCHEMA",
@@ -50,7 +58,9 @@ __all__ = [
     "quantile_from_buckets", "process_ident", "process_rank",
     "get_registry", "set_enabled", "reset_for_tests", "span",
     "named_scope", "configure", "install_recorder", "uninstall_recorder",
-    "get_recorder", "record_step",
+    "get_recorder", "record_step", "CostCatalog", "TrackedFn",
+    "get_catalog", "get_profiler", "install_profiler",
+    "uninstall_profiler",
 ]
 
 #: named scope for *compiled* code — same phase names as :func:`span`,
@@ -59,6 +69,7 @@ named_scope = jax.named_scope
 
 _REGISTRY = MetricsRegistry(enabled=False)
 _RECORDER: Optional[StepRecorder] = None
+_PROFILER = None    # Optional[obs.profiler.ProfileSession]
 
 
 def get_registry() -> MetricsRegistry:
@@ -77,9 +88,11 @@ def reset_for_tests() -> MetricsRegistry:
     Cached instrument handles bound to the old registry keep working but
     write into the discarded object — hence writers re-check
     ``get_registry()`` identity (see ``Transfer._obs_state``)."""
-    global _REGISTRY, _RECORDER
+    global _REGISTRY, _RECORDER, _PROFILER
     _REGISTRY = MetricsRegistry(enabled=False)
     _RECORDER = None
+    _PROFILER = None
+    costs.reset_for_tests()
     return _REGISTRY
 
 
@@ -107,7 +120,7 @@ class _Span:
 
     def __init__(self, hist, name: str):
         self._hist = hist
-        self._ann = profiler.annotate(name)
+        self._ann = _host_profiler.annotate(name)
 
     def __enter__(self):
         self._ann.__enter__()
@@ -158,10 +171,33 @@ def get_recorder() -> Optional[StepRecorder]:
 
 def record_step(n: int = 1) -> None:
     """Account ``n`` consumed train steps on the installed recorder (a
-    fused scan group counts its whole length).  No-op when none."""
+    fused scan group counts its whole length) and the installed profiler
+    session (ISSUE 14 triggered windows).  No-op when neither exists."""
     rec = _RECORDER
     if rec is not None:
         rec.on_steps(n)
+    prof = _PROFILER
+    if prof is not None:
+        prof.on_step(n)
+
+
+# -- profiler-session install point (obs/profiler.py) -----------------------
+
+def install_profiler(sess):
+    """Make ``sess`` the ProfileSession :func:`record_step` feeds."""
+    global _PROFILER
+    _PROFILER = sess
+    return sess
+
+
+def uninstall_profiler():
+    global _PROFILER
+    sess, _PROFILER = _PROFILER, None
+    return sess
+
+
+def get_profiler():
+    return _PROFILER
 
 
 # -- config gate ------------------------------------------------------------
@@ -195,6 +231,18 @@ def configure(config, run: str = "run",
     * ``crash_flush: 1`` — atexit + fatal-signal telemetry flush
       (default on; see recorder.py).
 
+    Compiler/device-cost knobs under ``[obs]`` (ISSUE 14) are armed
+    here too, INDEPENDENTLY of the recorder — the compile catalog
+    persists ``runs/compile_catalog.json`` and the profiler session
+    captures traces even when the JSONL sink is off:
+
+    * ``costs: 1`` / ``costs_path`` / ``costs_memory`` — the compiled-
+      program catalog (obs/costs.py; ``SMTPU_COSTS=1`` overrides).
+    * ``profile_at`` / ``profile_steps`` / ``profile_dir`` /
+      ``profile_trigger`` / ``profile_on_anomaly`` — triggered profiler
+      windows (obs/profiler.py; ``SMTPU_PROFILE_AT`` overrides, set by
+      ``launch.py -profile-at`` for every rank).
+
     Returns the installed :class:`StepRecorder`, or ``None`` when
     telemetry is off.  The caller owns ``close()`` (or use it as a
     context manager); close appends the summary line and uninstalls
@@ -203,6 +251,12 @@ def configure(config, run: str = "run",
     g = config.get_or
     fleet_dir = os.environ.get(ENV_FLEET_DIR) or \
         g("obs", "fleet_dir", "").to_string()
+    cat = costs.configure_costs(config, run=run)
+    prof = _configure_profiler(config, fleet_dir)
+    if cat is not None or prof is not None:
+        # instruments must record even without a JSONL sink — the
+        # catalog artifact and the capture summaries still read them
+        set_enabled(True)
     if not g("worker", "telemetry", 0).to_bool() and not fleet_dir:
         return None
     set_enabled(True)
@@ -224,3 +278,32 @@ def configure(config, run: str = "run",
         crash_flush=g("obs", "crash_flush", 1).to_bool(),
     )
     return install_recorder(rec)
+
+
+def _configure_profiler(config, fleet_dir: str):
+    """Install a ProfileSession when any trigger path is armed: the
+    ``profile_at`` knob (or its launcher env override), the fleet-dir
+    trigger file (on by default in fleet mode — polling is one stat per
+    second), or the numerics-anomaly hook.  None of them armed (the
+    default) installs nothing — ``record_step`` stays recorder-only."""
+    g = config.get_or
+    at = g("obs", "profile_at", -1).to_int32()
+    env_at = os.environ.get(profiler_mod.ENV_PROFILE_AT, "")
+    if env_at:
+        at = int(env_at)
+    steps = g("obs", "profile_steps", 5).to_int32()
+    env_steps = os.environ.get(profiler_mod.ENV_PROFILE_STEPS, "")
+    if env_steps:
+        steps = int(env_steps)
+    trigger = bool(fleet_dir) and g("obs", "profile_trigger",
+                                    1).to_bool()
+    on_anomaly = g("obs", "profile_on_anomaly", 0).to_bool()
+    if at < 0 and not trigger and not on_anomaly:
+        return None
+    sess = profiler_mod.ProfileSession(
+        profile_dir=g("obs", "profile_dir",
+                      os.path.join("runs", "profiles")).to_string(),
+        steps=steps, profile_at=at,
+        fleet_dir=fleet_dir if trigger else None,
+        capture_on_anomaly=on_anomaly)
+    return install_profiler(sess)

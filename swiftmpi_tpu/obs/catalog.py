@@ -76,6 +76,15 @@ SERIES = frozenset({
     "numerics/anomalies",
     # fleet-level numerics mirror (obs/collector.py)
     "fleet/grad_norm_divergence", "fleet/anomalies",
+    # compiler & device-cost plane (obs/costs.py, ISSUE 14): per-fn
+    # compile/retrace counters and XLA cost/memory-analysis gauges,
+    # all labeled fn=<catalog name>
+    "compile/compiles", "compile/retraces", "compile/compile_ms",
+    "compile/flops", "compile/bytes", "compile/peak_bytes",
+    # triggered profiler windows (obs/profiler.py): capture counters
+    # and per-phase device/host attribution from the trace parse
+    "profile/sessions", "profile/steps",
+    "profile/device_ms", "profile/host_ms", "profile/skew_ms",
 }) | frozenset("transfer/" + k for k in TRANSFER_KEYS)
 
 #: Dynamic-name families: an f-string series name passes the catalog

@@ -453,6 +453,12 @@ class AnomalyDetector:
         rec = obs.get_recorder()
         if rec is not None:
             rec.event("numerics/anomaly", anomaly)
+        if anomaly["severity"] == "critical":
+            # triggered profiler window (ISSUE 14): a critical anomaly
+            # captures the very steps that misbehaved — no-op unless
+            # [obs] profile_on_anomaly armed a session
+            from swiftmpi_tpu.obs import profiler as obs_profiler
+            obs_profiler.on_critical_anomaly(anomaly)
 
     # .. checkpoint carry ..................................................
 

@@ -335,10 +335,12 @@ class HybridTransfer(Transfer):
                mean, with_counts)
         fn = self._hot_push_cache.get(sig)
         if fn is None:
+            from swiftmpi_tpu.obs import costs as obs_costs
             fn = self._hot_push_cache.setdefault(
-                sig, jax.jit(self._build_hot_push(
-                    hot_state, access, tuple(sorted(grads)), mean,
-                    with_counts)))
+                sig, obs_costs.track("hybrid_hot_push", jax.jit(
+                    self._build_hot_push(
+                        hot_state, access, tuple(sorted(grads)), mean,
+                        with_counts))))
         if with_counts:
             return fn(hot_state, slots, grads,
                       jnp.asarray(counts, jnp.float32))

@@ -36,6 +36,7 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
 from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
+from swiftmpi_tpu.obs import costs as obs_costs
 from swiftmpi_tpu.ops import (calibration, pallas_gather, pallas_ring,
                               pallas_scatter)
 from swiftmpi_tpu.transfer.api import (Transfer, ef_quantize_window,
@@ -259,7 +260,8 @@ class TpuTransfer(Transfer):
         fn = self._pull_cache.get(sig)
         if fn is None:
             fn = self._pull_cache.setdefault(
-                sig, jax.jit(self._build_pull(state, access, fields)))
+                sig, obs_costs.track("tpu_pull", jax.jit(
+                    self._build_pull(state, access, fields))))
         if self.bucket_capacity is None:
             return fn(state, slots)
         out, ovf = fn(state, slots)
@@ -350,9 +352,10 @@ class TpuTransfer(Transfer):
         fn = self._push_cache.get(sig)
         if fn is None:
             fn = self._push_cache.setdefault(
-                sig, jax.jit(self._build_push(state, access,
-                                              tuple(sorted(grads)), mean,
-                                              with_counts)))
+                sig, obs_costs.track("tpu_push", jax.jit(
+                    self._build_push(state, access,
+                                     tuple(sorted(grads)), mean,
+                                     with_counts))))
         if self.bucket_capacity is None:
             return fn(state, slots, grads)
         out, ovf = fn(state, slots, grads)
@@ -479,8 +482,9 @@ class TpuTransfer(Transfer):
         fn = self._dedup_cache.get(sig)
         if fn is None:
             fn = self._dedup_cache.setdefault(
-                sig, jax.jit(self._build_window_dedup(
-                    capacity, tuple(sorted(fgrads)))))
+                sig, obs_costs.track("tpu_window_dedup", jax.jit(
+                    self._build_window_dedup(
+                        capacity, tuple(sorted(fgrads))))))
         return fn(flat, fgrads, counts_in)
 
     def _build_window_dedup(self, capacity, grad_fields):
@@ -525,8 +529,9 @@ class TpuTransfer(Transfer):
         fn = self._window_dense_cache.get(sig)
         if fn is None:
             fn = self._window_dense_cache.setdefault(
-                sig, jax.jit(self._build_push_window_dense(
-                    state, access, tuple(sorted(fgrads)), mean)))
+                sig, obs_costs.track("tpu_window_dense", jax.jit(
+                    self._build_push_window_dense(
+                        state, access, tuple(sorted(fgrads)), mean))))
         if self.count_traffic:
             # wire volume is the static table size, not the row count —
             # the `flat[0] * 0 + capacity` token keeps the value traced

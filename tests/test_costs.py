@@ -1,0 +1,361 @@
+"""Compiler & cost observability tests (ISSUE 14, obs/costs.py +
+obs/profiler.py): the TrackedFn compile/retrace bookkeeping against a
+real jit cache, hand-model drift math, the w2v cost-catalog golden on
+CPU (compile/* series in the JSONL + a valid smtpu-costs/1 artifact +
+the --compile report rendering it), the shape-churn -> retrace-counter
+-> budget-gate acceptance path, triggered profiler windows (profile_at
+knob artifacts, the fleet trigger file, chrome-trace phase attribution),
+and the off-by-default bit-identity contract across the jit-stepped
+transfer backends.
+"""
+
+import glob
+import gzip
+import json
+import os
+import sys
+import weakref
+
+import numpy as np
+import pytest
+
+jax = pytest.importorskip("jax")
+import jax.numpy as jnp  # noqa: E402
+
+from swiftmpi_tpu import obs  # noqa: E402
+from swiftmpi_tpu.data.text import synthetic_corpus  # noqa: E402
+from swiftmpi_tpu.models.word2vec import Word2Vec  # noqa: E402
+from swiftmpi_tpu.obs import costs as obs_costs  # noqa: E402
+from swiftmpi_tpu.obs import profiler as obs_profiler  # noqa: E402
+from swiftmpi_tpu.utils import ConfigParser  # noqa: E402
+
+SCRIPTS = os.path.join(os.path.dirname(__file__), os.pardir, "scripts")
+
+
+def _scripts_on_path():
+    if SCRIPTS not in sys.path:
+        sys.path.insert(0, SCRIPTS)
+
+
+def _corpus():
+    return synthetic_corpus(40, vocab_size=60, length=14, seed=8)
+
+
+def _cfg(transfer="xla", path=None, obs_extra=None):
+    d = {
+        "cluster": {"transfer": transfer},
+        "word2vec": {"len_vec": 16, "window": 2, "negative": 5,
+                     "sample": -1, "learning_rate": 0.05,
+                     "min_sentence_length": 2},
+        "server": {"initial_learning_rate": 0.3},
+        "worker": {"minibatch": 512},
+    }
+    if path is not None:
+        d["worker"].update({"telemetry": 1, "telemetry_path": path,
+                            "telemetry_flush": 1})
+    if obs_extra:
+        d["obs"] = dict(obs_extra)
+    return ConfigParser().update(d)
+
+
+def _train_final(cfg, corp, niters=3, batch_size=64):
+    m = Word2Vec(config=cfg)
+    losses = m.train(corp, niters=niters, batch_size=batch_size)
+    params = {k: np.asarray(v) for k, v in m.table.state.items()}
+    return losses, params, m
+
+
+def _lines(path):
+    return [json.loads(ln) for ln in open(path) if ln.strip()]
+
+
+def _counter_total(path, name):
+    """Sum one counter series (any labels) across a JSONL stream's
+    step deltas (the summary line repeats the totals — skip it)."""
+    total = 0.0
+    for rec in _lines(path):
+        if rec.get("kind") != "step":
+            continue
+        for key, delta in (rec.get("counters") or {}).items():
+            if key.split("{", 1)[0] == name:
+                total += delta
+    return total
+
+
+def _arm(tmp_path, memory=False):
+    cat = obs_costs.get_catalog()
+    cat.enabled = True
+    cat.memory = memory
+    cat.path = str(tmp_path / "compile_catalog.json")
+    obs.set_enabled(True)
+    return cat
+
+
+# -- TrackedFn unit: compiles, cache hits, retraces ------------------------
+
+def test_trackedfn_books_compiles_and_retraces(tmp_path):
+    cat = _arm(tmp_path, memory=True)
+    f = obs_costs.track("unit_fn", jax.jit(lambda x: x * 2.0 + 1.0))
+    x = jnp.ones((8,), jnp.float32)
+    np.testing.assert_allclose(np.asarray(f(x)), np.full(8, 3.0, "f4"))
+    e = cat.entry("unit_fn")
+    assert e["compiles"] == 1 and e["retraces"] == 0
+    assert e["compile_ms_total"] > 0.0
+    # XLA's own numbers landed (cost_analysis + memory_analysis)
+    assert e["flops"] > 0 and e["bytes_accessed"] > 0
+    assert e["peak_bytes"] > 0
+
+    # same shape again: cache hit, nothing booked
+    f(x + 1.0)
+    assert cat.entry("unit_fn")["compiles"] == 1
+
+    # shape churn on the SAME handle: compile + retrace
+    f(jnp.ones((16,), jnp.float32))
+    e = cat.entry("unit_fn")
+    assert e["compiles"] == 2 and e["retraces"] == 1
+
+    # ...but a FRESH handle under the same name (control-plane rebuild,
+    # fused-cache growth) books a compile, never a retrace
+    g = obs_costs.track("unit_fn", jax.jit(lambda x: x * 2.0 + 1.0))
+    g(x)
+    e = cat.entry("unit_fn")
+    assert e["compiles"] == 3 and e["retraces"] == 1
+
+    # the crash-safe artifact validates
+    doc = json.load(open(cat.path))
+    assert doc["schema"] == obs_costs.COSTS_SCHEMA
+    assert doc["fns"]["unit_fn"]["compiles"] == 3
+
+
+def test_trackedfn_disarmed_is_passthrough_and_weakrefable():
+    f = obs_costs.track("quiet_fn", jax.jit(lambda x: x + 1.0))
+    # jax weakrefs the step callable — the wrapper must support it
+    assert weakref.ref(f)() is f
+    # idempotent: re-tracking returns the same wrapper
+    assert obs_costs.track("other_name", f) is f
+    f(jnp.ones((4,), jnp.float32))
+    f(jnp.ones((9,), jnp.float32))   # would be a retrace if armed
+    assert obs_costs.get_catalog().entry("quiet_fn") is None
+    # attribute forwarding reaches the wrapped jit
+    assert f._cache_size() == 2
+
+
+def test_hand_model_drift(tmp_path):
+    cat = _arm(tmp_path)
+    f = obs_costs.track("drift_fn", jax.jit(lambda x: x @ x))
+    f(jnp.ones((8, 8), jnp.float32))
+    measured = cat.entry("drift_fn")["flops"]
+    cat.note_hand_model("drift_fn", flops=measured * 1.25,
+                        bytes_accessed=cat.entry("drift_fn")
+                        ["bytes_accessed"])
+    fns = cat.snapshot()["fns"]
+    assert fns["drift_fn"]["flops_drift_pct"] == pytest.approx(25.0)
+    assert fns["drift_fn"]["bytes_drift_pct"] == pytest.approx(0.0)
+
+
+# -- w2v cost-catalog + profile_at golden on CPU ---------------------------
+
+def test_w2v_costs_catalog_and_profile_at_golden(tmp_path, devices8):
+    """Armed ``[obs] costs`` + ``profile_at`` on ONE small CPU w2v run
+    (two e2e surfaces, one train — tier-1 wall clock matters):
+    compile/*{fn=} series land in the JSONL, the smtpu-costs/1 artifact
+    validates with measured flops/bytes for a w2v step, the --compile
+    report renders both, and a bounded trace lands under profile_dir
+    with a parsing profile_summary.json that the stream saw."""
+    tel = str(tmp_path / "tel.jsonl")
+    cat_path = str(tmp_path / "compile_catalog.json")
+    prof_dir = str(tmp_path / "profiles")
+    _train_final(_cfg("xla", path=tel,
+                      obs_extra={"costs": 1, "costs_path": cat_path,
+                                 "costs_memory": 0,
+                                 "profile_at": 1, "profile_steps": 2,
+                                 "profile_dir": prof_dir}),
+                 _corpus())
+
+    # JSONL: the funnel counted at least one compile, zero retraces
+    assert _counter_total(tel, "compile/compiles") >= 1
+    assert _counter_total(tel, "compile/retraces") == 0
+    gauges = set()
+    for rec in _lines(tel):
+        gauges |= set(rec.get("gauges") or {})
+    assert any(g.startswith("compile/flops{") for g in gauges)
+
+    # artifact: valid schema, measured numbers for a w2v step fn
+    doc = json.load(open(cat_path))
+    assert doc["schema"].startswith(obs_costs.COSTS_SCHEMA_PREFIX)
+    w2v_fns = {k: v for k, v in doc["fns"].items()
+               if k.startswith("w2v")}
+    assert w2v_fns, doc["fns"].keys()
+    assert any(v.get("flops", 0) > 0 and v.get("bytes_accessed", 0) > 0
+               for v in w2v_fns.values())
+    assert all(v["retraces"] == 0 for v in doc["fns"].values())
+
+    # the report renders a compile section from stream + artifact
+    _scripts_on_path()
+    import telemetry_report
+    comp = telemetry_report.compile_summary(telemetry_report.load(tel),
+                                            catalog=doc)
+    assert comp["retraces_total"] == 0
+    assert comp["compile_ms_total"] > 0
+    assert any(f.startswith("w2v") for f in comp["fns"])
+    assert telemetry_report.main(
+        [tel, "--compile", "--catalog", cat_path]) == 0
+
+    # profile_at: the bounded capture landed and parsed
+    dirs = glob.glob(os.path.join(prof_dir, "profile_step*_r*"))
+    assert len(dirs) == 1
+    summary = json.load(open(os.path.join(dirs[0],
+                                          "profile_summary.json")))
+    assert summary["schema"] == obs_profiler.PROFILE_SCHEMA
+    assert summary["reason"] == "profile_at"
+    assert summary["steps"] >= 1
+    assert summary["files"] >= 1       # the raw trace actually landed
+    assert summary["events"] > 0
+    assert isinstance(summary["device_ms"], dict)
+    # ...and the stream saw it: counters + the capture event
+    assert _counter_total(tel, "profile/sessions") == 1
+    assert _counter_total(tel, "profile/steps") >= 1
+    caps = [r for r in _lines(tel) if r.get("kind") == "profile/capture"]
+    assert len(caps) == 1 and caps[0]["run_dir"] == dirs[0]
+
+
+# -- shape churn -> retrace counter -> budget gate -------------------------
+
+def _emit_run(tmp_path, name, shapes):
+    """One synthetic 'run': an armed tracked jit driven through
+    ``shapes``, wire counters riding along, recorded to JSONL — the
+    minimal stream check_traffic_budget can cell-ify."""
+    reg = obs.reset_for_tests()
+    obs.set_enabled(True)
+    cat = obs_costs.get_catalog()
+    cat.enabled, cat.memory = True, False
+    path = str(tmp_path / f"{name}.jsonl")
+    rec = obs.StepRecorder(reg, path=path, run="w2v", flush_every=1)
+    f = obs_costs.track("w2v_step", jax.jit(lambda x: (x * 2.0).sum()))
+    for n in shapes:
+        f(jnp.ones((n,), jnp.float32))
+        reg.counter("transfer/wire_bytes", backend="xla").inc(1024)
+        reg.counter("transfer/dispatches", backend="xla").inc(1)
+        rec.on_steps(1)
+    rec.close()
+    return path
+
+
+def test_shape_churn_trips_retrace_budget_gate(tmp_path, capsys):
+    base = _emit_run(tmp_path, "base", [8, 8, 8])       # steady state
+    cand = _emit_run(tmp_path, "cand", [8, 12, 16])     # churning
+    _scripts_on_path()
+    import check_traffic_budget as ctb
+    b, c = ctb.load_cells(base), ctb.load_cells(cand)
+    assert b["w2v"]["retraces"] == 0
+    assert b["w2v"]["compile_ms"] > 0
+    assert c["w2v"]["retraces"] == 2
+    assert ctb.retrace_violations(b, c) == [("w2v", 0.0, 2.0)]
+    # floor 1: a single late retrace is tolerated...
+    assert ctb.retrace_violations(b, {"w2v": {"retraces": 1.0}}) == []
+    # ...and a costs-off candidate is skipped, never blocked
+    assert ctb.retrace_violations(b, {"w2v": {}}) == []
+
+    assert ctb.main([base, cand]) == 1
+    assert "RETRACE BUDGET EXCEEDED" in capsys.readouterr().out
+    assert ctb.main([base, base]) == 0
+
+
+# -- triggered profiler windows --------------------------------------------
+
+def test_fleet_trigger_file_drives_a_capture(tmp_path):
+    """request_profile -> trigger file -> session capture, replayed
+    exactly once per monotonic id."""
+    fleet = str(tmp_path / "fleet")
+    req = obs_profiler.request_profile(fleet, steps=1)
+    assert req["id"] == 1
+    assert obs_profiler.request_profile(fleet, steps=1)["id"] == 2
+
+    obs.set_enabled(True)
+    sess = obs_profiler.ProfileSession(
+        profile_dir=str(tmp_path / "prof"), fleet_dir=fleet)
+    f = jax.jit(lambda x: x + 1.0)
+    sess.on_step()                 # polls, parks, starts the capture
+    f(jnp.ones((4,), jnp.float32))
+    sess.on_step()                 # window of 1 consumed -> stop
+    assert len(sess.captures) == 1
+    assert sess.captures[0]["reason"] == "trigger:2"
+    assert os.path.exists(os.path.join(sess.captures[0]["run_dir"],
+                                       "profile_summary.json"))
+    # same id again: never replayed
+    sess._last_poll = 0.0
+    sess.on_step()
+    sess.on_step()
+    assert len(sess.captures) == 1
+
+
+def _gz_trace(path, events):
+    os.makedirs(os.path.dirname(path), exist_ok=True)
+    with gzip.open(path, "wt") as f:
+        json.dump({"traceEvents": events}, f)
+
+
+def test_parse_trace_dir_attributes_phases(tmp_path):
+    root = str(tmp_path / "trace")
+    events = [
+        {"ph": "M", "pid": 1, "name": "process_name",
+         "args": {"name": "/device:TPU:0 (pid 1)"}},
+        {"ph": "M", "pid": 2, "name": "process_name",
+         "args": {"name": "python (host)"}},
+        # device event carrying a named_scope inside a fused label
+        {"ph": "X", "pid": 1, "name": "fusion.3/apply/add",
+         "dur": 2000.0},
+        # host span
+        {"ph": "X", "pid": 2, "name": "render", "dur": 1000.0},
+        {"ph": "X", "pid": 2, "name": "apply", "dur": 500.0},
+        # python frame-trace noise: skipped
+        {"ph": "X", "pid": 2, "name": "$noise.py:1", "dur": 9999.0},
+        # unmatched name: aggregates under "other"
+        {"ph": "X", "pid": 1, "name": "memcpy", "dur": 100.0},
+        # non-complete events: ignored
+        {"ph": "B", "pid": 1, "name": "apply"},
+    ]
+    _gz_trace(os.path.join(root, "host.trace.json.gz"), events)
+    # the perfetto twin carries the same events — must NOT double count
+    _gz_trace(os.path.join(root, "perfetto_trace.json.gz"), events)
+
+    s = obs_profiler.parse_trace_dir(root)
+    assert s["files"] == 1 and s["events"] == 4
+    assert s["device_ms"]["apply"] == pytest.approx(2.0)
+    assert s["device_ms"]["other"] == pytest.approx(0.1)
+    assert s["host_ms"]["render"] == pytest.approx(1.0)
+    assert s["host_ms"]["apply"] == pytest.approx(0.5)
+    # per-phase host-vs-device skew
+    assert s["skew_ms"]["apply"] == pytest.approx(0.5 - 2.0)
+    # a perfetto-only dir still parses (no chrome twin to prefer)
+    root2 = str(tmp_path / "trace2")
+    _gz_trace(os.path.join(root2, "perfetto_trace.json.gz"), events)
+    assert obs_profiler.parse_trace_dir(root2)["events"] == 4
+
+
+# -- the contract the default rides on -------------------------------------
+
+@pytest.mark.parametrize("transfer", ["xla", "tpu", "hybrid"])
+def test_costs_off_bit_identical(transfer, devices8, tmp_path):
+    """Arming the catalog only OBSERVES the jit handles (the wrapped
+    jit is always the callee; analysis is lower()-side) — so ON vs OFF
+    must produce identical per-iteration losses AND bit-identical final
+    parameters on every jit-stepped backend."""
+    corp = _corpus()
+    l_off, p_off, _ = _train_final(_cfg(transfer), corp, niters=2)
+    assert obs_costs.get_catalog().entries() == {}   # default: nothing
+
+    obs.reset_for_tests()
+    cat_path = str(tmp_path / f"cat_{transfer}.json")
+    l_on, p_on, _ = _train_final(
+        _cfg(transfer, path=str(tmp_path / f"tel_{transfer}.jsonl"),
+             obs_extra={"costs": 1, "costs_path": cat_path,
+                        "costs_memory": 0}), corp, niters=2)
+    assert l_off == l_on
+    assert set(p_off) == set(p_on)
+    for k in p_off:
+        np.testing.assert_array_equal(p_off[k], p_on[k],
+                                      err_msg=f"{transfer}/{k}")
+    # ...and the catalog actually ran
+    doc = json.load(open(cat_path))
+    assert any(v["compiles"] > 0 for v in doc["fns"].values())

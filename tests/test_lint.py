@@ -336,6 +336,37 @@ def test_telemetry_trips_on_undeclared_numerics_series(tmp_path):
     assert "numerics/grad_nrom" in new[0].message
 
 
+def test_telemetry_covers_compile_series(tmp_path):
+    """ISSUE 14 satellite: the compiler-cost catalog and the triggered
+    profiler write catalog-declared series like any other plane — the
+    fn-labeled compile counters/gauges and the phase-labeled profile
+    attribution gauges all pass as written."""
+    new = lint_src(tmp_path, "pkg/obs/costs.py", """
+    def book(reg, name, dt_ms, ph):
+        reg.counter("compile/compiles", fn=name).inc()
+        reg.counter("compile/compile_ms", fn=name).inc(dt_ms)
+        reg.counter("compile/retraces", fn=name).inc()
+        reg.gauge("compile/flops", fn=name).set(1.0)
+        reg.gauge("compile/bytes", fn=name).set(1.0)
+        reg.gauge("compile/peak_bytes", fn=name).set(1.0)
+        reg.counter("profile/sessions").inc()
+        reg.counter("profile/steps").inc(5)
+        reg.gauge("profile/device_ms", phase=ph).set(1.0)
+        reg.gauge("profile/host_ms", phase=ph).set(1.0)
+        reg.gauge("profile/skew_ms", phase=ph).set(0.0)
+    """)
+    assert new == []
+
+
+def test_telemetry_trips_on_undeclared_compile_series(tmp_path):
+    new = lint_src(tmp_path, "pkg/obs/costs.py", """
+    def book(reg, name):
+        reg.counter("compile/retracez", fn=name).inc()
+    """)
+    assert rules_of(new) == {"TELEMETRY-CATALOG"}
+    assert "compile/retracez" in new[0].message
+
+
 def test_telemetry_checks_both_ifexp_branches(tmp_path):
     new = lint_src(tmp_path, "pkg/thing.py", """
     def record(reg, ok):
