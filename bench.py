@@ -2949,11 +2949,74 @@ def render_final_line(out: dict) -> str:
     return line
 
 
+# -- bench history (ISSUE 15): every run appends its cell results to an
+# append-only JSONL so telemetry_report.py --history can render
+# trend-over-rounds tables without scraping the per-round BENCH_r*.json
+# artifacts.  Each line is stamped with the git SHA and a stack key
+# (python + jax versions) so a regression can be attributed to a code
+# change vs. a toolchain change.
+
+HISTORY_SCHEMA = "smtpu-bench-history/1"
+HISTORY_SCHEMA_V = 1
+HISTORY_PATH = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "runs", "bench_history.jsonl")
+
+
+def _git_sha() -> str:
+    try:
+        r = subprocess.run(
+            ["git", "rev-parse", "--short", "HEAD"],
+            capture_output=True, text=True, timeout=5,
+            cwd=os.path.dirname(os.path.abspath(__file__)))
+        return r.stdout.strip() or "unknown"
+    except Exception:
+        return "unknown"
+
+
+def _stack_key() -> str:
+    import platform
+    try:
+        import jax
+        jv = jax.__version__
+    except Exception:
+        jv = "nojax"
+    return f"py{platform.python_version()}-jax{jv}"
+
+
+def append_history(out: dict, path: str = HISTORY_PATH) -> list:
+    """Append one ``smtpu-bench-history/1`` line per cell (the headline
+    plus every secondary entry's scalar fields); returns the rows.  A
+    failed append never blocks the one JSON line."""
+    base = {"v": HISTORY_SCHEMA_V, "schema": HISTORY_SCHEMA,
+            "ts": time.time(), "git_sha": _git_sha(),
+            "stack_key": _stack_key()}
+    rows = [{**base, "cell": "headline", "metric": out.get("metric"),
+             "value": out.get("value"), "unit": out.get("unit"),
+             "vs_baseline": out.get("vs_baseline"),
+             "degraded": len(out.get("degraded") or ())}]
+    for cell, entry in sorted((out.get("secondary") or {}).items()):
+        if not isinstance(entry, dict):
+            continue
+        rows.append({**base, "cell": cell,
+                     **{k: v for k, v in entry.items()
+                        if isinstance(v, (int, float, str, bool))
+                        or v is None}})
+    try:
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "a") as f:
+            for r in rows:
+                f.write(json.dumps(r, sort_keys=True) + "\n")
+    except OSError:
+        pass
+    return rows
+
+
 def emit_final(out: dict) -> None:
     try:
         _atomic_write_json(FULL_REPORT_PATH, out)
     except OSError:
         pass              # the sidecar must never block the one line
+    append_history(out)
     print(render_final_line(out), flush=True)
 
 

@@ -26,6 +26,14 @@ anomaly events end to end without any real training;
 ``SMTPU_FLEET_NUMERICS_SPIKE_RANK`` (default 0) at that step — the
 drill that must surface as an anomaly in the member table.
 
+``SMTPU_FLEET_TRACE=1`` arms the wire tracer (obs/trace.py) and drives
+it with one synthetic coalesced window per step through the SAME feed
+API the transfer ledgers use (priced decision, key reservoir, dedup,
+rank-skewed exchange), so the flight-recorder drill — rank 0 drops a
+``trace_trigger.json`` mid-run, every rank's tracer replays it into a
+``trace_r<rank>_p<pid>.jsonl`` dump in the fleet dir — runs end to end
+without any real transfer backend.
+
 Prints ``FLEET_CHILD_OK rank=<r> steps=<n>`` on a clean finish.
 """
 
@@ -49,10 +57,17 @@ def main() -> int:
     steps = int(os.environ.get("SMTPU_FLEET_STEPS", "60"))
     step_s = float(os.environ.get("SMTPU_FLEET_STEP_S", "0.02"))
     hb_s = float(os.environ.get("SMTPU_FLEET_HB_S", "0.25"))
+    fleet_dir = os.environ.get("SMTPU_FLEET_DIR", "")
+    trace = os.environ.get("SMTPU_FLEET_TRACE", "0") not in ("", "0")
 
+    obs_cfg = {"heartbeat_s": hb_s}
+    if trace:
+        # dumps land next to the telemetry streams so the smoke (and
+        # smtpu_top/telemetry_report --trace) find them in one place
+        obs_cfg.update({"trace": 1, "trace_dir": fleet_dir or "runs"})
     cfg = ConfigParser().update({
         "worker": {"telemetry": 1},
-        "obs": {"heartbeat_s": hb_s},
+        "obs": obs_cfg,
     })
     rec = obs.configure(cfg, run="fleet_child")
     if rec is None:
@@ -60,6 +75,17 @@ def main() -> int:
         return 2
     rank = obs.process_rank() or 0
     reg = obs.get_registry()
+
+    tr = obs.get_tracer()
+    if tr is not None:
+        # one pricing per compiled program, the decide_wire_format way:
+        # sparse wins, the losing candidates' modeled byte costs ride
+        # along as the record's "why"
+        tr.on_decision("xla", "sparse",
+                       {"dense": 8192.0, "sparse": 2048.0,
+                        "sparse_q": 1152.0, "bitmap": 1536.0},
+                       rows=32, capacity=128, row_bytes=64,
+                       quant="int8")
 
     det = None
     spike_at = spike_rank = -1
@@ -80,6 +106,18 @@ def main() -> int:
         reg.counter("transfer/dispatches", backend="xla").inc(1)
         reg.counter("transfer/window_fmt", backend="xla",
                     fmt="sparse").inc(1)
+        if tr is not None:
+            # one synthetic window per step, rank-skewed like the wire
+            # counter above (rows x row_bytes = 1000 * (rank + 1))
+            tr.stage_keys("xla", [(rank + 1) * k for k in range(8)])
+            tr.on_window("xla", "sparse", rows_in=48, rows_out=32)
+            tr.on_exchange("xla", rows=250 * (rank + 1), row_bytes=4)
+            if rank == 0 and step == steps // 2 and fleet_dir:
+                # the operator flow: drop the fleet-wide dump trigger
+                # (same file the `python -m swiftmpi_tpu.obs.trace`
+                # CLI writes); every rank replays it exactly once
+                from swiftmpi_tpu.obs import trace as trace_mod
+                trace_mod.request_trace(fleet_dir)
         if det is not None:
             # deterministic per-rank norms (mild skew, below the
             # cross-rank divergence factor) + optional injected spike
@@ -92,6 +130,19 @@ def main() -> int:
             det.on_sample(reg, {"numerics/grad_norm": g,
                                 "numerics/loss": loss}, 0.0)
         obs.record_step(1)
+
+    if tr is not None and fleet_dir:
+        # grace window: the trigger poll is throttled (poll_s), so a
+        # trigger dropped near the end of a short drill may not have
+        # been seen yet — keep polling (no step advance) until the dump
+        # lands or the grace expires
+        deadline = time.time() + 3.0
+        while not tr.dumps and time.time() < deadline:
+            tr.on_step(0)
+            time.sleep(0.1)
+        # clean teardown: detach WITHOUT dumping, so a normal exit does
+        # not overwrite the trigger dump with a crash dump
+        obs.uninstall_tracer()
 
     rec.close()
     print(f"FLEET_CHILD_OK rank={rank} steps={steps}")

@@ -194,6 +194,17 @@ def load_telemetry_cells(path: str) -> dict:
         cell["compile_ms"] = compile_ms
         if peak:
             cell["peak_hbm_bytes"] = peak
+    # wire-trace plane (obs/trace.py): the per-step latency mean plus
+    # the tracer's volume counters — the trace-overhead advisory diffs
+    # step_ms between a trace-off baseline and a trace-on candidate
+    for row in phase_table(doc):
+        if row["phase"] == "step_ms":
+            cell["step_ms"] = row["mean_ms"]
+    for tkey in ("trace/windows", "trace/records", "trace/dumps"):
+        total = sum(float(v) for k, v in totals.items()
+                    if parse_series_key(k)[0] == tkey)
+        if total:
+            cell[tkey.replace("/", "_")] = total
     run = str(doc["meta"].get("run", "telemetry"))
     cells = {run: cell} if cell else {}
     # kernel microbench streams (obs.micro.MicroTelemetry): every
@@ -384,6 +395,57 @@ def retrace_violations(base: dict, cand: dict) -> list:
     return bad
 
 
+def trace_dump_violations(pattern: str) -> list:
+    """Crash dumps (``smtpu-trace/1`` flight-recorder files, obs/trace.py)
+    that exist but cannot be parsed even after single-line repair.  A
+    dump is written precisely because something went wrong; a dump that
+    is schema-invalid or truncated beyond repair means the flight
+    recorder failed at its one job, so its presence fails the gate
+    outright (the unnoticed-death pattern: a hard candidate-side
+    property).  A dump that parses — even with its final line repaired,
+    even with zero window records (crash before the first window) — is
+    healthy.  Returns [(path, reason)]."""
+    import contextlib
+    import glob as _glob
+    import io
+
+    from telemetry_report import load_trace
+
+    bad = []
+    for path in sorted(_glob.glob(pattern)):
+        try:
+            with contextlib.redirect_stderr(io.StringIO()) as err:
+                load_trace(path)
+        except SystemExit:
+            reason = err.getvalue().strip() or \
+                "schema-invalid or truncated beyond repair"
+            bad.append((path, reason.splitlines()[-1]))
+    return bad
+
+
+def trace_overhead_report(base: dict, cand: dict, bound: float) -> list:
+    """Advisory step-latency cost of the wire tracer: cells where the
+    candidate ran with tracing armed (``trace_windows`` counter present)
+    against a trace-off baseline, compared on the step_ms mean.  Returns
+    [(cell, base_ms, cand_ms, rel, over_bound)] — printed next to the
+    verdict, never failing it: step_ms wall-clock jitters run to run,
+    and the hard bit-identity guarantee is pytest's (test_trace.py), not
+    this gate's."""
+    rows = []
+    for cell in sorted(set(base) & set(cand)):
+        b_ms = base[cell].get("step_ms")
+        c_ms = cand[cell].get("step_ms")
+        if b_ms is None or c_ms is None:
+            continue
+        if not cand[cell].get("trace_windows") \
+                or base[cell].get("trace_windows"):
+            continue
+        b_ms, c_ms = float(b_ms), float(c_ms)
+        rel = (c_ms - b_ms) / b_ms if b_ms > 0 else 0.0
+        rows.append((cell, b_ms, c_ms, rel, rel > bound))
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fail when bench traffic counters regressed")
@@ -394,7 +456,25 @@ def main(argv=None) -> int:
     ap.add_argument("--cells", default=None,
                     help="comma-separated cell allowlist (default: every "
                          "cell present in both files)")
+    ap.add_argument("--trace-dumps", default=None, metavar="GLOB",
+                    help="glob of flight-recorder crash dumps "
+                         "(runs/trace_r*_p*.jsonl); any matching dump "
+                         "that is schema-invalid or truncated beyond "
+                         "repair fails the gate")
+    ap.add_argument("--trace-overhead-bound", type=float, default=0.05,
+                    help="advisory step_ms bound for a trace-on "
+                         "candidate vs a trace-off baseline "
+                         "(default 0.05; never fails the gate)")
     args = ap.parse_args(argv)
+
+    if args.trace_dumps:
+        dumps = trace_dump_violations(args.trace_dumps)
+        if dumps:
+            print("TRACE DUMP UNREADABLE:")
+            for path, reason in dumps:
+                print(f"  {path}: {reason} — the flight recorder's "
+                      "crash dump cannot be replayed")
+            return 1
 
     base = load_cells(args.baseline)
     cand = load_cells(args.candidate)
@@ -473,6 +553,17 @@ def main(argv=None) -> int:
         for cell, metric, b, c, rel in regressions:
             print(f"  {cell}.{metric}: {b:g} -> {c:g} ({rel:+.1%})")
         return 1
+
+    overhead = trace_overhead_report(
+        {c: m for c, m in base.items() if not only or c in only},
+        {c: m for c, m in cand.items() if not only or c in only},
+        args.trace_overhead_bound)
+    for cell, b_ms, c_ms, rel, over in overhead:
+        verdict = ("OVER BOUND (advisory)" if over
+                   else f"within {args.trace_overhead_bound:.0%}")
+        print(f"  trace overhead {cell}: step_ms {b_ms:.3f} -> "
+              f"{c_ms:.3f} ({rel:+.1%}) — {verdict}")
+
     print(f"traffic budget OK: {covered} cell(s) within "
           f"{args.tolerance:.0%}")
     return 0

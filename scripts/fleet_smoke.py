@@ -13,6 +13,12 @@ and checks the cross-rank story end-to-end:
 * wire imbalance is nonzero (children book rank-skewed traffic);
 * every member reached a clean exit (supervisor exit events, rc 0).
 
+``--trace`` additionally runs the wire-tracer drill (ISSUE 15): every
+child arms the flight recorder and emits synthetic windows, rank 0
+drops a ``trace_trigger.json`` mid-run, and the smoke checks that every
+rank left a trigger dump that ``telemetry_report.py --trace`` parses
+and that the merged timeline correlates same-id windows across ranks.
+
 Capability-probed: containers that cannot spawn subprocesses (or where
 the launcher cannot run) print ``FLEET_SMOKE SKIP: <reason>`` and exit
 0, the same convention as the multiprocess pytest markers — CI treats
@@ -76,6 +82,13 @@ def main(argv=None) -> int:
                     help="inject a 40x grad-norm spike on rank 0 at "
                          "this step (implies --numerics); the merged "
                          "timeline must carry the anomaly")
+    ap.add_argument("--trace", action="store_true",
+                    help="arm the wire tracer in every child (synthetic "
+                         "windows, obs/trace.py): rank 0 drops a "
+                         "trace_trigger.json mid-run, every rank must "
+                         "leave a parseable flight-recorder dump and "
+                         "the merged timeline must correlate windows "
+                         "across ranks")
     ap.add_argument("--json", action="store_true",
                     help="dump the fleet summary as JSON")
     args = ap.parse_args(argv)
@@ -99,6 +112,8 @@ def main(argv=None) -> int:
         if args.numerics_spike >= 0:
             os.environ["SMTPU_FLEET_NUMERICS_SPIKE"] = \
                 str(args.numerics_spike)
+    if args.trace:
+        os.environ["SMTPU_FLEET_TRACE"] = "1"
     t0 = time.time()
     rc = smtpu_launch.supervise(
         [sys.executable, os.path.join(_REPO, "scripts",
@@ -141,6 +156,38 @@ def main(argv=None) -> int:
     if args.numerics_spike >= 0 and not s.get("numerics_anomaly_total"):
         failures.append("grad-norm spike injected but no anomaly in "
                         "the merged timeline")
+    n_dumps = 0
+    if args.trace:
+        import glob
+        for r in range(args.np):
+            paths = sorted(glob.glob(os.path.join(
+                fleet_dir, f"trace_r{r}_p*.jsonl")))
+            if not paths:
+                failures.append(f"rank {r}: no flight-recorder dump "
+                                "despite the mid-run trigger")
+                continue
+            for path in paths:
+                n_dumps += 1
+                parse = subprocess.run(
+                    [sys.executable,
+                     os.path.join(_REPO, "scripts",
+                                  "telemetry_report.py"),
+                     "--trace", path],
+                    capture_output=True, text=True, cwd=_REPO)
+                if parse.returncode != 0:
+                    failures.append(
+                        f"telemetry_report --trace cannot parse {path}: "
+                        f"{(parse.stderr or parse.stdout).strip()[:200]}")
+                    continue
+                with open(path) as f:
+                    meta = json.loads(f.readline())
+                if not str(meta.get("reason", "")).startswith("trigger"):
+                    failures.append(
+                        f"{path}: dump reason {meta.get('reason')!r} is "
+                        "not the fleet-dir trigger")
+        if not s.get("trace_windows_correlated"):
+            failures.append("traced windows did not correlate across "
+                            "ranks in the merged timeline")
 
     if args.json:
         json.dump(s, sys.stdout, indent=2, default=str)
@@ -153,6 +200,9 @@ def main(argv=None) -> int:
               f"skew_p50={s['fleet_step_ms_skew_ms']:.1f}ms  "
               f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}  "
               f"health={s['health']}")
+        if args.trace:
+            print(f"  trace: dumps={n_dumps}  windows_correlated="
+                  f"{s.get('trace_windows_correlated', 0)}")
         if numerics:
             print(f"  numerics: anomalies="
                   f"{s.get('numerics_anomaly_total', 0)} "

@@ -93,6 +93,24 @@ if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.p
 else
   echo "numerics smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
 fi
+# Advisory wire-trace smoke (ISSUE 15): the same 4-process fleet drill
+# with the flight recorder armed — every child emits synthetic windows,
+# rank 0 drops a trace_trigger.json mid-run, and fleet_smoke.py checks
+# that every rank left a parseable trigger dump and that the merged
+# timeline correlates same-id windows across ranks.  A rendered
+# `telemetry_report.py --trace` read of rank 0's dump shows the
+# per-window "why" an operator would triage from (docs/OPERATIONS.md
+# "Explaining a window's wire decision").
+TRACE_OUT="$REPO_DIR/runs/trace_smoke_$(date +%Y%m%d_%H%M%S)"
+echo "--- trace smoke (advisory) ---"
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.py" --out "$TRACE_OUT" --trace; then
+  TRACE_DUMP=$(ls "$TRACE_OUT"/trace_r0_p*.jsonl 2>/dev/null | head -1)
+  if [ -n "$TRACE_DUMP" ]; then
+    python "$(dirname "$0")/telemetry_report.py" --trace "$TRACE_DUMP" || echo "trace report ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
+else
+  echo "trace smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
+fi
 # Advisory calibration staleness check: verdicts recorded under another
 # jaxlib/libtpu stack no longer steer data-plane gates — say so next to
 # the verdict (exit code unchanged; the CLI always exits 0).

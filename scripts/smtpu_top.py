@@ -4,8 +4,9 @@
 Points a :class:`~swiftmpi_tpu.obs.collector.FleetCollector` at a fleet
 directory (the ``launch.py -fleet-dir`` target) and renders one row per
 rank: health, step progress and rate, phase p50/p95, wire traffic and
-decision mix, restart count, and a STRAGGLER flag from the collector's
-cross-rank attribution.  Refreshes in place until interrupted; the
+decision mix, restart count, the last traced wire window (WIN column,
+``id/age`` from obs/trace.py records in the fleet dir), and a
+STRAGGLER flag from the collector's cross-rank attribution.  Refreshes in place until interrupted; the
 ``--once`` mode renders a single frame and exits — that is what tests
 and CI call, and it works post-hoc on a finished run's directory
 (health is evaluated at the run's own end, see FleetCollector.now).
@@ -122,6 +123,7 @@ def frame(fc: FleetCollector) -> dict:
         step_ms = sorted(v[1] for v in per.values() if v[1] > 0)
         norms = fc._grad_norms(m)
         anomalies = fc._member_anomalies(m)
+        lw = m.get("last_window")
         rows.append({
             "rank": key,
             "ident": m["ident"],
@@ -137,6 +139,13 @@ def frame(fc: FleetCollector) -> dict:
             "wire_bytes": summary["wire_bytes"].get(key, 0.0),
             "fmt_mix": _member_fmt_mix(m),
             "retraces": _member_retraces(m),
+            # wire tracer (obs/trace.py): last traced window id and its
+            # age at the member's final heartbeat — a rank whose WIN age
+            # grows while its step advances has a wedged wire path
+            "last_window": lw["win"] if lw else None,
+            "last_window_age_s": (
+                max((m["last_seen"] or 0.0) - lw["t_abs"], 0.0)
+                if lw else None),
             "restarts": m["restarts"],
             "heartbeats": m["heartbeats"],
             "stalls": len(fc.stall_episodes(m)),
@@ -159,7 +168,7 @@ def render(fr: dict) -> str:
         f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}",
         f"{'RANK':<6}{'PID':>8}{'HEALTH':>9}{'STEP':>7}{'ST/S':>8}"
         f"{'P50MS':>8}{'P95MS':>8}{'WIRE':>12}{'GNORM':>9}{'HB':>5}"
-        f"{'RST':>4}{'RTRC':>5}  FMT-MIX / FLAGS",
+        f"{'RST':>4}{'RTRC':>5}{'WIN':>10}  FMT-MIX / FLAGS",
     ]
     for r in fr["members"]:
         mix = ",".join(f"{k}:{v}" for k, v in sorted(r["fmt_mix"].items()))
@@ -174,6 +183,10 @@ def render(fr: dict) -> str:
                 f"{k}:{anom[k]}" for k in sorted(anom)))
         gnorm = (f"{r['grad_norm']:>9.3g}" if r.get("grad_norm")
                  is not None else f"{'-':>9}")
+        if r.get("last_window") is not None:
+            win = f"{r['last_window']}/{r['last_window_age_s']:.0f}s"
+        else:
+            win = "-"
         lines.append(
             f"{r['rank']:<6}{r['pid'] or 0:>8}{r['health']:>9}"
             f"{r['step'] if r['step'] is not None else '-':>7}"
@@ -181,7 +194,7 @@ def render(fr: dict) -> str:
             f"{r['step_ms_p95']:>8.1f}{r['wire_bytes']:>12,.0f}"
             f"{gnorm}"
             f"{r['heartbeats']:>5}{r['restarts']:>4}"
-            f"{r.get('retraces', 0):>5}  "
+            f"{r.get('retraces', 0):>5}{win:>10}  "
             f"{mix or '-'}"
             + (("  " + " ".join(flags)) if flags else ""))
     if s["unnoticed_deaths"]:

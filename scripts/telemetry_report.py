@@ -194,7 +194,8 @@ def load(path: str) -> dict:
             heartbeats += 1
         elif isinstance(kind, str) and (kind.startswith("control/")
                                         or kind.startswith("numerics/")
-                                        or kind.startswith("profile/")):
+                                        or kind.startswith("profile/")
+                                        or kind.startswith("trace/")):
             events.append(rec)
     return {"meta": meta, "steps": steps, "events": events,
             "heartbeats": heartbeats, "summary": summary,
@@ -740,6 +741,207 @@ def _print_fleet_report(rep: dict) -> None:
                   f"across ranks")
 
 
+# -- trace mode (smtpu-trace/1 flight-recorder dumps) ---------------------
+TRACE_SCHEMA_PREFIX = "smtpu-trace/"
+
+
+def load_trace(path: str) -> dict:
+    """Load one flight-recorder dump (obs/trace.py ``dump()`` output:
+    a meta line + per-window records).  Crash tolerance matches
+    :func:`load` — a truncated FINAL line is repair-parsed and counted
+    under ``recovery``.  SystemExit(2) on unreadable / empty /
+    not-a-trace input."""
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if ln.strip()]
+    except OSError as e:
+        print(f"telemetry_report: cannot read {path}: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    if not lines:
+        print(f"telemetry_report: {path} is empty", file=sys.stderr)
+        raise SystemExit(2)
+    meta, windows = None, []
+    recovered = dropped = 0
+    last = len(lines) - 1
+    for n, ln in enumerate(lines):
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            rec = repair_json_line(ln) if n == last else None
+            if rec is None:
+                dropped += 1
+                continue
+            rec["repaired"] = True
+            recovered += 1
+        if not isinstance(rec, dict):
+            dropped += 1
+            continue
+        if rec.get("kind") == "meta":
+            meta = rec
+        elif rec.get("kind") == "trace/window":
+            windows.append(rec)
+    if meta is None and not windows:
+        print(f"telemetry_report: {path} is not a trace dump "
+              f"(no meta line, no trace/window records)",
+              file=sys.stderr)
+        raise SystemExit(2)
+    schema = (meta or windows[0]).get("schema", "")
+    if not str(schema).startswith(TRACE_SCHEMA_PREFIX):
+        print(f"telemetry_report: {path} is not a trace dump "
+              f"(schema={schema!r})", file=sys.stderr)
+        raise SystemExit(2)
+    windows.sort(key=lambda r: r.get("win", 0))
+    return {"meta": meta or {"schema": schema, "synthesized": True},
+            "windows": windows,
+            "recovery": {"recovered": recovered, "dropped": dropped}}
+
+
+def trace_report(doc: dict) -> dict:
+    """Machine-shaped flight-recorder report: the per-window timeline
+    (decision + why + volumes), decision counts, and the dump's hot-key
+    attribution table."""
+    rows = []
+    decisions: Dict[str, int] = {}
+    for rec in doc["windows"]:
+        d = str(rec.get("decision", "?"))
+        decisions[d] = decisions.get(d, 0) + 1
+        row = {k: rec.get(k) for k in (
+            "win", "step", "backend", "decision", "rows_in", "rows_out",
+            "enc_bytes", "exchanges", "prices", "quant", "hot_rows",
+            "ef_drained", "ef_rebanked", "shard_bytes", "repaired")
+            if rec.get(k) is not None}
+        rows.append(row)
+    meta = doc["meta"]
+    return {"meta": {k: meta.get(k) for k in
+                     ("schema", "reason", "rank", "pid", "win", "step",
+                      "records")},
+            "windows": rows, "decisions": decisions,
+            "hot_keys": meta.get("hot_keys") or [],
+            "recovery": doc["recovery"]}
+
+
+def _print_trace_report(rep: dict) -> None:
+    m = rep["meta"]
+    print(f"trace dump schema={m.get('schema')} reason={m.get('reason')} "
+          f"rank={m.get('rank')} last_win={m.get('win')} "
+          f"last_step={m.get('step')}")
+    r = rep["recovery"]
+    if r.get("recovered") or r.get("dropped"):
+        print(f"crashed-dump recovery: {r.get('recovered', 0)} record(s) "
+              f"repaired, {r.get('dropped', 0)} dropped")
+    counts = " ".join(f"{k}={rep['decisions'][k]}"
+                      for k in sorted(rep["decisions"]))
+    print(f"windows: {len(rep['windows'])} ({counts})")
+    print()
+    for w in rep["windows"]:
+        why = ""
+        prices = w.get("prices") or {}
+        if prices:
+            why = "  priced: " + " ".join(
+                f"{k}={_fmt_qty(v, 'B')}" for k, v in sorted(
+                    prices.items(), key=lambda kv: kv[1]))
+        extra = ""
+        if w.get("hot_rows") is not None:
+            extra += f" hot_rows={w['hot_rows']}"
+        if w.get("ef_drained") is not None:
+            extra += (f" ef_drained={w['ef_drained']:.4g}"
+                      f" ef_rebanked={w.get('ef_rebanked', 0.0):.4g}")
+        if w.get("repaired"):
+            extra += " [repaired]"
+        print(f"  win {w.get('win')} step {w.get('step')} "
+              f"[{w.get('backend')}] {w.get('decision')}: "
+              f"{w.get('rows_in')} -> {w.get('rows_out')} rows, "
+              f"{_fmt_qty(w.get('enc_bytes'), 'B')} encoded"
+              f"{extra}{why}")
+    if rep["hot_keys"]:
+        print()
+        print("hot keys (touches / attributed wire bytes):")
+        for h in rep["hot_keys"]:
+            print(f"  key {h.get('key')}: {h.get('touches', 0.0):,.1f} "
+                  f"touches, {_fmt_qty(h.get('bytes'), 'B')}")
+
+
+# -- history mode (smtpu-bench-history/1 trend tables) --------------------
+HISTORY_SCHEMA_PREFIX = "smtpu-bench-history/"
+
+
+def load_history(path: str) -> List[dict]:
+    """Load bench.py's append-only ``runs/bench_history.jsonl``; rows
+    with a foreign schema are dropped (the file is append-only across
+    versions).  SystemExit(2) on unreadable/empty/no-valid-rows."""
+    try:
+        with open(path) as f:
+            lines = [ln for ln in f if ln.strip()]
+    except OSError as e:
+        print(f"telemetry_report: cannot read {path}: {e}",
+              file=sys.stderr)
+        raise SystemExit(2)
+    rows = []
+    for ln in lines:
+        try:
+            rec = json.loads(ln)
+        except ValueError:
+            continue
+        if isinstance(rec, dict) and str(rec.get("schema", "")).startswith(
+                HISTORY_SCHEMA_PREFIX):
+            rows.append(rec)
+    if not rows:
+        print(f"telemetry_report: {path} has no "
+              f"{HISTORY_SCHEMA_PREFIX}* rows", file=sys.stderr)
+        raise SystemExit(2)
+    rows.sort(key=lambda r: r.get("ts", 0.0))
+    return rows
+
+
+def history_report(rows: List[dict]) -> dict:
+    """Trend table per cell: chronological (ts, git_sha, stack_key,
+    value) points plus first->last delta so a regression names the
+    commit range it arrived in."""
+    cells: Dict[str, List[dict]] = {}
+    for r in rows:
+        cells.setdefault(str(r.get("cell", "?")), []).append(r)
+    out = {}
+    for cell, rs in sorted(cells.items()):
+        field = "value" if any("value" in r for r in rs) else None
+        if field is None:
+            # secondary cells carry their metric under tpu/cpu keys
+            for cand in ("tpu", "cpu", "tpu_cached"):
+                if any(isinstance(r.get(cand), (int, float))
+                       for r in rs):
+                    field = cand
+                    break
+        points = [{"ts": r.get("ts"), "git_sha": r.get("git_sha"),
+                   "stack_key": r.get("stack_key"),
+                   "value": r.get(field) if field else None}
+                  for r in rs]
+        numeric = [p["value"] for p in points
+                   if isinstance(p["value"], (int, float))]
+        entry = {"field": field, "points": points, "runs": len(points)}
+        if len(numeric) >= 2 and numeric[0]:
+            entry["delta_pct"] = 100.0 * (numeric[-1] - numeric[0]) \
+                / abs(numeric[0])
+        out[cell] = entry
+    return out
+
+
+def _print_history_report(rep: dict) -> None:
+    import time as _time
+    print("bench history trends:")
+    for cell, e in rep.items():
+        delta = (f"  ({e['delta_pct']:+.1f}% first->last)"
+                 if "delta_pct" in e else "")
+        print(f"  {cell} [{e.get('field')}] — {e['runs']} run(s){delta}")
+        for p in e["points"]:
+            day = (_time.strftime("%Y-%m-%d %H:%M",
+                                  _time.localtime(p["ts"]))
+                   if p.get("ts") else "?")
+            v = p.get("value")
+            v_s = f"{v:,.2f}" if isinstance(v, (int, float)) else "-"
+            print(f"    {day}  {str(p.get('git_sha')):>10}  "
+                  f"{v_s:>14}  {p.get('stack_key')}")
+
+
 # -- rendering ------------------------------------------------------------
 def _print_numerics(num: dict) -> None:
     print()
@@ -931,8 +1133,32 @@ def main(argv=None) -> int:
                     help="treat path as an smtpu-fleet/1 merged "
                     "timeline (or a fleet dir): per-rank columns, "
                     "supervisor events, skew timeline")
+    ap.add_argument("--trace", action="store_true",
+                    help="treat path as an smtpu-trace/1 flight-"
+                    "recorder dump (obs/trace.py): per-window wire "
+                    "decisions with priced alternatives, hot keys")
+    ap.add_argument("--history", action="store_true",
+                    help="treat path as a smtpu-bench-history/1 "
+                    "runs/bench_history.jsonl: per-cell trend tables "
+                    "stamped with git SHA + stack key")
     args = ap.parse_args(argv)
 
+    if args.trace:
+        rep = trace_report(load_trace(args.path))
+        if args.json:
+            json.dump(rep, sys.stdout, indent=2)
+            print()
+        else:
+            _print_trace_report(rep)
+        return 0
+    if args.history:
+        rep = history_report(load_history(args.path))
+        if args.json:
+            json.dump(rep, sys.stdout, indent=2)
+            print()
+        else:
+            _print_history_report(rep)
+        return 0
     if args.fleet:
         rep = fleet_report(load_fleet(args.path))
         if args.json:

@@ -22,6 +22,16 @@ log = get_logger("apps.glove")
 
 
 def main(argv=None) -> int:
+    try:
+        return _main(argv)
+    finally:
+        # normal exits leave no flight-recorder dump (obs/trace.py
+        # clean-teardown contract)
+        from swiftmpi_tpu import obs
+        obs.uninstall_tracer()
+
+
+def _main(argv=None) -> int:
     cmd = CMDLine(argv)
     cmd.registerParameter("help", "this screen")
     cmd.registerParameter("config", "path of config file ([glove] "

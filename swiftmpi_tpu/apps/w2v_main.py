@@ -21,6 +21,17 @@ log = get_logger("apps.w2v")
 
 
 def main(argv=None) -> int:
+    try:
+        return _main(argv)
+    finally:
+        # clean teardown: a normal exit must not leave a misleading
+        # reason="crash" flight-recorder dump behind (and must not
+        # clobber a mid-run trigger dump at the same path)
+        from swiftmpi_tpu import obs
+        obs.uninstall_tracer()
+
+
+def _main(argv=None) -> int:
     cmd = CMDLine(argv)
     cmd.registerParameter("help", "this screen")
     cmd.registerParameter("config", "path of config file")

@@ -110,3 +110,17 @@ class DecayedSketch:
     def counts(self) -> np.ndarray:
         """The current histogram (as of the last fold; read-only)."""
         return self._counts
+
+    def topk(self, k: int):
+        """``[(id, decayed_count), ...]`` for the ``k`` hottest ids (as
+        of the last fold), hottest first; zero-count ids are excluded.
+        The wire tracer's hot-key attribution reads this to rank keys
+        by decayed touch frequency (obs/trace.py)."""
+        k = int(k)
+        if k <= 0:
+            return []
+        c = self._counts
+        n = min(k, c.size)
+        idx = np.argpartition(c, -n)[-n:]
+        idx = idx[np.argsort(c[idx])[::-1]]
+        return [(int(i), float(c[i])) for i in idx if c[i] > 0]

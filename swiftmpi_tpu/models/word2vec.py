@@ -1641,6 +1641,20 @@ class Word2Vec:
             tel_rec.add_sampler(_tel_sample)
         if self.numerics_on and tel_rec is not None:
             self._arm_numerics(tel_rec)
+        # wire tracer hot-key attribution ([obs] trace): the control
+        # sketch's decayed counts replace the reservoir touch estimates.
+        # build() armed control before obs.configure installed the
+        # tracer, so the attach happens here too.
+        _tracer = obs.get_tracer()
+        if _tracer is not None and self._control_sketch is not None:
+            _tracer.attach_sketch(self._control_sketch)
+        # The tracer's window records are fed from the wire ledger's
+        # landing points, which are behind the count_traffic opt-in
+        # (one extra host reduce per push, no traced-value change) —
+        # arm it so `[obs] trace: 1` records through the CLI without a
+        # second knob.
+        if _tracer is not None and hasattr(self.transfer, "count_traffic"):
+            self.transfer.count_traffic = True
         # step compile AFTER numerics arming: the builders close over
         # self._numerics at trace time, and a first-time arm drops any
         # step compiled without the bundle
@@ -2118,6 +2132,11 @@ class Word2Vec:
         self.controller = Controller(st, transfer=self.transfer,
                                      sketch=self._control_sketch,
                                      knobs=knobs)
+        # wire tracer hot-key attribution: the sketch's decayed counts
+        # replace the reservoir's touch estimates (obs/trace.py)
+        tracer = obs.get_tracer()
+        if tracer is not None:
+            tracer.attach_sketch(self._control_sketch)
 
     def _control_on_steps(self, n: int) -> bool:
         """Trainer-thread control hook — called at the same safe points

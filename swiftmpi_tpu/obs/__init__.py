@@ -60,7 +60,8 @@ __all__ = [
     "named_scope", "configure", "install_recorder", "uninstall_recorder",
     "get_recorder", "record_step", "CostCatalog", "TrackedFn",
     "get_catalog", "get_profiler", "install_profiler",
-    "uninstall_profiler",
+    "uninstall_profiler", "get_tracer", "install_tracer",
+    "uninstall_tracer",
 ]
 
 #: named scope for *compiled* code — same phase names as :func:`span`,
@@ -70,6 +71,7 @@ named_scope = jax.named_scope
 _REGISTRY = MetricsRegistry(enabled=False)
 _RECORDER: Optional[StepRecorder] = None
 _PROFILER = None    # Optional[obs.profiler.ProfileSession]
+_TRACER = None      # Optional[obs.trace.WindowTracer]
 
 
 def get_registry() -> MetricsRegistry:
@@ -88,10 +90,11 @@ def reset_for_tests() -> MetricsRegistry:
     Cached instrument handles bound to the old registry keep working but
     write into the discarded object — hence writers re-check
     ``get_registry()`` identity (see ``Transfer._obs_state``)."""
-    global _REGISTRY, _RECORDER, _PROFILER
+    global _REGISTRY, _RECORDER, _PROFILER, _TRACER
     _REGISTRY = MetricsRegistry(enabled=False)
     _RECORDER = None
     _PROFILER = None
+    uninstall_tracer()
     costs.reset_for_tests()
     return _REGISTRY
 
@@ -179,6 +182,9 @@ def record_step(n: int = 1) -> None:
     prof = _PROFILER
     if prof is not None:
         prof.on_step(n)
+    tr = _TRACER
+    if tr is not None:
+        tr.on_step(n)
 
 
 # -- profiler-session install point (obs/profiler.py) -----------------------
@@ -198,6 +204,37 @@ def uninstall_profiler():
 
 def get_profiler():
     return _PROFILER
+
+
+# -- wire-tracer install point (obs/trace.py) -------------------------------
+
+def install_tracer(tr, crash_flush: bool = True):
+    """Make ``tr`` the WindowTracer the transfer ledgers and
+    :func:`record_step` feed.  ``crash_flush`` enrolls it in the
+    recorder module's atexit + fatal-signal hooks so a killed rank
+    still leaves a flight-recorder dump behind."""
+    global _TRACER
+    _TRACER = tr
+    if crash_flush:
+        from swiftmpi_tpu.obs import recorder as recorder_mod
+        recorder_mod._CRASH_RECORDERS.add(tr)
+        recorder_mod._install_crash_hooks()
+    return tr
+
+
+def uninstall_tracer():
+    """Clean teardown: detach the tracer WITHOUT dumping (a crash dump
+    from a normal exit would be noise) and drop its crash enrollment."""
+    global _TRACER
+    tr, _TRACER = _TRACER, None
+    if tr is not None:
+        from swiftmpi_tpu.obs import recorder as recorder_mod
+        recorder_mod._CRASH_RECORDERS.discard(tr)
+    return tr
+
+
+def get_tracer():
+    return _TRACER
 
 
 # -- config gate ------------------------------------------------------------
@@ -253,9 +290,11 @@ def configure(config, run: str = "run",
         g("obs", "fleet_dir", "").to_string()
     cat = costs.configure_costs(config, run=run)
     prof = _configure_profiler(config, fleet_dir)
-    if cat is not None or prof is not None:
+    tr = _configure_tracer(config, fleet_dir)
+    if cat is not None or prof is not None or tr is not None:
         # instruments must record even without a JSONL sink — the
-        # catalog artifact and the capture summaries still read them
+        # catalog artifact, the capture summaries and the trace ring
+        # still read them
         set_enabled(True)
     if not g("worker", "telemetry", 0).to_bool() and not fleet_dir:
         return None
@@ -277,6 +316,9 @@ def configure(config, run: str = "run",
                       2.0 if fleet_dir else 0.0).to_float(),
         crash_flush=g("obs", "crash_flush", 1).to_bool(),
     )
+    if tr is not None:
+        # hot-key attribution + last-window gauges ride the step series
+        rec.add_sampler(tr.sampler)
     return install_recorder(rec)
 
 
@@ -307,3 +349,35 @@ def _configure_profiler(config, fleet_dir: str):
         fleet_dir=fleet_dir if trigger else None,
         capture_on_anomaly=on_anomaly)
     return install_profiler(sess)
+
+
+def _configure_tracer(config, fleet_dir: str):
+    """Install a WindowTracer when ``[obs] trace`` is armed (default off
+    — the transfer ledgers' host callbacks then never touch the trace
+    plane and the key-reservoir tap stays out of the traced programs,
+    which is the bit-identity contract the ON-vs-OFF tests pin).  Like
+    every format-affecting knob, arming or clearing mid-run requires a
+    step rebuild for the reservoir/EF taps to appear or vanish; the
+    record/ledger plumbing itself follows the tracer live."""
+    g = config.get_or
+    if not g("obs", "trace", 0).to_bool():
+        return None
+    cur = get_tracer()
+    if cur is not None:
+        # repeated train() calls must not stack tracers: the old one
+        # would stay enrolled in _CRASH_RECORDERS and dump a stale
+        # "crash" ring at exit.  The installed instance follows the
+        # run live; re-arming with different knobs needs an explicit
+        # uninstall_tracer() first.
+        return cur
+    from swiftmpi_tpu.obs import trace as trace_mod
+    tr = trace_mod.WindowTracer(
+        trace_dir=g("obs", "trace_dir", "runs").to_string(),
+        ring=g("obs", "trace_ring", 256).to_int32(),
+        sample=g("obs", "trace_sample", 1).to_int32(),
+        keys=g("obs", "trace_keys", 64).to_int32(),
+        topk=g("obs", "trace_topk", 8).to_int32(),
+        fleet_dir=fleet_dir or None,
+        dump_on_anomaly=g("obs", "trace_on_anomaly", 1).to_bool())
+    return install_tracer(
+        tr, crash_flush=g("obs", "crash_flush", 1).to_bool())

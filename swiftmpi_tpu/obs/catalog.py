@@ -85,6 +85,12 @@ SERIES = frozenset({
     # and per-phase device/host attribution from the trace parse
     "profile/sessions", "profile/steps",
     "profile/device_ms", "profile/host_ms", "profile/skew_ms",
+    # wire-path tracing plane (obs/trace.py, ISSUE 15): flight-recorder
+    # volume counters, the last-traced-window gauge smtpu_top's WIN
+    # column reads, and the hot-key attribution gauges (key= label)
+    "trace/windows", "trace/records", "trace/dumps",
+    "trace/last_window_id",
+    "trace/hot_key_touches", "trace/hot_key_bytes",
 }) | frozenset("transfer/" + k for k in TRANSFER_KEYS)
 
 #: Dynamic-name families: an f-string series name passes the catalog

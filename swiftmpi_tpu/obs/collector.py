@@ -353,6 +353,7 @@ class FleetCollector:
                 "exits": exits,
                 "_streams": streams,
             }
+            out[key]["last_window"] = self._last_window(out[key])
         return out
 
     def _exits_for(self, key: str, streams: List[_Stream]) -> List[dict]:
@@ -515,6 +516,72 @@ class FleetCollector:
                 per_step.setdefault(step, {})[key] = v
         return obs_numerics.cross_rank_divergence(per_step)
 
+    # -- wire-trace correlation (obs/trace.py, ISSUE 15) -------------------
+    @staticmethod
+    def _member_trace_windows(member: dict) -> Dict[int, dict]:
+        """win id -> latest ``trace/window`` event for one member (the
+        tracer mirrors each finalized record onto the recorder's event
+        stream when a fleet dir is armed); later lives overwrite."""
+        out: Dict[int, dict] = {}
+        for s in member["_streams"]:
+            for ev in s.events:
+                if ev.get("kind") != "trace/window":
+                    continue
+                try:
+                    out[int(ev["win"])] = ev
+                except (KeyError, TypeError, ValueError):
+                    continue
+        return out
+
+    def window_correlation(self) -> List[dict]:
+        """One row per window id traced by >= 2 members: per-rank
+        arrival time / encoded bytes / surviving rows, the cross-rank
+        arrival spread, and last-to-arrive attribution.  Window ids are
+        per-rank monotonic over the same consumed-step sequence, so
+        equal ids across ranks are the same logical exchange — the
+        causal join key the per-rank ledgers cannot provide."""
+        per = {key: self._member_trace_windows(m)
+               for key, m in self.members().items()}
+        counts: Dict[int, int] = {}
+        for table in per.values():
+            for win in table:
+                counts[win] = counts.get(win, 0) + 1
+        rows = []
+        for win in sorted(w for w, c in counts.items() if c >= 2):
+            evs = {k: v[win] for k, v in per.items() if win in v}
+            t = {k: float(e.get("t_abs", 0.0)) for k, e in evs.items()}
+            row = {
+                "win": win,
+                "step": max((int(e.get("step", 0))
+                             for e in evs.values()), default=0),
+                "backend": next(iter({str(e.get("backend"))
+                                      for e in evs.values()}), None),
+                "decision": sorted({str(e.get("decision"))
+                                    for e in evs.values()}),
+                "t": t,
+                "enc_bytes": {k: int(e.get("enc_bytes", 0))
+                              for k, e in evs.items()},
+                "rows_out": {k: int(e.get("rows_out", 0))
+                             for k, e in evs.items()},
+            }
+            if t:
+                row["spread_ms"] = (max(t.values()) - min(t.values())) \
+                    * 1e3
+                row["last_rank"] = max(t, key=t.get)
+            rows.append(row)
+        return rows
+
+    @staticmethod
+    def _last_window(member: dict) -> Optional[dict]:
+        """Most recent traced window for one member — smtpu_top's WIN
+        column ({win, t_abs}), None when the member never traced."""
+        table = FleetCollector._member_trace_windows(member)
+        if not table:
+            return None
+        win = max(table)
+        return {"win": win,
+                "t_abs": float(table[win].get("t_abs", 0.0))}
+
     # -- fleet summary -----------------------------------------------------
     @staticmethod
     def _p50(vals: List[float]) -> float:
@@ -603,6 +670,11 @@ class FleetCollector:
             "fleet_grad_norm_divergence": max(
                 (d["ratio"] for d in divergence), default=0.0),
             "cross_rank_anomalies": len(divergence),
+            # wire-trace plane (obs/trace.py): window records joined on
+            # the per-rank-monotonic window id
+            "trace_windows_correlated": len(self.window_correlation()),
+            "last_window": {k: m["last_window"]
+                            for k, m in members.items()},
         }
 
     # -- merged timeline ---------------------------------------------------
@@ -670,6 +742,12 @@ class FleetCollector:
         for d in self.numerics_divergence():
             recs.append({"v": FLEET_SCHEMA_V,
                          "kind": "numerics/cross_rank", **d})
+        wrows = self.window_correlation()
+        if max_rows is not None and len(wrows) > max_rows:
+            wrows = wrows[-max_rows:]
+        for row in wrows:
+            recs.append({"v": FLEET_SCHEMA_V, "kind": "trace/window_corr",
+                         **row})
         rows = self.aligned()
         if max_rows is not None and len(rows) > max_rows:
             rows = rows[-max_rows:]

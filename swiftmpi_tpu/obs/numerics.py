@@ -458,7 +458,12 @@ class AnomalyDetector:
             # captures the very steps that misbehaved — no-op unless
             # [obs] profile_on_anomaly armed a session
             from swiftmpi_tpu.obs import profiler as obs_profiler
+            from swiftmpi_tpu.obs import trace as obs_trace
             obs_profiler.on_critical_anomaly(anomaly)
+            # flight-recorder dump (ISSUE 15): preserve the last-N
+            # window wire records surrounding the anomaly — no-op
+            # unless a tracer is installed with [obs] trace_on_anomaly
+            obs_trace.on_critical_anomaly(anomaly)
 
     # .. checkpoint carry ..................................................
 

@@ -137,25 +137,50 @@ def window_wire_format(rows: int, capacity: int, row_bytes: int,
     volume clears the **quantization-error guard**: sparse_q is picked
     only when ``q_vol * quant_guard <= lossless_vol`` (default 1.25 —
     never pay quantization error for a marginal byte win)."""
+    decision, _ = price_window_formats(
+        rows, capacity, row_bytes, dense_ratio=dense_ratio,
+        expected_unique=expected_unique, quant=quant,
+        quant_row_bytes=quant_row_bytes, quant_guard=quant_guard)
+    return decision
+
+
+def price_window_formats(rows: int, capacity: int, row_bytes: int,
+                         dense_ratio: float = 2.0,
+                         expected_unique: Optional[float] = None,
+                         quant: str = "off",
+                         quant_row_bytes: Optional[int] = None,
+                         quant_guard: float = 1.25):
+    """The :func:`window_wire_format` decision WITH its evidence: returns
+    ``(decision, prices)`` where ``prices`` maps every candidate format
+    that was actually priced to its modeled byte volume — the "why did
+    this window densify" record the wire-tracing plane
+    (:mod:`swiftmpi_tpu.obs.trace`) attaches to each trace record.  The
+    decision logic is byte-for-byte the one documented on
+    :func:`window_wire_format` (which delegates here); with ``quant ==
+    "off"`` only the 2-way sparse/dense pair is priced, so the candidate
+    set itself records which rungs were even in play."""
     eff = float(min(rows, capacity))
     if expected_unique is not None:
         eff = min(eff, float(expected_unique))
     sparse_vol = eff * (4.0 + row_bytes)
     dense_vol = float(capacity) * row_bytes
+    prices = {"sparse": sparse_vol, "dense": dense_vol}
     if sparse_vol * dense_ratio >= dense_vol:
-        return "dense"
+        return "dense", prices
     if quant == "off":
-        return "sparse"
+        return "sparse", prices
     value_bytes = max(float(row_bytes) - 4.0, 0.0)
     bitmap_vol = capacity / 8.0 + eff * value_bytes
+    prices["bitmap"] = bitmap_vol
     best, best_vol = "sparse", sparse_vol
     if bitmap_vol < best_vol:
         best, best_vol = "bitmap", bitmap_vol
     if quant_row_bytes is not None:
         q_vol = eff * (4.0 + float(quant_row_bytes))
+        prices["sparse_q"] = q_vol
         if q_vol * quant_guard <= best_vol:
-            return "sparse_q"
-    return best
+            return "sparse_q", prices
+    return best, prices
 
 
 class HotColdPartition:

@@ -140,7 +140,7 @@ def numerics_quant_err(err_sq) -> None:
 
 
 def ef_quantize_window(state, ded_slots, ded_grads, capacity: int,
-                       quant: str):
+                       quant: str, trace_backend: Optional[str] = None):
     """Error-feedback quantize of one deduped window: drain each touched
     slot's residual into its gradient sum, quantize-dequantize, and
     store the new per-slot quantization error back into the ``<f>@ef``
@@ -174,6 +174,11 @@ def ef_quantize_window(state, ded_slots, ded_grads, capacity: int,
     out_state = dict(state)
     out_grads = dict(ded_grads)
     err_sq = None
+    # tracer armed at trace time adds two |.|-sum reads per EF field —
+    # pure reads, values untouched; same rebuild-to-arm contract as the
+    # numerics tap above
+    tracer = obs.get_tracer()
+    drained = rebanked = None
     for f, g in ded_grads.items():
         efk = ef_name(f)
         if efk not in state:
@@ -190,8 +195,20 @@ def ef_quantize_window(state, ded_slots, ded_grads, capacity: int,
         if _NUMERICS_TAP is not None:
             fsq = jnp.sum(err ** 2)
             err_sq = fsq if err_sq is None else err_sq + fsq
+        if tracer is not None:
+            dsum = jnp.sum(jnp.abs(res))
+            esum = jnp.sum(jnp.abs(err))
+            drained = dsum if drained is None else drained + dsum
+            rebanked = esum if rebanked is None else rebanked + esum
     if err_sq is not None:
         numerics_quant_err(err_sq)
+    if tracer is not None and drained is not None:
+        from functools import partial
+        cb = partial(tracer.stage_ef, trace_backend or "?")
+        if isinstance(drained, jax.core.Tracer):
+            jax.debug.callback(cb, drained, rebanked)
+        else:
+            cb(float(drained), float(rebanked))
     return out_state, out_grads
 
 
@@ -336,6 +353,14 @@ class Transfer:
         self._obs_inc("dispatches", ndisp)
         if decision:
             self._count_decision(st, decision)
+        # wire-tracing plane (obs/trace.py): the tracer reads the SAME
+        # host landing point the ledger books through, so its records
+        # agree with the counters by construction and arming it changes
+        # nothing in the traced program
+        tr = obs.get_tracer()
+        if tr is not None:
+            tr.on_exchange(self.name, int(rows), int(row_bytes),
+                           base_bytes=int(base_bytes), decision=decision)
 
     def _record_exchange(self, rows, row_bytes: int,
                          decision: Optional[str] = None,
@@ -351,6 +376,13 @@ class Transfer:
                      base_bytes=int(base_bytes))
         if isinstance(rows, jax.core.Tracer):
             jax.debug.callback(cb, rows)
+        elif obs.get_tracer() is not None:
+            # armed tracer: land eagerly (program order) so the window
+            # state machine attributes bytes to the RIGHT open record —
+            # the batching queue would park this exchange past the next
+            # window's open.  Ledger totals are identical either way.
+            self._accum_wire(int(row_bytes), rows, decision=decision,
+                             base_bytes=int(base_bytes))
         else:
             st = self._wire_state()
             st["pending"].append((int(row_bytes), rows, decision,
@@ -421,6 +453,12 @@ class Transfer:
         self._obs_inc("coalesced_rows_out", int(rows_out))
         if decision:
             self._count_decision(st, decision)
+            tr = obs.get_tracer()
+            if tr is not None:
+                # a decision-carrying dedup opens this backend's window
+                # record; the following exchange callback seals it
+                tr.on_window(self.name, decision, int(rows_in),
+                             int(rows_out))
 
     def _record_coalesce(self, rows_in, rows_out,
                          decision: Optional[str] = None) -> None:
@@ -552,16 +590,67 @@ class Transfer:
         dense alternative.  The ONE place backends ask the wire-format
         question — call sites no longer read config/module constants
         directly, so the control plane can steer the crossover (ratio
-        and expected-unique estimate) without touching compiled code."""
-        from swiftmpi_tpu.parameter.key_index import window_wire_format
-        return window_wire_format(
+        and expected-unique estimate) without touching compiled code.
+
+        When the wire tracer is armed the full candidate pricing (every
+        format's modeled byte volume, not just the winner) is cached on
+        it, so each runtime window record can say WHY its format won
+        (obs/trace.py)."""
+        from swiftmpi_tpu.parameter.key_index import price_window_formats
+        quant = (self.wire_quant if quant_row_bytes is not None
+                 else "off")
+        decision, prices = price_window_formats(
             int(rows), int(capacity), int(row_bytes),
             dense_ratio=self.wire_dense_ratio(family),
             expected_unique=self.window_expected_unique,
-            quant=self.wire_quant if quant_row_bytes is not None
-            else "off",
+            quant=quant,
             quant_row_bytes=quant_row_bytes,
             quant_guard=self.wire_quant_guard)
+        tr = obs.get_tracer()
+        if tr is not None:
+            tr.on_decision(self.name, decision, prices, int(rows),
+                           int(capacity), int(row_bytes), quant=quant)
+        return decision
+
+    def _trace_keys(self, ded_slots, cap_per_shard: Optional[int] = None,
+                    n_shards: Optional[int] = None) -> None:
+        """Ship a bounded strided reservoir of the surviving (deduped,
+        ``-1``-padded) slot array — and, when the backend knows its
+        ``slot // cap_per_shard`` owner mapping, the surviving-row count
+        per destination shard — to the armed wire tracer.  Pure reads
+        plus one host callback, added to the traced program only when a
+        tracer with a key reservoir is installed at trace time (values
+        are untouched, so trajectories stay bit-identical either way;
+        arming mid-run requires the usual step rebuild)."""
+        tr = obs.get_tracer()
+        if tr is None or tr.keys <= 0:
+            return
+        from functools import partial
+        ded_slots = jnp.asarray(ded_slots)
+        B = int(ded_slots.shape[0])
+        if B == 0:
+            return
+        stride = max(B // int(tr.keys), 1)
+        sample = ded_slots[::stride][:int(tr.keys)]
+        cb = partial(tr.stage_keys, self.name)
+        shard_rows = None
+        if cap_per_shard and n_shards:
+            valid = ded_slots >= 0
+            own = jnp.where(valid,
+                            ded_slots // jnp.int32(cap_per_shard),
+                            jnp.int32(n_shards))
+            shard_rows = jnp.zeros((int(n_shards) + 1,), jnp.int32).at[
+                own].add(1, mode="drop")[:int(n_shards)]
+        if isinstance(sample, jax.core.Tracer) or \
+                isinstance(shard_rows, jax.core.Tracer):
+            if shard_rows is None:
+                jax.debug.callback(cb, sample)
+            else:
+                jax.debug.callback(cb, sample, shard_rows)
+        elif shard_rows is None:
+            cb(np.asarray(sample))
+        else:
+            cb(np.asarray(sample), np.asarray(shard_rows))
 
     def pull(self, state: TableState, slots, access: AccessMethod,
              fields=None) -> TableState:

@@ -39,6 +39,7 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
+from swiftmpi_tpu import obs
 from swiftmpi_tpu.cluster.mesh import SHARD_AXIS
 from swiftmpi_tpu.parameter.sparse_table import (base_field, hot_name,
                                                  is_hot_field)
@@ -311,6 +312,18 @@ class HybridTransfer(Transfer):
                                   jnp.sum(ded_slots >= 0))
         is_hot = (ded_slots >= 0) & (ded_slots < n_hot)
         tail_slots = jnp.where(ded_slots >= n_hot, ded_slots - n_hot, -1)
+        # stage the hot/tail split for the wire tracer under the TAIL's
+        # name: the tail TpuTransfer owns the decision-carrying window
+        # record this callback's extras attach to (obs/trace.py)
+        tr = obs.get_tracer()
+        if tr is not None:
+            hot_rows = jnp.sum(is_hot)
+            cb = (lambda v, _tr=tr, _n=self.tail.name:
+                  _tr.stage(_n, hot_rows=int(v)))
+            if isinstance(hot_rows, jax.core.Tracer):
+                jax.debug.callback(cb, hot_rows)
+            else:
+                cb(hot_rows)
         # mean normalization now depends on the collapsed multiplicities,
         # so both slices take the counts wire format
         need_counts = mean or (counts is not None)

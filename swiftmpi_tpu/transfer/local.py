@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import numpy as np
 
+from swiftmpi_tpu import obs
 from swiftmpi_tpu.parameter.access import AccessMethod
 from swiftmpi_tpu.parameter.sparse_table import ef_name
 from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
@@ -142,6 +143,9 @@ class LocalTransfer(Transfer):
             acc = np.zeros((len(uniq), g.shape[1]), np.float32)
             np.add.at(acc, pos, g[valid])
             sums[f] = acc
+        # wire tracer key reservoir (eager numpy twin of the device
+        # backends' tap; no-op unless armed)
+        self._trace_keys(uniq)
         self._record_coalesce(int(valid.sum()), len(uniq),
                               decision=decision)
         if decision == "sparse_q":
@@ -149,6 +153,7 @@ class LocalTransfer(Transfer):
             # same order of operations as api.ef_quantize_window
             state = dict(state)
             err_sq = 0.0
+            drained = rebanked = 0.0
             banked = False
             for f in list(sums):
                 efk = ef_name(f)
@@ -156,6 +161,7 @@ class LocalTransfer(Transfer):
                     continue
                 ef = np.asarray(state[efk], np.float32).copy()
                 tot = sums[f] + ef[uniq]
+                drained += float(np.sum(np.abs(ef[uniq])))
                 deq = np.asarray(
                     quantize_dequantize(tot, self.wire_quant),
                     np.float32)
@@ -163,9 +169,13 @@ class LocalTransfer(Transfer):
                 state[efk] = ef
                 sums[f] = deq
                 err_sq += float(np.sum((tot - deq) ** 2))
+                rebanked += float(np.sum(np.abs(tot - deq)))
                 banked = True
             if banked:
                 numerics_quant_err(err_sq)
+                tracer = obs.get_tracer()
+                if tracer is not None:
+                    tracer.stage_ef(self.name, drained, rebanked)
             wire = (quant_grad_row_bytes(sums, self.wire_quant,
                                          with_counts=True), 0)
         else:       # bitmap: same payload at mask-indexed encoding

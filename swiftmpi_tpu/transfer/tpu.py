@@ -429,6 +429,12 @@ class TpuTransfer(Transfer):
                                            mean, fcounts)
         if pre_deduped:
             ded_slots, ded_grads, ded_counts = flat, fgrads, fcounts
+            # wire tracer key reservoir + per-destination-shard rows
+            # (no-op unless armed); staged BEFORE the coalesce callback
+            # opens the window record
+            self._trace_keys(ded_slots,
+                             cap_per_shard=capacity // self.n,
+                             n_shards=self.n)
             if self.count_traffic:
                 # the caller (hybrid) already logged the dedup row deltas
                 # on its own ledger, but the wire decision is made HERE —
@@ -439,6 +445,9 @@ class TpuTransfer(Transfer):
         else:
             ded_slots, ded_grads, ded_counts = self._window_dedup(
                 flat, fgrads, fcounts, capacity)
+            self._trace_keys(ded_slots,
+                             cap_per_shard=capacity // self.n,
+                             n_shards=self.n)
             if self.count_traffic:
                 self._record_coalesce(jnp.sum(flat >= 0),
                                       jnp.sum(ded_slots >= 0),
@@ -453,7 +462,8 @@ class TpuTransfer(Transfer):
             # values (the routed payload stays dequantized f32), bank
             # the new per-slot error; book the exchange at encoded size
             state, ded_grads = ef_quantize_window(
-                state, ded_slots, ded_grads, capacity, quant)
+                state, ded_slots, ded_grads, capacity, quant,
+                trace_backend=self.name)
             wire = (quant_grad_row_bytes(ded_grads, quant,
                                          with_counts=need_counts), 0)
         elif decision == "bitmap":

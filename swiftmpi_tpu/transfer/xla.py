@@ -295,12 +295,16 @@ class XlaTransfer(Transfer):
         ded_counts = jnp.zeros(fcounts.shape, jnp.float32).at[owner].add(
             fcounts * valid, mode="drop")
         ded_slots = jnp.where(is_owner, flat, -1)
+        # wire tracer key reservoir (no-op unless armed); single-device
+        # oracle, so no destination-shard split
+        self._trace_keys(ded_slots)
         if self.count_traffic:
             self._record_coalesce(jnp.sum(valid), jnp.sum(is_owner),
                                   decision=decision)
         if decision == "sparse_q":
             state, ded_grads = ef_quantize_window(
-                state, ded_slots, ded_grads, capacity, self.wire_quant)
+                state, ded_slots, ded_grads, capacity, self.wire_quant,
+                trace_backend=self.name)
             wire = (quant_grad_row_bytes(ded_grads, self.wire_quant,
                                          with_counts=True), 0)
         else:       # bitmap: same payload, mask-indexed representation
