@@ -1798,6 +1798,89 @@ def _bench_cpp_oracle():
             "epochs_timed": rec["epochs"]}
 
 
+def _bench_w2v_fleet8(steps: int = 40) -> dict:
+    """Elastic scaling cell (ISSUE 16): one supervise_elastic world per
+    N in {1, 2, 4, 8} over the elastic fleet child (scripts/
+    _fleet_child.py, SMTPU_ELASTIC=1) — no faults, clean worlds — and
+    the aggregate trained-rows/s ("words/s" proxy: every owned row gets
+    one training touch per step) plus total modeled wire bytes per N.
+
+    Same 1-core-host framing as scripts/rank8_baseline.py: N processes
+    timeslice one core, so aggregate words/s stays ~flat 1 -> 8 HERE;
+    the curve's job is membership-plane evidence (every world boots,
+    partitions N ways, and exits epoch-0 clean), not a scaling claim.
+    At N=8 the PR-12 fleet gates are evaluated on the merged timeline
+    and reported in the cell (`gates_pass`), which is the ISSUE 16
+    acceptance hook: skew and wire imbalance inside budget at 8 ranks.
+    """
+    import tempfile
+
+    from swiftmpi_tpu import launch as smtpu_launch
+    from swiftmpi_tpu.obs.collector import FleetCollector
+
+    repo = os.path.dirname(os.path.abspath(__file__))
+    child = os.path.join(repo, "scripts", "_fleet_child.py")
+    # elastic child knobs ride on env (launch._child_env passes through)
+    saved = {k: os.environ.get(k) for k in
+             ("SMTPU_FAULT_PLAN", "SMTPU_ELASTIC", "SMTPU_FLEET_STEPS",
+              "SMTPU_FLEET_STEP_S", "SMTPU_FLEET_HB_S")}
+    os.environ.pop("SMTPU_FAULT_PLAN", None)
+    os.environ["SMTPU_ELASTIC"] = "1"
+    os.environ["SMTPU_FLEET_STEPS"] = str(steps)
+    # sleep-dominated steps: 8 sleeping procs don't contend for the
+    # single core, so per-step wall stays ~step_s on every rank and the
+    # skew gate measures the membership plane, not timeslice noise
+    os.environ["SMTPU_FLEET_STEP_S"] = "0.05"
+    os.environ["SMTPU_FLEET_HB_S"] = "0.25"
+    row_bytes = 4 + 8 * 4          # key + dim=8 f32 (child default)
+    curve = []
+    gates = {}
+    try:
+        for n in (1, 2, 4, 8):
+            fleet_dir = tempfile.mkdtemp(prefix=f"bench_fleet8_n{n}_")
+            t0 = time.perf_counter()
+            rc = smtpu_launch.supervise_elastic(
+                [sys.executable, child], n, fleet_dir=fleet_dir,
+                max_restarts=0, join_timeout_s=30.0)
+            wall = time.perf_counter() - t0
+            if rc != 0:
+                raise RuntimeError(
+                    f"elastic world np={n} exited rc={rc}")
+            fc = FleetCollector(fleet_dir)
+            fc.poll(final=True)
+            s = fc.summary()
+            wire = sum((s.get("wire_bytes") or {}).values())
+            curve.append({
+                "procs": n, "wall_s": round(wall, 3),
+                "words_per_sec": wire / row_bytes / wall,
+                "wire_bytes": int(wire),
+                "fleet_epoch": s.get("fleet_epoch", 0),
+                "step_ms_skew_pct": s.get("fleet_step_ms_skew_pct"),
+                "wire_imbalance": s.get("fleet_wire_bytes_imbalance"),
+            })
+            if n == 8:
+                # the PR-12 advisory budgets (check_traffic_budget.py
+                # ABS_NOISE_FLOOR), evaluated at full width
+                skew = float(s.get("fleet_step_ms_skew_pct", 0.0))
+                imb = float(s.get("fleet_wire_bytes_imbalance", 0.0))
+                gates = {"step_ms_skew_pct": skew,
+                         "wire_bytes_imbalance": imb,
+                         "skew_budget_pct": 15.0,
+                         "imbalance_budget": 0.2,
+                         "gates_pass": bool(skew <= 15.0
+                                            and imb <= 0.2)}
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {"steps": steps, "curve": curve,
+            # headline field: aggregate trained-rows/s at full width
+            "words_per_sec": curve[-1]["words_per_sec"],
+            "host_cores": os.cpu_count(), **gates}
+
+
 def child_main(which: str) -> None:
     import jax
 
@@ -1977,6 +2060,16 @@ def child_main(which: str) -> None:
         # against the pre-staged scale cells (different timed surface)
         out["w2v_1m_pipeline"] = _bench_w2v_1m_pipeline(
             device, max(timed // 2, 1))
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
+    if os.environ.get("BENCH_ONLY") == "w2v_fleet8":
+        # elastic scaling cell (ISSUE 16): membership-plane worlds at
+        # N in {1,2,4,8}, PR-12 gates at N=8 — pure subprocess
+        # orchestration, no device work, own child like the other
+        # multi-process cells
+        out["w2v_fleet8"] = _bench_w2v_fleet8(
+            int(os.environ.get("BENCH_FLEET8_STEPS", "40")))
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
@@ -2381,6 +2474,7 @@ _SECONDARY_CELLS = (
     ("w2v_1m_qwire", "w2v_1m_qwire", "words_per_sec", "words/s"),
     ("w2v_1m_pipeline", "w2v_1m_pipeline", "words_per_sec", "words/s"),
     ("w2v_1m_fused", "w2v_1m_fused", "words_per_sec", "words/s"),
+    ("w2v_fleet8", "w2v_fleet8", "words_per_sec", "words/s"),
     ("w2v_text8_epoch_wall", "w2v_text8", "epoch_wall_s", "s"),
     ("w2v_100m_epoch_wall", "w2v_100m", "epoch_wall_s", "s"),
     ("transformer_lm", "tfm", "tokens_per_sec", "tokens/s"),

@@ -34,6 +34,21 @@ rank-skewed exchange), so the flight-recorder drill — rank 0 drops a
 ``trace_r<rank>_p<pid>.jsonl`` dump in the fleet dir — runs end to end
 without any real transfer backend.
 
+``SMTPU_ELASTIC=1`` switches the step loop to an
+:class:`~swiftmpi_tpu.cluster.elastic.ElasticWorker` under
+``launch.py -elastic 1``'s member table (ISSUE 16): the child boots
+into the published membership, syncs it at the top of every step (the
+safe point — adoptions, two-phase rejoins, and rollbacks all land
+here), trains its owned rows, and publishes ``elastic/epoch`` /
+``elastic/loss`` / ``elastic/rows_owned`` gauges plus
+``elastic/migration_bytes`` and modeled ``transfer/wire_bytes``
+counters, so the FleetCollector's epoch/reconvergence/imbalance view
+works off the ordinary telemetry streams.  ``SMTPU_ELASTIC_SHARDS`` /
+``_ROWS`` / ``_DIM`` / ``_DUMP_EVERY`` size the workload; a rank
+evicted by a rollback re-enters through ``boot()``.  Prints
+``ELASTIC_CHILD_OK rank=<r> steps=<n> epoch=<e> loss=<l>`` on a clean
+finish; a stale-epoch rejection exits rc 3 (loud, never silent).
+
 Prints ``FLEET_CHILD_OK rank=<r> steps=<n>`` on a clean finish.
 """
 
@@ -51,6 +66,67 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(
 from swiftmpi_tpu import obs                          # noqa: E402
 from swiftmpi_tpu.testing import faults              # noqa: E402
 from swiftmpi_tpu.utils.config import ConfigParser   # noqa: E402
+
+
+def elastic_main(rec, reg, rank: int, steps: int, step_s: float,
+                 fleet_dir: str) -> int:
+    """Elastic step loop: ElasticWorker under the supervisor-owned
+    member table (see module docstring)."""
+    from swiftmpi_tpu.cluster.bootstrap import ENV_NUM_PROCESSES
+    from swiftmpi_tpu.cluster.elastic import ElasticWorker
+    from swiftmpi_tpu.cluster.membership import StaleEpochError
+
+    world = int(os.environ.get(ENV_NUM_PROCESSES, "1"))
+    worker = ElasticWorker(
+        rank, fleet_dir, world_size=world,
+        n_shards=int(os.environ.get("SMTPU_ELASTIC_SHARDS",
+                                    str(4 * world))),
+        rows_per_shard=int(os.environ.get("SMTPU_ELASTIC_ROWS", "32")),
+        dim=int(os.environ.get("SMTPU_ELASTIC_DIM", "8")),
+        dump_every=int(os.environ.get("SMTPU_ELASTIC_DUMP_EVERY", "5")))
+    join_timeout = float(os.environ.get("SMTPU_ELASTIC_JOIN_TIMEOUT_S",
+                                        "30"))
+    row_bytes = 4 + worker.dim * 4
+    booked_mig = 0
+    loss = 0.0
+    try:
+        if not worker.boot(timeout_s=join_timeout):
+            print(f"elastic_child: rank {rank} never admitted within "
+                  f"{join_timeout}s", file=sys.stderr)
+            return 4
+        for step in range(steps):
+            faults.step_event(step)       # kill/hang drills fire here
+            events = worker.sync()        # the safe point
+            if any(e.get("kind") == "evicted" for e in events):
+                if not worker.boot(timeout_s=join_timeout):
+                    print(f"elastic_child: rank {rank} evicted and "
+                          "never re-admitted", file=sys.stderr)
+                    return 4
+            with obs.span("dispatch"):
+                loss = worker.step()
+                time.sleep(step_s)
+            reg.gauge("elastic/epoch").set(float(worker.epoch))
+            reg.gauge("elastic/loss").set(float(loss))
+            reg.gauge("elastic/rows_owned").set(float(len(worker.rows)))
+            if worker.migration_bytes > booked_mig:
+                reg.counter("elastic/migration_bytes").inc(
+                    worker.migration_bytes - booked_mig)
+                booked_mig = worker.migration_bytes
+            # modeled per-step training wire: owned rows x sparse row
+            # bytes — what feeds the fleet_wire_bytes_imbalance gate
+            reg.counter("transfer/wire_bytes", backend="elastic").inc(
+                len(worker.rows) * row_bytes)
+            reg.counter("transfer/dispatches", backend="elastic").inc(1)
+            obs.record_step(1)
+    except StaleEpochError as e:
+        print(f"elastic_child: STALE EPOCH on rank {rank}: {e}",
+              file=sys.stderr)
+        return 3
+    worker.write_census()
+    rec.close()
+    print(f"ELASTIC_CHILD_OK rank={rank} steps={steps} "
+          f"epoch={worker.epoch} loss={loss:.6f}")
+    return 0
 
 
 def main() -> int:
@@ -75,6 +151,9 @@ def main() -> int:
         return 2
     rank = obs.process_rank() or 0
     reg = obs.get_registry()
+
+    if os.environ.get("SMTPU_ELASTIC", "0") not in ("", "0"):
+        return elastic_main(rec, reg, rank, steps, step_s, fleet_dir)
 
     tr = obs.get_tracer()
     if tr is not None:

@@ -65,6 +65,8 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "steps_to_reconverge", "recompiles", "hot_k",
                   "straggler_rank", "members_dead", "unnoticed_deaths",
                   "fleet_restarts", "aligned_steps",
+                  "fleet_epoch", "fleet_reconverge_steps",
+                  "migration_bytes",
                   "numerics_anomalies", "numerics_critical",
                   "numerics_nonfinite", "cross_rank_anomalies",
                   "retraces", "compile_ms", "peak_hbm_bytes")
@@ -243,6 +245,16 @@ def load_fleet_cells(path: str) -> dict:
     }
     if s.get("straggler_rank") is not None:
         cell["straggler_rank"] = s["straggler_rank"]
+    if s.get("fleet_epoch") is not None:
+        # elastic membership plane (ISSUE 16): how far the epoch moved,
+        # how long the fleet took to agree on the final membership, and
+        # what the migrations cost in modeled delta bytes — advisory
+        # context next to the skew/imbalance gates
+        cell["fleet_epoch"] = int(s["fleet_epoch"])
+        if s.get("fleet_reconverge_steps") is not None:
+            cell["fleet_reconverge_steps"] = int(
+                s["fleet_reconverge_steps"])
+        cell["migration_bytes"] = int(s.get("migration_bytes", 0))
     if s.get("numerics_anomaly_total") is not None:
         cell["numerics_anomalies"] = int(s["numerics_anomaly_total"])
         cell["numerics_critical"] = int(

@@ -19,6 +19,16 @@ drops a ``trace_trigger.json`` mid-run, and the smoke checks that every
 rank left a trigger dump that ``telemetry_report.py --trace`` parses
 and that the merged timeline correlates same-id windows across ranks.
 
+``--elastic`` runs the ISSUE 16 chaos drill instead: elastic children
+under ``supervise_elastic`` (per-rank failure domains), SIGKILL of
+``--kill-rank`` (default 2) mid-run via the fault bus, then asserts
+the elastic story from the merged evidence — the epoch bumped (death
+repartition + two-phase rejoin) and appears in the supervisor
+timeline, the kill is attributed (an organic non-zero exit event, NOT
+``by_supervisor``), zero unnoticed deaths, every rank reached a clean
+final exit, and the fleet reconverged on the final epoch
+(``fleet_reconverge_steps`` is not None).
+
 Capability-probed: containers that cannot spawn subprocesses (or where
 the launcher cannot run) print ``FLEET_SMOKE SKIP: <reason>`` and exit
 0, the same convention as the multiprocess pytest markers — CI treats
@@ -64,6 +74,89 @@ def _probe(timeout_s: float = 60.0) -> str:
     return ""
 
 
+def run_elastic(args) -> int:
+    """The ISSUE 16 worker-kill chaos drill (see module docstring)."""
+    fleet_dir = os.path.abspath(args.out)
+    os.makedirs(fleet_dir, exist_ok=True)
+    kill_step = max(args.steps // 4, 2)
+    marker = os.path.join(fleet_dir, "kill_marker")
+    plan = FaultPlan().kill_rank(args.kill_rank, at_step=kill_step,
+                                 marker=marker)
+    os.environ["SMTPU_FAULT_PLAN"] = plan.to_json()
+    os.environ["SMTPU_FLEET_STEPS"] = str(args.steps)
+    os.environ["SMTPU_FLEET_STEP_S"] = str(args.step_s)
+    os.environ["SMTPU_FLEET_HB_S"] = "0.25"
+    os.environ["SMTPU_ELASTIC"] = "1"
+    os.environ["SMTPU_ELASTIC_DUMP_EVERY"] = "3"
+    t0 = time.time()
+    rc = smtpu_launch.supervise_elastic(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "_fleet_child.py")],
+        nprocs=args.np, fleet_dir=fleet_dir, max_restarts=3,
+        backoff_s=0.2, join_timeout_s=30.0)
+    elapsed = time.time() - t0
+    if rc != 0:
+        print(f"FLEET_SMOKE FAIL: elastic world exited rc={rc}")
+        return 1
+
+    fc = FleetCollector(fleet_dir, stall_after_s=args.stall_after,
+                        dead_after_s=4 * args.stall_after)
+    fc.poll(final=True)
+    timeline = fc.write_timeline()
+    s = fc.summary()
+    failures = []
+    killed = str(args.kill_rank)
+    if s.get("fleet_epoch", 0) < 1:
+        failures.append(f"no epoch bump after the kill "
+                        f"(fleet_epoch={s.get('fleet_epoch')})")
+    epoch_events = [e for e in fc.supervisor_events
+                    if e.get("kind") == "epoch"]
+    if len(epoch_events) < 2:
+        failures.append(f"supervisor timeline carries "
+                        f"{len(epoch_events)} epoch event(s); expected "
+                        "init + death repartition at least")
+    if not any(str(e.get("reason", "")).startswith("commit")
+               for e in epoch_events):
+        failures.append("the killed rank's rejoin never committed — "
+                        "the two-phase handback did not complete "
+                        "before the world ended (drill too short?)")
+    organic = [e for e in fc.supervisor_events
+               if e.get("kind") == "exit"
+               and str(e.get("rank")) == killed
+               and e.get("rc") not in (0, None)
+               and not e.get("by_supervisor")]
+    if not organic:
+        failures.append(f"kill of rank {killed} not attributed as an "
+                        "organic exit in the supervisor evidence")
+    if s["unnoticed_deaths"]:
+        failures.append(f"unnoticed deaths: {s['unnoticed_deaths']}")
+    bad_health = {k: v for k, v in s["health"].items() if v != "exited"}
+    if bad_health:
+        failures.append(f"members not cleanly exited: {bad_health}")
+    if s.get("fleet_reconverge_steps") is None:
+        failures.append("fleet never reconverged on the final epoch "
+                        "(a live member lags, or no epochs published)")
+    if not s.get("migration_bytes"):
+        failures.append("repartition happened but migration_bytes is "
+                        "zero — deltas were not booked")
+    if args.json:
+        json.dump(s, sys.stdout, indent=2, default=str)
+        print()
+    else:
+        print(f"elastic smoke: {args.np} ranks x {args.steps} steps in "
+              f"{elapsed:.1f}s -> {timeline}")
+        print(f"  fleet_epoch={s.get('fleet_epoch')}  "
+              f"reconverge_steps={s.get('fleet_reconverge_steps')}  "
+              f"migration_bytes={s.get('migration_bytes')}  "
+              f"restarts={s.get('restarts')}  health={s['health']}")
+    if failures:
+        for f in failures:
+            print(f"FLEET_SMOKE FAIL: {f}")
+        return 1
+    print("FLEET_SMOKE OK")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="4-process fleet smoke")
     ap.add_argument("--out", default="runs/fleet_smoke",
@@ -89,6 +182,14 @@ def main(argv=None) -> int:
                          "leave a parseable flight-recorder dump and "
                          "the merged timeline must correlate windows "
                          "across ranks")
+    ap.add_argument("--elastic", action="store_true",
+                    help="run the ISSUE 16 chaos drill instead: "
+                         "elastic children under supervise_elastic, "
+                         "SIGKILL of --kill-rank mid-run, assert epoch "
+                         "bump + reconvergence + kill attribution in "
+                         "the merged timeline")
+    ap.add_argument("--kill-rank", type=int, default=2,
+                    help="rank the --elastic drill kills (default 2)")
     ap.add_argument("--json", action="store_true",
                     help="dump the fleet summary as JSON")
     args = ap.parse_args(argv)
@@ -97,6 +198,8 @@ def main(argv=None) -> int:
     if reason:
         print(f"FLEET_SMOKE SKIP: {reason}")
         return 0
+    if args.elastic:
+        return run_elastic(args)
 
     fleet_dir = os.path.abspath(args.out)
     os.makedirs(fleet_dir, exist_ok=True)

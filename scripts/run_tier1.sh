@@ -111,6 +111,23 @@ if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.p
 else
   echo "trace smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
 fi
+# Advisory elastic chaos drill (ISSUE 16): a 4-process elastic world
+# under launch.py -elastic 1 — rank 2 is SIGKILLed mid-run, survivors
+# repartition its rows at the next safe point (epoch 1, death), the
+# supervisor restarts it and re-admits it through the two-phase rejoin
+# (epoch 2, commit).  fleet_smoke.py --elastic checks the kill was
+# attributed (organic exit, never unnoticed), the epoch advanced, a
+# commit landed, migration bytes were booked, and every rank ended the
+# drill on the final epoch (fleet_reconverge_steps is finite).
+EL_OUT="$REPO_DIR/runs/elastic_smoke_$(date +%Y%m%d_%H%M%S)"
+echo "--- elastic smoke (advisory) ---"
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.py" --out "$EL_OUT" --elastic; then
+  if [ -r "$EL_OUT/fleet.jsonl" ]; then
+    python "$(dirname "$0")/telemetry_report.py" --fleet "$EL_OUT/fleet.jsonl" || echo "elastic fleet report ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
+else
+  echo "elastic smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
+fi
 # Advisory calibration staleness check: verdicts recorded under another
 # jaxlib/libtpu stack no longer steer data-plane gates — say so next to
 # the verdict (exit code unchanged; the CLI always exits 0).

@@ -146,6 +146,12 @@ def frame(fc: FleetCollector) -> dict:
             "last_window_age_s": (
                 max((m["last_seen"] or 0.0) - lw["t_abs"], 0.0)
                 if lw else None),
+            # elastic membership (ISSUE 16): the member's last-published
+            # elastic/epoch gauge — a rank rendering an older EPOCH than
+            # the fleet's is still catching up on a repartition (or is
+            # the restarted rank mid-rejoin)
+            "epoch": (lambda eps: eps[max(eps)] if eps else None)(
+                fc._member_epochs(m)),
             "restarts": m["restarts"],
             "heartbeats": m["heartbeats"],
             "stalls": len(fc.stall_episodes(m)),
@@ -168,7 +174,7 @@ def render(fr: dict) -> str:
         f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}",
         f"{'RANK':<6}{'PID':>8}{'HEALTH':>9}{'STEP':>7}{'ST/S':>8}"
         f"{'P50MS':>8}{'P95MS':>8}{'WIRE':>12}{'GNORM':>9}{'HB':>5}"
-        f"{'RST':>4}{'RTRC':>5}{'WIN':>10}  FMT-MIX / FLAGS",
+        f"{'RST':>4}{'RTRC':>5}{'EP':>4}{'WIN':>10}  FMT-MIX / FLAGS",
     ]
     for r in fr["members"]:
         mix = ",".join(f"{k}:{v}" for k, v in sorted(r["fmt_mix"].items()))
@@ -194,7 +200,9 @@ def render(fr: dict) -> str:
             f"{r['step_ms_p95']:>8.1f}{r['wire_bytes']:>12,.0f}"
             f"{gnorm}"
             f"{r['heartbeats']:>5}{r['restarts']:>4}"
-            f"{r.get('retraces', 0):>5}{win:>10}  "
+            f"{r.get('retraces', 0):>5}"
+            f"{int(r['epoch']) if r.get('epoch') is not None else '-':>4}"
+            f"{win:>10}  "
             f"{mix or '-'}"
             + (("  " + " ".join(flags)) if flags else ""))
     if s["unnoticed_deaths"]:
@@ -202,6 +210,13 @@ def render(fr: dict) -> str:
     if s["straggler_rank"] is not None:
         lines.append(f"straggler: rank {s['straggler_rank']} "
                      f"({s['straggler_score']:.2f}x median step time)")
+    if s.get("fleet_epoch") is not None:
+        rec = s.get("fleet_reconverge_steps")
+        lines.append(
+            f"elastic: epoch {s['fleet_epoch']}, reconverged in "
+            + (f"{rec} steps" if rec is not None
+               else f"NOT YET (laggards: {s.get('laggards')})")
+            + f", migration {s.get('migration_bytes', 0):,} B")
     if s.get("numerics_anomaly_total"):
         lines.append(
             f"numerics: {s['numerics_anomaly_total']} anomalies "

@@ -782,6 +782,77 @@ class LockGuard(Rule):
 
 
 # ---------------------------------------------------------------------------
+# EPOCH-GUARD
+
+_EPOCH_GUARD_RE = re.compile(r"#\s*epoch-guard:")
+_EPOCH_MUTATORS = ("write_membership", "adopt_owner_map")
+_EPOCH_FIELDS = {"owner_of_shard", "shard_owner", "member_table",
+                 "membership_epoch", "_membership_epoch",
+                 "live_ranks", "_live_ranks"}
+
+
+class EpochGuard(Rule):
+    """Elastic-membership state (cluster/membership.py) moves only
+    forward: epochs never regress, and every adoption of a new owner
+    map must validate the advance (raise ``StaleEpochError`` on
+    regression) before publishing.  Any function that rebinds
+    membership state — calls :func:`write_membership` /
+    ``adopt_owner_map``, or assigns an epoch/owner/live-set field —
+    must carry a ``# epoch-guard: <how the advance is validated>``
+    annotation on the validation line, so the invariant is stated at
+    every mutation site and un-guarded writes stand out in review."""
+
+    id = "EPOCH-GUARD"
+    description = "membership state mutated without an epoch-guard note"
+
+    def check(self, f, ctx):
+        for fn in ast.walk(f.tree):
+            if not isinstance(fn, (ast.FunctionDef,
+                                   ast.AsyncFunctionDef)):
+                continue
+            if fn.name == "write_membership":
+                continue        # the guarded choke point itself
+            if fn.name == "__init__":
+                continue        # pre-publication init (no epoch yet),
+                # same happens-before reasoning as LOCK-GUARD
+            trigger = self._trigger(fn)
+            if trigger is None or self._annotated(f, fn):
+                continue
+            yield self.finding(
+                f, trigger,
+                f"function `{fn.name}` mutates elastic-membership "
+                "state without a `# epoch-guard:` annotation — state "
+                "how the epoch advance is validated (StaleEpochError "
+                "on regression) at the mutation site")
+
+    @staticmethod
+    def _trigger(fn) -> Optional[ast.AST]:
+        """First membership mutation inside ``fn``, or None."""
+        for node in ast.walk(fn):
+            if isinstance(node, ast.Call):
+                chain = attr_chain(node.func)
+                if chain and chain.split(".")[-1] in _EPOCH_MUTATORS:
+                    return node
+            elif isinstance(node, (ast.Assign, ast.AugAssign,
+                                   ast.AnnAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    for c in _target_chains(t):
+                        if c.split(".")[-1] in _EPOCH_FIELDS:
+                            return node
+        return None
+
+    @staticmethod
+    def _annotated(f, fn) -> bool:
+        end = getattr(fn, "end_lineno", None) or len(f.lines)
+        for line in f.lines[fn.lineno - 1:end]:
+            if _EPOCH_GUARD_RE.search(line):
+                return True
+        return False
+
+
+# ---------------------------------------------------------------------------
 # KNOB-DOC
 
 _CONFIG_RECEIVERS = ("config", "conf", "cfg", "_config")
@@ -842,4 +913,4 @@ class KnobDoc(Rule):
 
 RULES = (DonateEscape(), ReaderPureHost(), ProducerNoRng(),
          ProducerNoDevice(), LedgerMonotonic(), TelemetryCatalog(),
-         LockGuard(), KnobDoc())
+         LockGuard(), EpochGuard(), KnobDoc())

@@ -20,7 +20,17 @@ __all__ = [
     "build_mesh", "mesh_info", "ps_mesh", "replicated", "row_sharded",
     "HashFrag", "Cluster", "barrier", "init_distributed", "process_count",
     "process_index", "shutdown_distributed",
+    "MemberTable", "StaleEpochError", "ElasticWorker",
 ]
+
+_ELASTIC_NAMES = {
+    # elastic membership plane (ISSUE 16); lazy like Cluster so the
+    # mesh/hashfrag primitives stay dependency-light
+    "MemberTable": ("swiftmpi_tpu.cluster.membership", "MemberTable"),
+    "StaleEpochError": ("swiftmpi_tpu.cluster.membership",
+                        "StaleEpochError"),
+    "ElasticWorker": ("swiftmpi_tpu.cluster.elastic", "ElasticWorker"),
+}
 
 
 def __getattr__(name):
@@ -29,4 +39,8 @@ def __getattr__(name):
     if name == "Cluster":
         from swiftmpi_tpu.cluster.cluster import Cluster
         return Cluster
+    if name in _ELASTIC_NAMES:
+        import importlib
+        modname, attr = _ELASTIC_NAMES[name]
+        return getattr(importlib.import_module(modname), attr)
     raise AttributeError(name)

@@ -1,0 +1,507 @@
+"""Elastic worker: the per-rank data plane of the elastic world
+(ISSUE 16).
+
+:class:`ElasticWorker` is what a rank *does* with the member table that
+:mod:`swiftmpi_tpu.cluster.membership` publishes: it owns the rows of
+its shards, trains them, and moves them across process boundaries when
+the epoch advances.  ``jax.distributed`` cannot change membership
+mid-run (the global device set is fixed at init), so the elastic data
+plane deliberately rides the fleet directory instead — faithful to
+SwiftMPI's *asynchronous* parameter-server model, where workers never
+lockstep and staleness is bounded, not zero:
+
+* **Dumps** (:meth:`ElasticWorker.maybe_dump`): every ``dump_every``
+  steps a rank publishes its rows as ONE encoded delta
+  (``rows_r<rank>.npz``).  This is the survivors' adoption source when
+  the rank dies — the staleness envelope is exactly the dump cadence
+  plus the delta encoding's quantization error (both documented in
+  docs/ARCHITECTURE.md "Elastic membership").
+* **Deltas** ship in the PR-10 wire formats: :func:`encode_delta`
+  prices sparse / bitmap / sparse_q through the same
+  :func:`~swiftmpi_tpu.parameter.key_index.price_window_formats`
+  crossover the window push uses, so migration traffic obeys the same
+  byte model as training traffic and lands in the same advisory gates
+  (``migration_bytes`` in check_traffic_budget.py).
+* **Two-phase rejoin**: on a ``prepare`` epoch a move source exports
+  fresh deltas (``mig_e<epoch>_r<dst>.npz``) and acks — keeping its
+  rows; only the ``committed`` twin makes sources drop and the
+  rejoiner import.  A source death mid-prepare rolls the epoch back
+  and strands nothing (tests/test_elastic.py pins the row census).
+* **Failure detection**, worker half: :func:`elastic_barrier` is a
+  file barrier with a timeout — a peer that never stamps is reported
+  to the caller (the supervisor's FleetCollector health view is the
+  other half).  Stale participation is always loud:
+  :exc:`~swiftmpi_tpu.cluster.membership.StaleEpochError`.
+
+The training workload is a deterministic per-row contraction (each row
+relaxes toward a key-seeded target), so convergence — and
+RE-convergence after adopting stale rows — is measurable as a scalar
+loss without any model machinery in the chaos drills.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from swiftmpi_tpu.cluster import membership as mem
+from swiftmpi_tpu.cluster.membership import (MemberTable, StaleEpochError,
+                                             read_membership)
+from swiftmpi_tpu.control.sketch import DecayedSketch
+from swiftmpi_tpu.parameter.key_index import price_window_formats
+from swiftmpi_tpu.utils.logger import get_logger
+
+log = get_logger(__name__)
+
+
+# -- PR-10 encoded row deltas ----------------------------------------------
+
+def encode_delta(keys, values, capacity: int, quant: str = "int8",
+                 positions=None) -> Dict[str, np.ndarray]:
+    """Encode a (keys, rows) delta for the wire, choosing the format
+    with the SAME crossover pricing as the window push
+    (key_index.price_window_formats): ``sparse`` (f32 pairs, lossless),
+    ``bitmap`` (occupancy mask + packed values — only offered when the
+    caller supplies dense ``positions`` < capacity), or ``sparse_q``
+    (int8 values + per-row scale, lossy, guarded).  Returns the npz
+    payload dict; ``wire_bytes`` is the modeled encoded size booked
+    into the migration ledger."""
+    keys = np.asarray(keys, np.int64).ravel()
+    values = np.asarray(values, np.float32)
+    if len(keys):
+        values = values.reshape(len(keys), -1)
+    else:
+        # empty delta (a rank mid-rejoin owns nothing yet): keep the
+        # trailing dim if the caller shaped one, else 1 — reshape(0, -1)
+        # is ambiguous on size-0 arrays
+        values = values.reshape(
+            0, values.shape[-1] if values.ndim >= 2 else 1)
+    d = values.shape[1]
+    row_bytes = 4 + d * 4
+    quant_row_bytes = 4 + d + 4 if quant == "int8" else 4 + 2 * d
+    decision, prices = price_window_formats(
+        len(keys), int(capacity), row_bytes,
+        quant=quant if quant in ("int8", "bf16") else "off",
+        quant_row_bytes=quant_row_bytes if quant != "off" else None)
+    if decision == "bitmap" and positions is None:
+        decision = "sparse"      # no dense position space to mask over
+    if decision == "dense":
+        decision = "sparse"      # deltas never ship the whole table
+    enc: Dict[str, np.ndarray] = {
+        "format": np.array(decision), "keys": keys,
+        "capacity": np.array(int(capacity)),
+    }
+    if decision == "sparse_q":
+        scale = np.max(np.abs(values), axis=1, keepdims=True) / 127.0
+        safe = np.where(scale > 0, scale, 1.0)
+        q = np.clip(np.round(values / safe), -127, 127).astype(np.int8)
+        enc["q"] = q
+        enc["scale"] = np.where(scale > 0, scale, 0.0).astype(np.float32)
+        wire = len(keys) * (4.0 + quant_row_bytes)
+    elif decision == "bitmap":
+        mask = np.zeros(int(capacity), np.bool_)
+        mask[np.asarray(positions, np.int64)] = True
+        enc["mask"] = np.packbits(mask)
+        enc["positions"] = np.asarray(positions, np.int64)
+        enc["values"] = values
+        wire = capacity / 8.0 + len(keys) * (row_bytes - 4)
+    else:
+        enc["values"] = values
+        wire = len(keys) * (4.0 + row_bytes)
+    enc["wire_bytes"] = np.array(int(round(wire)))
+    return enc
+
+
+def decode_delta(enc) -> Tuple[np.ndarray, np.ndarray]:
+    """Reconstruct ``(keys, rows_f32)`` from an :func:`encode_delta`
+    payload (an open npz or a dict).  ``sparse_q`` round-trips through
+    the int8 scale — the receiver sees exactly what the wire carried,
+    quantization error included."""
+    fmt = str(np.asarray(enc["format"]))
+    keys = np.asarray(enc["keys"], np.int64)
+    if fmt == "sparse_q":
+        values = (np.asarray(enc["q"], np.float32)
+                  * np.asarray(enc["scale"], np.float32))
+    else:
+        values = np.asarray(enc["values"], np.float32)
+    return keys, values
+
+
+def delta_wire_bytes(enc) -> int:
+    return int(np.asarray(enc["wire_bytes"]))
+
+
+def _atomic_savez(path: str, **arrays) -> None:
+    # pid-unique tmp: concurrent writers of the same target must never
+    # clobber each other's in-flight tmp (last os.replace wins whole)
+    tmp = f"{path}.{os.getpid()}.tmp"
+    with open(tmp, "wb") as f:
+        np.savez(f, **arrays)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+# -- file barrier with timeout (failure detection, worker half) ------------
+
+def elastic_barrier(dirpath: str, epoch: int, rank: int,
+                    live, timeout_s: float = 10.0,
+                    poll_s: float = 0.05) -> List[int]:
+    """Epoch-stamped file barrier: stamp, then wait for every rank in
+    ``live``.  Returns the ranks that never stamped within
+    ``timeout_s`` — an EMPTY list means the barrier passed.  This is
+    the data plane's collective-timeout half of dead-peer detection;
+    the caller reports stragglers instead of hanging forever on them
+    (the reference's poisoned-barrier failure mode, SURVEY.md §5)."""
+    def path(r: int) -> str:
+        return os.path.join(dirpath, f"barrier_e{epoch}_r{r}")
+
+    with open(path(rank), "w"):
+        pass
+    deadline = time.monotonic() + timeout_s
+    waiting = [r for r in live if r != rank]
+    while waiting and time.monotonic() < deadline:
+        waiting = [r for r in waiting if not os.path.exists(path(r))]
+        if waiting:
+            time.sleep(poll_s)
+    return waiting
+
+
+class ElasticWorker:
+    """One rank's shard-owning trainer under elastic membership.
+
+    Rows live per key in host memory; shard routing is the member
+    table's ``owner_of_shard``.  The synthetic key space is dense per
+    shard (``key = shard + i * n_shards``), so ``key // n_shards`` is a
+    valid bitmap position — all three PR-10 sparse formats stay in
+    play for the deltas.  Per-shard touch loads fold through a
+    :class:`~swiftmpi_tpu.control.sketch.DecayedSketch` — the Parallax
+    placement signal published for the Controller-driven supervisor.
+    """
+
+    def __init__(self, rank: int, fleet_dir: str, *, world_size: int,
+                 n_shards: int, rows_per_shard: int = 32, dim: int = 8,
+                 lr: float = 0.25, quant: str = "int8",
+                 dump_every: int = 5, sketch_decay: float = 0.9):
+        self.rank = int(rank)
+        self.dir = fleet_dir
+        self.world_size = int(world_size)
+        self.n_shards = int(n_shards)
+        self.rows_per_shard = int(rows_per_shard)
+        self.dim = int(dim)
+        self.lr = float(lr)
+        self.quant = quant
+        self.dump_every = max(int(dump_every), 1)
+        self.capacity = self.n_shards * self.rows_per_shard
+        self.rows: Dict[int, np.ndarray] = {}       # key -> (dim,) f32
+        self.sketch = DecayedSketch(self.n_shards, decay=sketch_decay)
+        self.member_table: Optional[MemberTable] = None
+        self.epoch = -1
+        self.step_count = 0
+        self.migration_bytes = 0     # modeled encoded delta traffic
+        self.moves_applied = 0
+        self.events: List[dict] = []  # sync decisions, for the child log
+
+    # -- deterministic workload -------------------------------------------
+    def target(self, key: int) -> np.ndarray:
+        """Key-seeded unit-scale target the row relaxes toward; same on
+        every rank, so an adopted row keeps converging to the same
+        answer its dead owner was chasing."""
+        phase = (np.arange(self.dim, dtype=np.float64) + 1.0) \
+            * (float(key) * 0.6180339887498949 % 37.0 + 1.0)
+        return np.sin(phase).astype(np.float32)
+
+    def keys_of_shard(self, shard: int) -> List[int]:
+        return [shard + i * self.n_shards
+                for i in range(self.rows_per_shard)]
+
+    def shard_of(self, key: int) -> int:
+        return int(key) % self.n_shards
+
+    def owned_shards(self) -> List[int]:
+        if self.member_table is None:
+            return []
+        return self.member_table.shards_of(self.rank)
+
+    def owned_keys(self) -> List[int]:
+        return sorted(self.rows)
+
+    def loss(self) -> float:
+        if not self.rows:
+            return 0.0
+        return float(np.mean([np.mean((self.target(k) - v) ** 2)
+                              for k, v in self.rows.items()]))
+
+    def step(self) -> float:
+        """One training step over every owned row (the async-PS model:
+        local progress between membership syncs).  Returns the loss
+        BEFORE the update, folds the touch counts into the sketch, and
+        handles the periodic dump + load publication."""
+        pre = self.loss()
+        for k in self.rows:
+            t = self.target(k)
+            self.rows[k] += self.lr * (t - self.rows[k])
+        shards = self.owned_shards()
+        if shards:
+            self.sketch.observe(np.repeat(np.asarray(shards, np.int64),
+                                          self.rows_per_shard))
+        self.step_count += 1
+        if self.step_count % self.dump_every == 0:
+            self.maybe_dump()
+            self.publish_load()
+        return pre
+
+    # -- dumps, loads, census ---------------------------------------------
+    def dump_path(self, rank: Optional[int] = None) -> str:
+        return os.path.join(self.dir,
+                            f"rows_r{self.rank if rank is None else rank}"
+                            ".npz")
+
+    def maybe_dump(self) -> str:
+        """Publish every owned row as ONE encoded delta, epoch-stamped.
+        The dump is both the resume state of a restarted rank and the
+        adoption source when this rank dies — its cadence IS the
+        staleness envelope."""
+        keys = np.asarray(self.owned_keys(), np.int64)
+        vals = (np.stack([self.rows[int(k)] for k in keys])
+                if len(keys) else np.zeros((0, self.dim), np.float32))
+        # the synthetic key space is dense in [0, capacity), so keys
+        # double as bitmap positions
+        enc = encode_delta(keys, vals, self.capacity, self.quant,
+                           positions=keys if len(keys) else None)
+        enc["epoch"] = np.array(int(self.epoch))
+        enc["step"] = np.array(int(self.step_count))
+        path = self.dump_path()
+        _atomic_savez(path, **enc)
+        return path
+
+    def publish_load(self) -> None:
+        loads = self.sketch.fold()
+        mem.publish_load(self.dir, self.rank,
+                         {s: float(loads[s]) for s in range(self.n_shards)
+                          if loads[s] > 0})
+
+    def write_census(self) -> str:
+        """Publish this rank's owned-key census (epoch-stamped) — the
+        row-census invariant's evidence: after reconvergence every
+        stamped key must appear in exactly one live rank's census."""
+        import json
+        path = os.path.join(self.dir, f"census_r{self.rank}.json")
+        blob = json.dumps({"epoch": int(self.epoch),
+                           "keys": self.owned_keys()})
+        tmp = path + ".tmp"
+        with open(tmp, "w") as f:
+            f.write(blob)
+        os.replace(tmp, path)
+        return path
+
+    # -- membership sync ---------------------------------------------------
+    def _seed_shard(self, shard: int) -> None:
+        for k in self.keys_of_shard(shard):
+            self.rows[k] = np.zeros(self.dim, np.float32)
+
+    def _drop_shard(self, shard: int) -> None:
+        for k in self.keys_of_shard(shard):
+            self.rows.pop(k, None)
+
+    def _import_delta(self, enc, shards) -> int:
+        """Install a decoded delta's rows for ``shards`` (only — a dump
+        may carry more than what moved).  Returns rows imported."""
+        keys, vals = decode_delta(enc)
+        want = set(int(s) for s in shards)
+        n = 0
+        for k, v in zip(keys.tolist(), vals):
+            if self.shard_of(k) in want:
+                self.rows[int(k)] = np.asarray(v, np.float32).copy()
+                n += 1
+        return n
+
+    def mig_path(self, epoch: int, src: int, dst: int) -> str:
+        # keyed by (epoch, src, dst): a rejoin's handback usually has
+        # SEVERAL sources exporting to one destination — per-source
+        # files, or the exports would overwrite each other
+        return os.path.join(self.dir, f"mig_e{epoch}_s{src}_r{dst}.npz")
+
+    def _export_moves(self, table: MemberTable) -> None:
+        """PREPARE phase, source side: export fresh rows for every
+        shard this rank is giving up, one encoded delta per
+        destination, then ack.  Rows are KEPT until the commit — the
+        all-or-nothing half of the epoch protocol."""
+        by_dst: Dict[int, List[int]] = {}
+        for s, src, dst in table.moves:
+            if src == self.rank:
+                by_dst.setdefault(dst, []).append(s)
+        for dst, shards in sorted(by_dst.items()):
+            keys = np.asarray(
+                [k for s in shards for k in self.keys_of_shard(s)
+                 if k in self.rows], np.int64)
+            vals = (np.stack([self.rows[int(k)] for k in keys])
+                    if len(keys) else np.zeros((0, self.dim), np.float32))
+            enc = encode_delta(keys, vals, self.capacity, self.quant,
+                               positions=keys if len(keys) else None)
+            enc["epoch"] = np.array(int(table.epoch))
+            _atomic_savez(self.mig_path(table.epoch, self.rank, dst),
+                          **enc)
+            self.migration_bytes += delta_wire_bytes(enc)
+        if by_dst:
+            mem.write_ack(self.dir, table.epoch, self.rank,
+                          {"dsts": sorted(by_dst)})
+
+    def _adopt_committed(self, table: MemberTable) -> None:
+        """Install a committed table: import what moved to me, drop
+        what moved away, seed what has no source (initial ownership)."""
+        mine_now = set(table.shards_of(self.rank))
+        # during a PREPARE epoch the effective owner map is still
+        # prev_owner (sources keep rows until commit), so "before" must
+        # be read from it — else a commit sees no delta to import
+        if self.member_table is None:
+            mine_before = set()
+        elif (self.member_table.state == mem.PREPARE
+              and self.member_table.prev_owner is not None):
+            mine_before = {s for s, r in enumerate(self.member_table.prev_owner)
+                           if r == self.rank}
+        else:
+            mine_before = set(self.member_table.shards_of(self.rank))
+        moved_to_me = {s: src for s, src, dst in table.moves
+                       if dst == self.rank}
+        # drop first: shards that left (commit of a prepare I sourced)
+        for s in sorted(mine_before - mine_now):
+            self._drop_shard(s)
+        gained = sorted(mine_now - mine_before)
+        # group imports by source so each delta file is read once
+        by_src: Dict[int, List[int]] = {}
+        fresh: List[int] = []
+        for s in gained:
+            src = moved_to_me.get(s)
+            if src is None:
+                fresh.append(s)
+            else:
+                by_src.setdefault(src, []).append(s)
+        for s in fresh:
+            self._seed_shard(s)
+        for src, shards in sorted(by_src.items()):
+            imported = 0
+            # rejoin commit: the source exported a fresh mig delta for
+            # me; death: adopt from the dead rank's last dump (stale by
+            # <= dump_every steps — the documented envelope)
+            for path in (self.mig_path(table.epoch, src, self.rank),
+                         self.dump_path(src)):
+                try:
+                    with np.load(path, allow_pickle=False) as z:
+                        imported = self._import_delta(z, shards)
+                        self.migration_bytes += delta_wire_bytes(z)
+                except (OSError, KeyError, ValueError):
+                    continue
+                if imported:   # a readable but irrelevant delta (zero
+                    break      # rows for these shards) falls through
+                               # to the next source
+            if not imported:
+                # no delta survived (rank died before its first dump):
+                # seed from scratch — rows re-learn, loudly logged
+                log.warning("rank %d: no delta for shards %s from r%d; "
+                            "seeding fresh", self.rank, shards, src)
+                for s in shards:
+                    self._seed_shard(s)
+            else:
+                for s in shards:        # fill rows the delta missed
+                    for k in self.keys_of_shard(s):
+                        self.rows.setdefault(
+                            k, np.zeros(self.dim, np.float32))
+            self.moves_applied += len(shards)
+
+    def sync(self) -> List[dict]:
+        """Adopt the currently published member table — called at the
+        top of every step (the safe point).  Raises
+        :class:`StaleEpochError` if the table regressed below what this
+        worker already applied (stale participation is never silent).
+        Returns the sync decisions taken, newest last."""
+        table = read_membership(self.dir)
+        if table is None:
+            return []
+        if self.member_table is not None and table.epoch < self.member_table.epoch:
+            raise StaleEpochError(
+                f"rank {self.rank}: published epoch {table.epoch} "
+                f"regressed below adopted epoch {self.member_table.epoch}")
+        same = (self.member_table is not None
+                and table.epoch == self.member_table.epoch
+                and table.state == self.member_table.state)
+        if same:
+            return []
+        events: List[dict] = []
+        if table.state == mem.PREPARE:
+            self._export_moves(table)
+            events.append({"kind": "prepare", "epoch": table.epoch,
+                           "reason": table.reason})
+        else:
+            commit_of_mine = (self.member_table is not None
+                              and self.member_table.state == mem.PREPARE
+                              and table.epoch == self.member_table.epoch)
+            rolled_back = (table.rolled_back is not None
+                           and self.member_table is not None
+                           and self.member_table.epoch == table.rolled_back)
+            if rolled_back:
+                # prepare undone: nothing was dropped, nothing to do —
+                # exported mig files for the dead epoch are inert (the
+                # epoch stamp in their filename can never match again)
+                events.append({"kind": "rollback", "epoch": table.epoch,
+                               "undid": table.rolled_back})
+                # ownership may ALSO have changed vs prev (e.g. the
+                # rolled-back table equals prev_owner, same as ours)
+            self._adopt_committed(table)
+            events.append({"kind": "commit" if commit_of_mine
+                           else "adopt", "epoch": table.epoch,
+                           "reason": table.reason,
+                           "owned": len(table.shards_of(self.rank))})
+            if self.rank not in table.live:
+                # a rolled-back rejoin evicted this rank again — the
+                # driver loop must go back through boot()
+                events.append({"kind": "evicted", "epoch": table.epoch})
+        # epoch-guard: table.epoch advance validated above (sync raises
+        # StaleEpochError on regression before reaching here)
+        self.member_table = table
+        self.epoch = table.epoch
+        self.write_census()
+        self.events.extend(events)
+        return events
+
+    # -- boot / rejoin ------------------------------------------------------
+    def resume_epoch(self) -> int:
+        """Epoch stamp of this rank's last dump (its train_with_resume
+        moral equivalent for the drill workload): what a restarted rank
+        claims when it asks back in."""
+        try:
+            with np.load(self.dump_path(), allow_pickle=False) as z:
+                return int(np.asarray(z["epoch"]))
+        except (OSError, KeyError, ValueError):
+            return 0
+
+    def boot(self, timeout_s: float = 30.0,
+             poll_s: float = 0.05) -> bool:
+        """Join the world: adopt the table if this rank is live in it,
+        else publish a join request (stamped with the resume epoch) and
+        wait for re-admission at the supervisor's next safe point.
+        Returns False on timeout; raises :class:`StaleEpochError` when
+        the supervisor rejects the claimed epoch as stale."""
+        deadline = time.monotonic() + timeout_s
+        requested = False
+        while time.monotonic() < deadline:
+            table = read_membership(self.dir)
+            if table is not None and self.rank in table.live:
+                self.sync()
+                if requested:
+                    mem.clear_join(self.dir, self.rank)
+                return True
+            rej = mem.read_reject(self.dir, self.rank)
+            if rej is not None:
+                raise StaleEpochError(
+                    f"rank {self.rank}: join rejected — "
+                    f"{rej.get('reason')}")
+            if table is not None and not requested:
+                mem.request_join(self.dir, self.rank,
+                                 self.resume_epoch())
+                requested = True
+            time.sleep(poll_s)
+        return False

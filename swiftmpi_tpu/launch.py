@@ -28,6 +28,17 @@ Flags (reference CMDLine style, ``-key value``):
                     with ``train_with_resume`` in the child).
 * ``-backoff S``  — initial restart backoff seconds (default 1.0,
                     doubling per restart, capped at 60s).
+* ``-stable-after S`` — reset the restart-attempt budget after the
+                    world (or, elastic mode, the rank) ran S seconds
+                    before failing: ``max_restarts`` bounds crash-LOOPS,
+                    not the total organic hiccups of a long run.
+* ``-elastic 1``  — per-rank failure domains (ISSUE 16): one rank dying
+                    is repartitioned across survivors and restarted
+                    alone instead of tearing the world down.  See
+                    :func:`supervise_elastic`; requires ``-fleet-dir``.
+                    ``-shards K``, ``-join-timeout S``, ``-dead-after S``
+                    tune the member table, rejoin deadline, and
+                    hung-rank detection.
 * ``-fleet-dir D`` — arm fleet observability (ISSUE 12): children get
                     ``SMTPU_FLEET_DIR=D`` (their StepRecorder writes
                     per-rank heartbeat'd JSONL streams there, see
@@ -242,7 +253,8 @@ def supervise(argv: List[str], nprocs: int, cpu_devices: int = 0,
               max_restarts: int = 0, backoff_s: float = 1.0,
               backoff_factor: float = 2.0,
               backoff_max_s: float = 60.0,
-              fleet_dir: Optional[str] = None) -> int:
+              fleet_dir: Optional[str] = None,
+              stable_after_s: Optional[float] = None) -> int:
     """Restart-the-world supervisor around :func:`launch`.
 
     The SPMD recovery model (io/resilience.py): a failed rank cannot be
@@ -258,7 +270,13 @@ def supervise(argv: List[str], nprocs: int, cpu_devices: int = 0,
 
     With ``fleet_dir``, ONE SupervisorLog spans every attempt — restart
     events land between the attempts' spawn/exit runs, so the collector
-    sees a rank's pre- and post-restart lives as one member history."""
+    sees a rank's pre- and post-restart lives as one member history.
+
+    ``stable_after_s`` resets the restart-attempt counter after the
+    world has run that long before failing: a week-long run with an
+    occasional recoverable crash should not exhaust ``max_restarts``
+    budgeted for crash-LOOPS and give up on its Nth organic hiccup —
+    only failures in quick succession burn the budget."""
     attempt = 0
     fleet_log = None
     if fleet_dir:
@@ -268,9 +286,20 @@ def supervise(argv: List[str], nprocs: int, cpu_devices: int = 0,
                         max_restarts=max_restarts, argv=list(argv))
     try:
         while True:
+            t_start = time.monotonic()
             rc = launch(argv, nprocs, cpu_devices, port, kill_grace_s,
                         fleet_dir=fleet_dir, fleet_log=fleet_log,
                         attempt=attempt)
+            ran_s = time.monotonic() - t_start
+            if rc != 0 and attempt and stable_after_s is not None \
+                    and ran_s >= stable_after_s:
+                print(f"[launch] world was stable {ran_s:.1f}s >= "
+                      f"{stable_after_s:.1f}s; restart budget reset",
+                      file=sys.stderr)
+                if fleet_log is not None:
+                    fleet_log.event("stable_reset", ran_s=ran_s,
+                                    attempt=attempt)
+                attempt = 0
             if rc == 0:
                 if attempt:
                     print(f"[launch] world recovered after {attempt} "
@@ -301,6 +330,311 @@ def supervise(argv: List[str], nprocs: int, cpu_devices: int = 0,
             fleet_log.close()
 
 
+def _publish_epoch(fleet_dir: str, table, fleet_log, reason: str) -> None:
+    """The supervisor's ONLY membership-write path: publish a new member
+    table and put the epoch transition on the fleet timeline in the same
+    breath, so the collector can correlate every ownership change with
+    the supervisor evidence that caused it."""
+    from swiftmpi_tpu.cluster import membership as mem
+    # epoch-guard: mem.write_membership validates the epoch advance
+    # (same-epoch rewrites other than prepare->commit raise
+    # StaleEpochError) — this helper exists so every supervisor-side
+    # table write goes through that check exactly once
+    mem.write_membership(fleet_dir, table)
+    if fleet_log is not None:
+        fleet_log.event("epoch", epoch=table.epoch, state=table.state,
+                        live=list(table.live), reason=reason,
+                        moves=len(table.moves))
+
+
+def _shard_weights(fleet_dir: str, n_shards: int) -> List[float]:
+    """Fleet-wide per-shard load: sum of every rank's published
+    DecayedSketch fold (cluster.membership.publish_load); shards nobody
+    reported weigh 1.0 so placement degrades to balance-by-count."""
+    from swiftmpi_tpu.cluster import membership as mem
+    total = [0.0] * n_shards
+    for vec in mem.read_loads(fleet_dir, n_shards).values():
+        for s, v in enumerate(vec):
+            total[s] += float(v)
+    return [v if v > 0 else 1.0 for v in total]
+
+
+def _handback_shards(table, weight: List[float], k: int) -> List[int]:
+    """Pick ``k`` shards to hand back to a rejoining rank: repeatedly
+    take the heaviest shard from the currently most-loaded survivor —
+    the inverse of the death-path LPT, so a rejoin UNDOES imbalance
+    instead of adding to it."""
+    owned = {r: sorted(table.shards_of(r), key=lambda s: -weight[s])
+             for r in table.live}
+    load = {r: sum(weight[s] for s in owned[r]) for r in table.live}
+    picks: List[int] = []
+    for _ in range(max(k, 0)):
+        donors = [r for r in table.live if len(owned[r]) > 1]
+        if not donors:       # never strip a survivor's last shard
+            break
+        r = max(donors, key=lambda r: (load[r], -r))
+        s = owned[r].pop(0)
+        load[r] -= weight[s]
+        picks.append(s)
+    return picks
+
+
+def supervise_elastic(argv: List[str], nprocs: int, *, fleet_dir: str,
+                      cpu_devices: int = 0, port: int = 0,
+                      kill_grace_s: float = 5.0, max_restarts: int = 2,
+                      backoff_s: float = 0.5, backoff_factor: float = 2.0,
+                      backoff_max_s: float = 30.0,
+                      stable_after_s: Optional[float] = None,
+                      join_timeout_s: float = 20.0,
+                      n_shards: Optional[int] = None,
+                      dead_after_s: Optional[float] = None,
+                      poll_s: float = 0.1) -> int:
+    """Per-rank failure domains: the elastic alternative to
+    :func:`supervise`'s restart-the-world.
+
+    The supervisor owns the member table (cluster/membership.py) and is
+    its only writer.  One rank dying does NOT tear the world down:
+
+    1. the exit is reaped and logged (normalized rc, ``by_supervisor``);
+    2. if a two-phase rejoin was in flight, it is rolled back first
+       (``plan_death`` refuses to operate over a PREPARE table — the
+       all-or-nothing rule);
+    3. the dead rank's shards are repartitioned across survivors with
+       :func:`~swiftmpi_tpu.control.controller.plan_placement` — the
+       Controller's Parallax rule over the ranks' published
+       DecayedSketch folds — and the new COMMITTED epoch is published;
+       survivors adopt the orphans from the dead rank's last dump
+       (staleness <= its dump cadence);
+    4. the rank is restarted with per-RANK backoff (``stable_after_s``
+       resets a rank's attempt budget after a long stable run) and
+       re-admitted through the two-phase prepare/commit rejoin when its
+       join request arrives — or abandoned once its budget is spent,
+       with the world carrying on minus one failure domain.
+
+    ``dead_after_s`` arms the detection half the exit code cannot see:
+    a HUNG rank (alive, silent) is judged by FleetCollector health
+    against the wall clock and killed, which routes it into the same
+    death path.  Requires the children to heartbeat via
+    ``SMTPU_FLEET_DIR`` telemetry.
+
+    Returns 0 when every rank finished rc=0; else the first abandoned
+    rank's rc (the world ran degraded but is still reported honestly).
+    """
+    from swiftmpi_tpu.cluster import membership as mem
+    from swiftmpi_tpu.obs.collector import SupervisorLog
+
+    os.makedirs(fleet_dir, exist_ok=True)
+    n_shards = n_shards or 4 * nprocs
+    port = port or _free_port()
+    table = mem.initial_table(nprocs, n_shards)
+    fleet_log = SupervisorLog(fleet_dir)
+    fleet_log.event("world_start", nprocs=nprocs, mode="elastic",
+                    n_shards=n_shards, max_restarts=max_restarts,
+                    argv=list(argv))
+    _publish_epoch(fleet_dir, table, fleet_log, "init")
+
+    print_lock = threading.Lock()
+    procs: Dict[int, subprocess.Popen] = {}
+    threads: List[threading.Thread] = []
+    attempts: Dict[int, int] = {r: 0 for r in range(nprocs)}
+    last_start: Dict[int, float] = {}
+    restart_due: Dict[int, float] = {}
+    finished: set = set()
+    abandoned: set = set()
+    terminated: set = set()            # ranks we delivered a signal to
+    prepare_deadline: Optional[float] = None
+    last_health_poll = 0.0
+    rc_final = 0
+
+    def reader(rank: int, stream) -> None:
+        try:
+            for line in stream:
+                with print_lock:
+                    sys.stdout.write(f"[rank {rank}] {line}")
+                    sys.stdout.flush()
+        except (ValueError, OSError):
+            pass
+
+    def spawn(rank: int) -> None:
+        p = subprocess.Popen(
+            argv, env=_child_env(os.environ, port, rank, nprocs,
+                                 cpu_devices, fleet_dir),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True)
+        procs[rank] = p
+        last_start[rank] = time.monotonic()
+        fleet_log.event("spawn", rank=rank, pid=p.pid,
+                        attempt=attempts[rank])
+        t = threading.Thread(target=reader, args=(rank, p.stdout),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    def note_exit(rank: int, p, code: int) -> None:
+        fleet_log.event("exit", rank=rank, pid=p.pid,
+                        rc=_normalize_rc(code),
+                        by_supervisor=rank in terminated,
+                        attempt=attempts[rank])
+        terminated.discard(rank)
+
+    def handle_death(rank: int) -> None:
+        """Membership half of a rank failure: rollback any in-flight
+        prepare, then repartition the dead rank's shards across the
+        survivors and publish the new epoch."""
+        nonlocal table, prepare_deadline
+        if table.state == mem.PREPARE:
+            table = mem.rollback_table(
+                table, reason=f"rank {rank} died mid-prepare")
+            _publish_epoch(fleet_dir, table, fleet_log, table.reason)
+            prepare_deadline = None
+        if rank not in table.live:
+            return                   # was the rolled-back rejoiner
+        if len(table.live) == 1:
+            # last live rank: nobody to repartition onto — its restart
+            # resumes from its own dump, a world-of-one restart
+            return
+        from swiftmpi_tpu.control.controller import plan_placement
+        dead_shards = table.shards_of(rank)
+        survivors = [r for r in table.live if r != rank]
+        assign = plan_placement(dead_shards, survivors,
+                                mem.read_loads(fleet_dir, n_shards),
+                                table.owner_of_shard)
+        table = mem.plan_death(table, rank, assign)
+        _publish_epoch(fleet_dir, table, fleet_log, table.reason)
+
+    for rank in range(nprocs):
+        spawn(rank)
+    try:
+        while procs or restart_due:
+            now = time.monotonic()
+            # 1. reap exits — each a per-rank failure domain
+            for rank, p in list(procs.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                note_exit(rank, p, code)
+                del procs[rank]
+                if code == 0:
+                    finished.add(rank)
+                    continue
+                if stable_after_s is not None and attempts[rank] \
+                        and now - last_start[rank] >= stable_after_s:
+                    fleet_log.event("stable_reset", rank=rank,
+                                    ran_s=now - last_start[rank],
+                                    attempt=attempts[rank])
+                    attempts[rank] = 0
+                handle_death(rank)
+                if attempts[rank] >= max_restarts:
+                    rcn = _normalize_rc(code)
+                    print(f"[launch] rank {rank} out of restart budget "
+                          f"({max_restarts}); abandoned rc={rcn}",
+                          file=sys.stderr)
+                    fleet_log.event("rank_abandoned", rank=rank, rc=rcn)
+                    abandoned.add(rank)
+                    rc_final = rc_final or rcn
+                else:
+                    delay = min(backoff_s * (backoff_factor
+                                             ** attempts[rank]),
+                                backoff_max_s)
+                    attempts[rank] += 1
+                    fleet_log.event("restart_rank", rank=rank,
+                                    rc=_normalize_rc(code),
+                                    attempt=attempts[rank],
+                                    delay_s=delay)
+                    restart_due[rank] = now + delay
+            # 2. spawn due restarts (they re-enter via a join request)
+            for rank, due in list(restart_due.items()):
+                if now >= due:
+                    del restart_due[rank]
+                    spawn(rank)
+            # 3. drive an in-flight prepare to commit or rollback
+            if table.state == mem.PREPARE:
+                if mem.acks_complete(fleet_dir, table):
+                    table = mem.commit_table(table)
+                    _publish_epoch(fleet_dir, table, fleet_log,
+                                   "commit: " + table.reason)
+                    prepare_deadline = None
+                elif prepare_deadline is not None \
+                        and now >= prepare_deadline:
+                    table = mem.rollback_table(table,
+                                               reason="prepare timeout")
+                    _publish_epoch(fleet_dir, table, fleet_log,
+                                   table.reason)
+                    prepare_deadline = None
+            # 4. admit pending joins (only from a committed table)
+            elif table.state == mem.COMMITTED:
+                for rank, claimed in sorted(
+                        mem.pending_joins(fleet_dir).items()):
+                    if rank in table.live:
+                        continue
+                    verdict = mem.judge_join(table, rank, claimed)
+                    if verdict == "stale":
+                        mem.write_reject(
+                            fleet_dir, rank,
+                            reason=f"claimed epoch {claimed} is ahead "
+                                   f"of the world's {table.epoch}")
+                        mem.clear_join(fleet_dir, rank)
+                        fleet_log.event("join_rejected", rank=rank,
+                                        claimed=claimed,
+                                        epoch=table.epoch)
+                        continue
+                    weight = _shard_weights(fleet_dir, n_shards)
+                    share = n_shards // (len(table.live) + 1)
+                    picks = _handback_shards(table, weight, share)
+                    assign = {s: rank for s in picks}
+                    table = mem.plan_rejoin(table, rank, assign)
+                    _publish_epoch(fleet_dir, table, fleet_log,
+                                   table.reason)
+                    prepare_deadline = time.monotonic() + join_timeout_s
+                    break          # one prepare in flight at a time
+            # 5. hung-rank detection: alive but silent past dead_after_s
+            if dead_after_s and now - last_health_poll >= 1.0:
+                last_health_poll = now
+                from swiftmpi_tpu.obs.collector import FleetCollector
+                coll = FleetCollector(fleet_dir, dead_after_s=dead_after_s)
+                coll.poll()
+                for key, status in coll.health(at=time.time()).items():
+                    try:
+                        hrank = int(key.lstrip("r"))
+                    except ValueError:
+                        continue
+                    p = procs.get(hrank)
+                    if status == "dead" and p is not None \
+                            and p.poll() is None:
+                        print(f"[launch] rank {hrank} hung (silent > "
+                              f"{dead_after_s:.1f}s); killing",
+                              file=sys.stderr)
+                        fleet_log.event("hang_kill", rank=hrank,
+                                        pid=p.pid)
+                        terminated.add(hrank)
+                        p.kill()
+            time.sleep(poll_s)
+        fleet_log.event("world_exit", rc=rc_final,
+                        finished=sorted(finished),
+                        abandoned=sorted(abandoned))
+        return rc_final
+    finally:
+        for rank, p in procs.items():
+            if p.poll() is None:
+                terminated.add(rank)
+                p.kill()
+        for rank, p in procs.items():
+            try:
+                p.wait(timeout=kill_grace_s)
+            except subprocess.TimeoutExpired:
+                pass
+            note_exit(rank, p, p.poll() if p.poll() is not None else -9)
+        for t in threads:
+            t.join(timeout=2.0)
+        for rank, p in procs.items():
+            try:
+                p.stdout.close()
+            except (ValueError, OSError):
+                pass
+        for t in threads:
+            t.join(timeout=1.0)
+        fleet_log.close()
+
+
 def main(args: Optional[List[str]] = None) -> int:
     from swiftmpi_tpu.utils.cmdline import CMDLine
 
@@ -318,6 +652,22 @@ def main(args: Optional[List[str]] = None) -> int:
     cmd.registerParameter("max-restarts",
                           "restart-the-world budget on failure")
     cmd.registerParameter("backoff", "initial restart backoff seconds")
+    cmd.registerParameter("stable-after",
+                          "reset restart budget after this many stable "
+                          "seconds")
+    cmd.registerParameter("elastic",
+                          "1 = per-rank failure domains (ISSUE 16): "
+                          "restart-the-rank + cross-process "
+                          "repartition; requires -fleet-dir")
+    cmd.registerParameter("shards",
+                          "elastic member-table shard count "
+                          "(default 4*np)")
+    cmd.registerParameter("join-timeout",
+                          "elastic rejoin prepare->commit deadline "
+                          "seconds")
+    cmd.registerParameter("dead-after",
+                          "elastic hung-rank detection: kill a rank "
+                          "silent this many seconds")
     cmd.registerParameter("fleet-dir",
                           "fleet telemetry directory (ISSUE 12)")
     cmd.registerParameter("profile-at",
@@ -338,18 +688,44 @@ def main(args: Optional[List[str]] = None) -> int:
     if cmd.hasParameter("profile-steps"):
         os.environ[obs_profiler.ENV_PROFILE_STEPS] = str(
             int(cmd.get_value("profile-steps")))
+    nprocs = int(cmd.get_value("np")) if cmd.hasParameter("np") else 1
+    cpu = int(cmd.get_value("cpu")) if cmd.hasParameter("cpu") else 0
+    fleet_dir = (cmd.get_value("fleet-dir")
+                 if cmd.hasParameter("fleet-dir") else None)
+    stable_after_s = (float(cmd.get_value("stable-after"))
+                      if cmd.hasParameter("stable-after") else None)
+    if cmd.hasParameter("elastic") and int(cmd.get_value("elastic")):
+        if not fleet_dir:
+            print("launch: -elastic requires -fleet-dir (the member "
+                  "table and migration deltas live there)",
+                  file=sys.stderr)
+            return 2
+        return supervise_elastic(
+            prog, nprocs, fleet_dir=fleet_dir, cpu_devices=cpu,
+            port=int(cmd.get_value("port"))
+            if cmd.hasParameter("port") else 0,
+            max_restarts=int(cmd.get_value("max-restarts"))
+            if cmd.hasParameter("max-restarts") else 2,
+            backoff_s=float(cmd.get_value("backoff"))
+            if cmd.hasParameter("backoff") else 0.5,
+            stable_after_s=stable_after_s,
+            join_timeout_s=float(cmd.get_value("join-timeout"))
+            if cmd.hasParameter("join-timeout") else 20.0,
+            n_shards=int(cmd.get_value("shards"))
+            if cmd.hasParameter("shards") else None,
+            dead_after_s=float(cmd.get_value("dead-after"))
+            if cmd.hasParameter("dead-after") else None)
     return supervise(
         prog,
-        nprocs=int(cmd.get_value("np")) if cmd.hasParameter("np") else 1,
-        cpu_devices=int(cmd.get_value("cpu"))
-        if cmd.hasParameter("cpu") else 0,
+        nprocs=nprocs,
+        cpu_devices=cpu,
         port=int(cmd.get_value("port")) if cmd.hasParameter("port") else 0,
         max_restarts=int(cmd.get_value("max-restarts"))
         if cmd.hasParameter("max-restarts") else 0,
         backoff_s=float(cmd.get_value("backoff"))
         if cmd.hasParameter("backoff") else 1.0,
-        fleet_dir=cmd.get_value("fleet-dir")
-        if cmd.hasParameter("fleet-dir") else None)
+        fleet_dir=fleet_dir,
+        stable_after_s=stable_after_s)
 
 
 if __name__ == "__main__":

@@ -39,6 +39,7 @@ TRANSFER_KEYS = frozenset({
     "pull_bytes", "pull_rows", "pull_hot_rows",
     "routed_rows", "overflow_dropped",          # tpu routing ledger
     "hot_rows", "psum_bytes",                   # hybrid hot plane
+    "membership_changes",                       # elastic epoch adoptions
 })
 
 SERIES = frozenset({
@@ -91,6 +92,12 @@ SERIES = frozenset({
     "trace/windows", "trace/records", "trace/dumps",
     "trace/last_window_id",
     "trace/hot_key_touches", "trace/hot_key_bytes",
+    # elastic membership plane (cluster/membership.py + elastic.py,
+    # ISSUE 16): per-rank adopted epoch / workload gauges, the modeled
+    # migration-delta traffic, and the fleet-level mirrors
+    "elastic/epoch", "elastic/loss", "elastic/rows_owned",
+    "elastic/migration_bytes",
+    "fleet/epoch", "fleet/reconverge_steps", "fleet/migration_bytes",
 }) | frozenset("transfer/" + k for k in TRANSFER_KEYS)
 
 #: Dynamic-name families: an f-string series name passes the catalog

@@ -582,6 +582,64 @@ class FleetCollector:
         return {"win": win,
                 "t_abs": float(table[win].get("t_abs", 0.0))}
 
+    # -- elastic membership (cluster/membership.py, ISSUE 16) --------------
+    @staticmethod
+    def _member_epochs(member: dict) -> Dict[int, int]:
+        """step -> adopted ``elastic/epoch`` gauge for one member; later
+        lives overwrite overlapping steps, like :meth:`_per_step`."""
+        out: Dict[int, int] = {}
+        for s in member["_streams"]:
+            for r in s.records:
+                for gkey, v in (r.get("gauges") or {}).items():
+                    name, _ = parse_series_key(gkey)
+                    if name == "elastic/epoch":
+                        out[int(r["step"])] = int(v)
+        return out
+
+    def elastic_view(self, at: Optional[float] = None) -> Optional[dict]:
+        """Fleet digest of the elastic membership plane, or None when no
+        member ever published ``elastic/epoch`` (a static world).
+
+        * ``fleet_epoch`` — the highest epoch any member adopted.
+        * ``fleet_reconverge_steps`` — over the members that reached
+          ``fleet_epoch``, the spread between the first and the last
+          member's first step at it: how long the world took to agree
+          on the final membership.  None while a LIVE member still
+          hasn't caught up (reconvergence not yet provable).
+        * ``migration_bytes`` — total modeled delta traffic
+          (``elastic/migration_bytes`` counter) across all members: the
+          cost of every adoption and rejoin, priced by the same PR-10
+          byte model as training traffic.
+        """
+        at = self.now() if at is None else at
+        members = self.members()
+        epochs = {k: self._member_epochs(m) for k, m in members.items()}
+        if not any(epochs.values()):
+            return None
+        fleet_epoch = max(max(t.values()) for t in epochs.values() if t)
+        first_at = {k: min(s for s, e in t.items() if e == fleet_epoch)
+                    for k, t in epochs.items()
+                    if t and fleet_epoch in t.values()}
+        health = self.health(at)
+        laggards = [k for k, t in epochs.items()
+                    if t and k not in first_at
+                    and health.get(k) in ("live", "stalled")]
+        reconverge = (max(first_at.values()) - min(first_at.values())
+                      if first_at and not laggards else None)
+        mig = 0.0
+        for m in members.values():
+            for s in m["_streams"]:
+                for r in s.records:
+                    for ckey, delta in (r.get("counters") or {}).items():
+                        name, _ = parse_series_key(ckey)
+                        if name == "elastic/migration_bytes":
+                            mig += float(delta)
+        return {"fleet_epoch": fleet_epoch,
+                "fleet_reconverge_steps": reconverge,
+                "migration_bytes": int(mig),
+                "epoch_first_step": first_at,
+                "laggards": laggards}
+
     # -- fleet summary -----------------------------------------------------
     @staticmethod
     def _p50(vals: List[float]) -> float:
@@ -675,7 +733,14 @@ class FleetCollector:
             "trace_windows_correlated": len(self.window_correlation()),
             "last_window": {k: m["last_window"]
                             for k, m in members.items()},
-        }
+        } | ({
+            # elastic membership plane (ISSUE 16) — keys only appear
+            # when some member published elastic/epoch, so static-world
+            # summaries (and their goldens) are unchanged
+            "fleet_epoch": ev["fleet_epoch"],
+            "fleet_reconverge_steps": ev["fleet_reconverge_steps"],
+            "migration_bytes": ev["migration_bytes"],
+        } if (ev := self.elastic_view(at)) is not None else {})
 
     # -- merged timeline ---------------------------------------------------
     def _health_transitions(self, at: float) -> List[dict]:
@@ -795,3 +860,10 @@ class FleetCollector:
             s["fleet_grad_norm_divergence"])
         reg.gauge("fleet/anomalies").set(
             float(s["numerics_anomaly_total"]))
+        if "fleet_epoch" in s:
+            reg.gauge("fleet/epoch").set(float(s["fleet_epoch"]))
+            reg.gauge("fleet/migration_bytes").set(
+                float(s["migration_bytes"]))
+            if s["fleet_reconverge_steps"] is not None:
+                reg.gauge("fleet/reconverge_steps").set(
+                    float(s["fleet_reconverge_steps"]))

@@ -617,3 +617,65 @@ class KeyIndex:
             self._keys_by_shard[shard].append(int(key))
             self._next_local[shard] = max(self._next_local[shard], local + 1)
         self._ht_grow(max(len(self._slot_of), 1))
+
+    # -- elastic ownership (cross-process repartition, ISSUE 16) -----------
+    def shard_rows(self, shard: int) -> Tuple[np.ndarray, np.ndarray]:
+        """``(keys, slots)`` of every assigned tail key in ``shard``, in
+        insertion order — the export manifest when the shard moves to
+        another process: gather the table rows at ``slots``, encode them
+        as a PR-10 delta keyed by ``keys``, and the receiver re-creates
+        the keys in its own layout (slot values are process-local and
+        never cross the wire)."""
+        keys = np.asarray(self._keys_by_shard[int(shard)], np.int64)
+        slots = np.asarray([self._slot_of[int(k)] for k in keys],
+                           np.int64)
+        return keys, slots
+
+    def adopt_owner_map(self, owner_of_shard, epoch: int) -> None:
+        """Adopt an elastic member table's shard->rank ownership
+        (cluster/membership.py).  The map is advisory routing state —
+        it does not move any local rows itself (the ElasticWorker /
+        transfer layer ships the deltas) — but its epoch is guarded:
+        adopting an older epoch than the one already applied means this
+        process is acting on a stale world view, which is exactly the
+        split-brain the epoch protocol exists to prevent."""
+        from swiftmpi_tpu.cluster.membership import StaleEpochError
+        owner = tuple(int(r) for r in owner_of_shard)
+        if len(owner) != self.num_shards:
+            raise ValueError(
+                f"owner map covers {len(owner)} shards; this index "
+                f"routes {self.num_shards}")
+        cur = getattr(self, "owner_epoch", -1)
+        if int(epoch) < cur:
+            raise StaleEpochError(
+                f"adopt_owner_map: epoch {epoch} regressed below "
+                f"adopted epoch {cur}")
+        # epoch-guard: regression raises StaleEpochError above — the
+        # ownership state below only ever moves forward in epoch
+        self.shard_owner = owner
+        self.owner_epoch = int(epoch)
+
+    def owner_moves(self, new_owner, rank: int
+                    ) -> Tuple[Dict[int, List[int]], List[int]]:
+        """Diff the adopted owner map against ``new_owner`` from
+        ``rank``'s seat: returns ``(outbound, inbound)`` where
+        ``outbound`` maps destination rank -> the local shards to
+        export there (each with :meth:`shard_rows`) and ``inbound`` is
+        the shards arriving.  Raises if no map was adopted yet."""
+        old = getattr(self, "shard_owner", None)
+        if old is None:
+            raise ValueError("owner_moves: no owner map adopted yet")
+        new = tuple(int(r) for r in new_owner)
+        if len(new) != len(old):
+            raise ValueError(
+                f"owner map length changed: {len(old)} -> {len(new)}")
+        outbound: Dict[int, List[int]] = {}
+        inbound: List[int] = []
+        for s, (o, n) in enumerate(zip(old, new)):
+            if o == n:
+                continue
+            if o == rank:
+                outbound.setdefault(n, []).append(s)
+            elif n == rank:
+                inbound.append(s)
+        return outbound, inbound

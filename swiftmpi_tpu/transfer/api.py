@@ -46,7 +46,7 @@ serialization, word2vec.h:120-132) stays the caller's job via
 
 from __future__ import annotations
 
-from typing import Dict, Optional
+from typing import Dict, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -534,6 +534,46 @@ class Transfer:
         if not since:
             return cur
         return {k: v - since.get(k, 0) for k, v in cur.items()}
+
+    # -- elastic membership (ISSUE 16) -------------------------------------
+    #: last adopted membership epoch; -1 = never told (static world).
+    #: Class-level DEFAULTS — the guarded mutation path is
+    #: :meth:`on_membership` only.
+    _membership_epoch = -1
+    _live_ranks: Optional[Tuple[int, ...]] = None
+
+    def on_membership(self, epoch: int, live_ranks) -> None:
+        """Adopt an elastic membership change (cluster/membership.py):
+        the world's live-rank set or shard ownership moved, so anything
+        this backend compiled or estimated against the old world shape
+        is suspect.  Raises
+        :class:`~swiftmpi_tpu.cluster.membership.StaleEpochError` if
+        ``epoch`` regresses below what was already adopted (acting on a
+        stale world view is the split-brain the epoch protocol
+        prevents); adopting the SAME epoch again is a no-op, so every
+        component in a process can be told independently.  Backends
+        override :meth:`_membership_changed` to invalidate their
+        compiled caches — the base books the epoch and mirrors the
+        change into telemetry."""
+        from swiftmpi_tpu.cluster.membership import StaleEpochError
+        epoch = int(epoch)
+        if epoch < self._membership_epoch:
+            raise StaleEpochError(
+                f"{self.name}: membership epoch {epoch} regressed "
+                f"below adopted {self._membership_epoch}")
+        if epoch == self._membership_epoch:
+            return
+        # epoch-guard: regression raised StaleEpochError above — the
+        # membership state below only ever moves forward
+        self._membership_epoch = epoch
+        self._live_ranks = tuple(int(r) for r in live_ranks)
+        self._obs_inc("membership_changes", 1)
+        self._membership_changed()
+
+    def _membership_changed(self) -> None:
+        """Backend hook, called once per adopted epoch: drop whatever
+        was specialized to the old world shape.  Default: nothing (a
+        backend with no world-shaped state)."""
 
     # -- wire-format decision hook ----------------------------------------
     #: post-dedup unique-row estimate for the window crossover (set by

@@ -63,6 +63,17 @@ class HybridTransfer(Transfer):
         self._psum_bytes_total = 0
         self._hot_pending: list = []
 
+    def on_membership(self, epoch: int, live_ranks) -> None:
+        """Elastic membership (api.py): the tail backend owns most of
+        the world-shaped compiled state, so it is told FIRST (its own
+        epoch guard runs there), then the hybrid books the epoch and
+        drops its hot-psum cache."""
+        self.tail.on_membership(epoch, live_ranks)
+        super().on_membership(epoch, live_ranks)
+
+    def _membership_changed(self) -> None:
+        self._hot_push_cache.clear()
+
     # -- attribute forwarding to the tail backend --------------------------
     @property
     def metrics(self):

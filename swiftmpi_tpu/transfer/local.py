@@ -30,6 +30,13 @@ class LocalTransfer(Transfer):
         # (valid rows x grad_row_bytes) the same exchange would ship —
         # the oracle for cross-backend traffic goldens
         self.count_traffic = False
+        # elastic membership (api.py): nothing compiled to invalidate;
+        # keep the adoption history so tests can assert the hook fired
+        self.membership_log: list = []
+
+    def _membership_changed(self) -> None:
+        self.membership_log.append(
+            (self._membership_epoch, self._live_ranks))
 
     def pull(self, state, slots, access, fields=None):
         slots = np.asarray(slots, np.int64)

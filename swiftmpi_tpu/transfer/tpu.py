@@ -146,6 +146,17 @@ class TpuTransfer(Transfer):
         self._window_dense_cache: Dict = {}
         self.window_expected_unique: Optional[float] = None
 
+    def _membership_changed(self) -> None:
+        """Elastic membership (api.py): every compiled program here is
+        specialized to a signature that embeds the world's shard
+        layout, so an epoch change drops all four caches — the next
+        call recompiles against the new shape instead of routing rows
+        to a dead peer's address."""
+        self._pull_cache.clear()
+        self._push_cache.clear()
+        self._dedup_cache.clear()
+        self._window_dense_cache.clear()
+
     # -- overflow accounting ----------------------------------------------
     def _accum_overflow(self, op: str, count) -> None:
         c = int(count)

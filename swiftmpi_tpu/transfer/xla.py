@@ -77,6 +77,14 @@ class XlaTransfer(Transfer):
         # valid rows x (index + grad row); dense: capacity x grad row
         self.count_traffic = False
 
+    def _membership_changed(self) -> None:
+        """Elastic membership (api.py): XLA keeps no compiled caches
+        here (jit re-specializes on its own), but the expected-unique
+        hint was derived from the OLD world's vocab-to-shard spread —
+        clear it so the window crossover reverts to raw row counts
+        until the model re-derives it for the new shape."""
+        self.window_expected_unique = None
+
     # -- pull (global_pull_access.h:28-43 equivalent) ----------------------
     def pull(self, state, slots, access, fields=None):
         slots = jnp.asarray(slots, jnp.int32)

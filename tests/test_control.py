@@ -333,6 +333,7 @@ def test_sparse_table_repartition_preserves_every_row(devices8):
         assert np.isfinite(rows).all()
 
 
+@pytest.mark.slow
 def test_no_torn_serve_reads_during_repartition(devices8):
     """Serve-plane acceptance: concurrent readers over the snapshot
     publisher never observe a torn row while the trainer thread churns
@@ -407,6 +408,7 @@ def test_hybrid_pull_hot_rows_accounting(devices8):
 
 # -- end-to-end: drift, hysteresis budget, audit trail ---------------------
 
+@pytest.mark.slow
 def test_drift_reconverges_within_hysteresis_budget(tmp_path, devices8):
     sents_a, sents_b, vocab = _drift_setup()
     tel = str(tmp_path / "tel.jsonl")
@@ -467,6 +469,7 @@ def test_drift_reconverges_within_hysteresis_budget(tmp_path, devices8):
         sys.path.remove(SCRIPTS)
 
 
+@pytest.mark.slow
 def test_control_off_is_bit_identical_and_passive_on_is_free(devices8):
     sents_a, _, vocab = _drift_setup()
 
@@ -492,6 +495,7 @@ def test_control_off_is_bit_identical_and_passive_on_is_free(devices8):
     assert l_on == l_absent
 
 
+@pytest.mark.slow
 def test_autotune_tracks_statically_retuned_oracle(devices8):
     """ISSUE 9 acceptance: under drift the autotuned arm's loss tracks a
     statically-retuned oracle (same vocab, partition pinned to phase-B

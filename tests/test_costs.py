@@ -155,6 +155,7 @@ def test_hand_model_drift(tmp_path):
 
 # -- w2v cost-catalog + profile_at golden on CPU ---------------------------
 
+@pytest.mark.slow
 def test_w2v_costs_catalog_and_profile_at_golden(tmp_path, devices8):
     """Armed ``[obs] costs`` + ``profile_at`` on ONE small CPU w2v run
     (two e2e surfaces, one train — tier-1 wall clock matters):

@@ -437,6 +437,7 @@ def test_hybrid_checkpoint_roundtrip_and_partition_guard(tmp_path,
         load_checkpoint(m3.table, path)
 
 
+@pytest.mark.slow
 def test_hogwild_tail_skip_count_in_train_metrics(devices8):
     """Satellite: the hogwild batcher's tail drop is RETURNED, not just
     logged — train_metrics carries the skipped-word count and it respects
@@ -463,6 +464,7 @@ def test_train_metrics_carries_transfer_traffic(devices8):
 
 # -- the Zipf golden ------------------------------------------------------
 
+@pytest.mark.slow
 def test_hybrid_zipf_traffic_reduction_golden(devices8):
     """ISSUE 3 acceptance: on a synthetic Zipf(1.0) 100K-vocab corpus on
     the 8-device mesh, transfer=hybrid moves >=3x fewer cross-shard

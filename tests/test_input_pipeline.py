@@ -261,6 +261,7 @@ def _train_final(transfer, stencil, pipeline, corp, niters=2,
 @pytest.mark.parametrize("transfer,stencil",
                          [("xla", 0), ("xla", 1), ("tpu", 0),
                           ("hybrid", 0), ("hybrid", 1)])
+@pytest.mark.slow
 def test_pipeline_bit_identical_to_off(transfer, stencil, devices8):
     """The acceptance contract: same seed + corpus, ``pipeline: 3`` vs
     ``pipeline: 0`` — identical per-iteration losses AND bit-identical
@@ -342,6 +343,7 @@ def _tfm_batches(n=6, batch=8, seq=16, seed=0):
             for _ in range(n)]
 
 
+@pytest.mark.slow
 def test_trainer_run_pipeline_parity(devices8):
     mesh = Mesh(np.array(devices8).reshape(4, 2), ("data", "model"))
 

@@ -421,6 +421,67 @@ def test_lock_guard_ignores_wrong_lock(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# EPOCH-GUARD (the ISSUE 16 elastic-membership invariant)
+
+def test_epoch_guard_trips_on_unannotated_adopt(tmp_path):
+    new = lint_src(tmp_path, "pkg/worker.py", """
+    class Worker:
+        def sync(self, table):
+            self.member_table = table
+            self.epoch = table.epoch
+    """)
+    assert [f.rule for f in new] == ["EPOCH-GUARD"]
+    assert "epoch-guard" in new[0].message
+    assert "sync" in new[0].message
+
+
+def test_epoch_guard_trips_on_unannotated_write_call(tmp_path):
+    new = lint_src(tmp_path, "pkg/sup.py", """
+    from swiftmpi_tpu.cluster import membership as mem
+
+    def publish(fleet_dir, table):
+        mem.write_membership(fleet_dir, table)
+    """)
+    assert [f.rule for f in new] == ["EPOCH-GUARD"]
+
+
+def test_epoch_guard_passes_with_annotation(tmp_path):
+    new = lint_src(tmp_path, "pkg/worker.py", """
+    class Worker:
+        def sync(self, table):
+            if table.epoch < self.epoch:
+                raise ValueError("stale epoch")
+            # epoch-guard: regression raised above
+            self.member_table = table
+            self.epoch = table.epoch
+    """)
+    assert new == []
+
+
+def test_epoch_guard_ignores_class_defaults_and_init(tmp_path):
+    # class-level defaults and __init__ run happens-before publication
+    # (no epoch exists yet) — neither needs the annotation
+    new = lint_src(tmp_path, "pkg/backend.py", """
+    class Backend:
+        _membership_epoch = -1
+        _live_ranks = None
+
+        def __init__(self):
+            self.member_table = None
+    """)
+    assert new == []
+
+
+def test_epoch_guard_skips_the_choke_point_itself(tmp_path):
+    new = lint_src(tmp_path, "pkg/mem.py", """
+    def write_membership(dirpath, table):
+        owner_of_shard = tuple(table.owner_of_shard)
+        return owner_of_shard
+    """)
+    assert new == []
+
+
+# ---------------------------------------------------------------------------
 # KNOB-DOC
 
 def test_knob_doc_trips_without_entry(tmp_path):

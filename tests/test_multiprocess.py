@@ -213,6 +213,7 @@ def test_launcher_kills_stragglers_and_leaks_nothing(tmp_path):
         os.kill(pid, 0)
 
 
+@pytest.mark.slow
 def test_supervised_chaos_recovery_end_to_end(tmp_path):
     """The acceptance scenario: a fault plan kills rank 0 mid-training
     AND corrupts the newest checkpoint; the supervisor restarts the

@@ -379,6 +379,7 @@ def test_chaos_hang_watchdog_recovers(tmp_path, devices8):
     assert np.isfinite(losses).all()
 
 
+@pytest.mark.slow
 def test_chaos_hang_budget_exhaustion_raises(tmp_path, devices8):
     """Hang faults count against the same restart budget."""
     corpus = synthetic_corpus(10, vocab_size=20, length=10, seed=11)

@@ -709,6 +709,7 @@ def test_window_fmt_telemetry_mirror():
         obs.set_enabled(False)
 
 
+@pytest.mark.slow
 def test_w2v_sparse_q_trajectory_parity(devices8):
     """[cluster] wire_quant: int8 through the fused windowed scan tracks
     the f32 wire within the documented envelope |a-b| <= 1e-5 + 1e-3|b|

@@ -384,6 +384,7 @@ def test_w2v_resume_after_grow_invalidates_step(tmp_path, devices8):
 
 # -- async modes (word2vec_global.h:577-651) ------------------------------
 
+@pytest.mark.slow
 def test_w2v_hogwild_trains_and_matches_sync_loss(devices8):
     """Genuinely unsynchronized mode: 8 independent worker replicas,
     sequential arrival-order reconciliation.  Must converge, and land
@@ -406,6 +407,7 @@ def test_w2v_hogwild_trains_and_matches_sync_loss(devices8):
     assert float(jnp.abs(st["v2sum"]).sum()) > 0
 
 
+@pytest.mark.slow
 def test_w2v_staleness_sweep(devices8):
     """VERDICT round-1 item 5: loss vs staleness.  local_steps in
     {1, 4, 16} (snapshot mode) and hogwild: all variants must converge
@@ -745,6 +747,7 @@ class _ShortTailBatcher:
                              last.ctx_mask[:n], min(last.n_words, n))
 
 
+@pytest.mark.slow
 def test_w2v_fused_inner_steps_trains_like_per_batch(devices8):
     """[worker] inner_steps: N sync steps fused per dispatch via
     lax.scan.  Same math and update order as the per-batch loop (only
@@ -827,6 +830,7 @@ def test_w2v_cli_hogwild_variant(tmp_path, devices8):
     assert len(open(out).readlines()) == 40
 
 
+@pytest.mark.slow
 def test_w2v_hogwild_reconciliation_is_exact_worker_major_apply(devices8):
     """The ring-state reconciliation (state travels, pushes stay local)
     must produce BIT-level the same table as the literal worker-major
