@@ -1,0 +1,218 @@
+#!/usr/bin/env python
+"""Serve-fleet worker: one rank of a ``launch.py -serve N`` world.
+
+Role comes from ``SMTPU_SERVE_ROLE`` (the serve supervisor sets it:
+rank 0 = ``trainer``, ranks 1..N = ``replica``); the snapshot stream
+lives in ``SMTPU_SHIP_DIR``.  Like scripts/_fleet_child.py, nothing
+here is cross-process beyond the ship directory — no jax.distributed,
+no collectives — so the drill's capability probe stays "can this
+container spawn subprocesses".
+
+**Trainer**: a synthetic hot-head table (``SMTPU_SERVE_VOCAB`` rows ×
+``SMTPU_SERVE_DIM``, ``SMTPU_SERVE_NHOT`` hot) trained with a Zipf
+touched-row set per step (``SMTPU_SERVE_ZIPF``, low slots hottest —
+the validation shape).  Every ``SMTPU_SERVE_EVERY`` steps it publishes
+through the in-process :class:`SnapshotPublisher` and ships the result
+with :class:`~swiftmpi_tpu.serve.shipper.SnapshotShipper` — full base
+first, priced deltas after — booking ``serve/delta_*`` telemetry.  The
+fault bus fires at the top of every step (``SMTPU_FAULT_PLAN`` kill
+drills); a restarted trainer's shipper resumes the version chain past
+the manifest tail.
+
+**Replica**: replays the stream with
+:class:`~swiftmpi_tpu.serve.shipper.SnapshotReplica` (blocking on
+``wait_for_version(1)`` for the base — the cross-process staleness
+bound), then runs an open-loop Zipf query storm through the standard
+:class:`~swiftmpi_tpu.serve.reader.EmbeddingReader`
+(``SMTPU_SERVE_QPS`` paced queries/s of ``SMTPU_SERVE_QSIZE``-key
+batches), polling for new versions each step.  All ``serve/*`` series
+ride the reader's ``{replica=r<rank>}`` labels.  A dead trainer does
+NOT stop the storm: the replica keeps serving the last applied version
+(``serve/staleness_s`` rising) and exits cleanly.
+
+Prints ``SERVE_CHILD_OK role=<role> rank=<r> version=<v> ...`` on a
+clean finish; a replica that never sees a base exits rc 4.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+
+# launched as `python scripts/_serve_child.py`: sys.path[0] is scripts/,
+# so the package root must be added by hand
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np                                    # noqa: E402
+
+from swiftmpi_tpu import obs                          # noqa: E402
+from swiftmpi_tpu.testing import faults               # noqa: E402
+from swiftmpi_tpu.utils.config import ConfigParser    # noqa: E402
+
+
+def _env_int(name: str, default: int) -> int:
+    return int(os.environ.get(name, str(default)))
+
+
+def _env_float(name: str, default: float) -> float:
+    return float(os.environ.get(name, str(default)))
+
+
+def _zipf_slots(rng, n: int, vocab: int, alpha: float) -> np.ndarray:
+    """Zipf-shaped slot draws with slot 0 hottest (the hot head is the
+    low slots, matching the table layout the shipper prices)."""
+    z = rng.zipf(alpha, size=n)
+    return np.minimum(z - 1, vocab - 1).astype(np.int64)
+
+
+class _Table:
+    """SnapshotPublisher-capturable toy table: state dict + n_hot."""
+
+    def __init__(self, vocab: int, dim: int, n_hot: int, seed: int):
+        rng = np.random.default_rng(seed)
+        self.state = {
+            "v@hot": rng.normal(size=(n_hot, dim)).astype(np.float32),
+            "v": rng.normal(size=(vocab - n_hot, dim)).astype(
+                np.float32),
+        }
+        self.n_hot = n_hot
+
+        class _KI:
+            n_hot = self.n_hot
+        self.key_index = _KI()
+
+
+def trainer_main(rec, reg, rank: int, steps: int, step_s: float,
+                 ship_dir: str) -> int:
+    from swiftmpi_tpu.serve.shipper import SnapshotShipper
+    from swiftmpi_tpu.serve.snapshot import SnapshotPublisher
+
+    vocab = _env_int("SMTPU_SERVE_VOCAB", 4096)
+    dim = _env_int("SMTPU_SERVE_DIM", 16)
+    n_hot = _env_int("SMTPU_SERVE_NHOT", 256)
+    every = _env_int("SMTPU_SERVE_EVERY", 5)
+    touch = _env_int("SMTPU_SERVE_TOUCH", 128)
+    alpha = _env_float("SMTPU_SERVE_ZIPF", 1.3)
+    quant = os.environ.get("SMTPU_SERVE_QUANT", "int8")
+
+    tbl = _Table(vocab, dim, n_hot, seed=7)
+    keys = np.arange(1, vocab + 1, dtype=np.uint64)
+    slots = np.arange(vocab, dtype=np.int64)
+    pub = SnapshotPublisher(every=1)
+    shipper = SnapshotShipper(ship_dir, quant=quant)
+    rng = np.random.default_rng(1000 + shipper.version)
+    touched_keys: set = set()
+    for step in range(steps):
+        faults.step_event(step)       # kill drills fire here
+        with obs.span("dispatch"):
+            hit = _zipf_slots(rng, touch, vocab, alpha)
+            rows = np.unique(hit)
+            upd = rng.normal(scale=0.05,
+                             size=(len(rows), dim)).astype(np.float32)
+            hot = rows[rows < n_hot]
+            tail = rows[rows >= n_hot] - n_hot
+            tbl.state["v@hot"][hot] += upd[:len(hot)]
+            tbl.state["v"][tail] += upd[len(rows) - len(tail):]
+            touched_keys.update((rows + 1).tolist())
+            time.sleep(step_s)
+        if (step + 1) % every == 0:
+            snap = pub.publish(tbl, keys=keys, slots=slots,
+                               meta={"query_field": "v"})
+            recd = shipper.ship(
+                snap, touched=np.fromiter(touched_keys, np.uint64,
+                                          len(touched_keys)))
+            touched_keys.clear()
+            print(f"SERVE_SHIP v{recd['version']} kind={recd['kind']} "
+                  f"bytes={recd['bytes']} full={recd['full_bytes']} "
+                  f"fmt={recd['fmt']}", flush=True)
+        obs.record_step(1)
+    rec.close()
+    print(f"SERVE_CHILD_OK role=trainer rank={rank} "
+          f"version={shipper.version} steps={steps}")
+    return 0
+
+
+def replica_main(rec, reg, rank: int, steps: int, step_s: float,
+                 ship_dir: str) -> int:
+    from swiftmpi_tpu.serve.reader import EmbeddingReader
+    from swiftmpi_tpu.serve.shipper import SnapshotReplica
+
+    vocab = _env_int("SMTPU_SERVE_VOCAB", 4096)
+    alpha = _env_float("SMTPU_SERVE_ZIPF", 1.3)
+    qsize = _env_int("SMTPU_SERVE_QSIZE", 32)
+    rate = _env_float("SMTPU_SERVE_QPS", 200.0)
+    sync_s = _env_float("SMTPU_SERVE_SYNC_TIMEOUT_S", 30.0)
+
+    replica = SnapshotReplica(ship_dir)
+    # cross-process bounded staleness: refuse to serve before the first
+    # shipped base lands (the same contract wait_for_version gives an
+    # in-process reader)
+    if replica.wait_for_version(1, timeout=sync_s) is None:
+        print(f"serve_child: rank {rank} saw no base within {sync_s}s",
+              file=sys.stderr)
+        return 4
+    reader = EmbeddingReader(replica, field="v",
+                             cache_rows=_env_int(
+                                 "SMTPU_SERVE_CACHE_ROWS", 1024))
+    rng = np.random.default_rng(17 + rank)
+    gap = 1.0 / rate if rate > 0 else 0.0
+    queries = 0
+    for step in range(steps):
+        faults.step_event(step)       # replica-kill drills fire here
+        t_end = time.perf_counter() + step_s
+        with obs.span("dispatch"):
+            while True:
+                t_q = time.perf_counter()
+                if t_q >= t_end:
+                    break
+                replica.poll()
+                qkeys = _zipf_slots(rng, qsize, vocab, alpha) + 1
+                reader.read(qkeys)
+                queries += 1
+                # open-loop pacing: hold the offered rate even when a
+                # query runs long (sleep only the remaining gap)
+                rest = gap - (time.perf_counter() - t_q)
+                if rest > 0:
+                    time.sleep(min(rest, max(t_end - time.perf_counter(),
+                                             0.0)))
+        obs.record_step(1)
+    lat = reader.latency_quantiles()
+    rec.close()
+    print(f"SERVE_CHILD_OK role=replica rank={rank} "
+          f"version={replica.version} queries={queries} "
+          f"p50={lat['p50_ms']:.3f} p99={lat['p99_ms']:.3f} "
+          f"hit={reader.hit_ratio():.3f} "
+          f"stale_s={replica.staleness_s():.3f}")
+    return 0
+
+
+def main() -> int:
+    steps = _env_int("SMTPU_SERVE_STEPS", 40)
+    step_s = _env_float("SMTPU_SERVE_STEP_S", 0.05)
+    hb_s = _env_float("SMTPU_FLEET_HB_S", 0.25)
+    ship_dir = os.environ.get("SMTPU_SHIP_DIR", "")
+    if not ship_dir:
+        print("serve_child: SMTPU_SHIP_DIR not set (run under "
+              "launch.py -serve N)", file=sys.stderr)
+        return 2
+    cfg = ConfigParser().update({
+        "worker": {"telemetry": 1},
+        "obs": {"heartbeat_s": hb_s},
+    })
+    rec = obs.configure(cfg, run="serve_child")
+    if rec is None:
+        print("serve_child: telemetry failed to arm", file=sys.stderr)
+        return 2
+    rank = obs.process_rank() or 0
+    reg = obs.get_registry()
+    role = os.environ.get("SMTPU_SERVE_ROLE",
+                          "trainer" if rank == 0 else "replica")
+    if role == "trainer":
+        return trainer_main(rec, reg, rank, steps, step_s, ship_dir)
+    return replica_main(rec, reg, rank, steps, step_s, ship_dir)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -53,7 +53,13 @@ TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
                    "kernel_ms", "serve_p99_ms", "serve_miss_ratio",
                    "pull_bytes_per_step", "control_decisions_per_1k_steps",
                    "fleet_step_ms_skew_pct", "fleet_wire_bytes_imbalance",
-                   "ef_mass_growth", "fleet_grad_norm_divergence")
+                   "ef_mass_growth", "fleet_grad_norm_divergence",
+                   # snapshot-shipping wire cost (ISSUE 17): mean
+                   # encoded bytes per steady-state delta publish on
+                   # the serve_fleet cell — the number the shared
+                   # transfer/delta.py codec exists to hold down.  An
+                   # exact byte model, so no noise floor.
+                   "delta_bytes_per_publish")
 DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "window_fmt_sparse", "window_fmt_q",
                   "window_fmt_bitmap", "wire_quant", "coalesce_ratio",
@@ -69,7 +75,10 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "migration_bytes",
                   "numerics_anomalies", "numerics_critical",
                   "numerics_nonfinite", "cross_rank_anomalies",
-                  "retraces", "compile_ms", "peak_hbm_bytes")
+                  "retraces", "compile_ms", "peak_hbm_bytes",
+                  "serve_fleet_qps", "qps_scaling_x", "delta_publishes",
+                  "full_publishes", "delta_vs_full_ratio",
+                  "delta_fmt_mix", "staleness_s", "gates_pass")
 #: absolute increase a metric must clear before it can regress: wall-
 #: clock metrics jitter run to run while the counter metrics are exact,
 #: so only the former get a floor (ms for the stall split; kernel_ms is
@@ -458,6 +467,28 @@ def trace_overhead_report(base: dict, cand: dict, bound: float) -> list:
     return rows
 
 
+def serve_qps_report(base: dict, cand: dict, bound: float) -> list:
+    """Advisory aggregate-throughput report for serving cells: the one
+    HIGHER-is-better number in the budget (``serve_fleet_qps``, the
+    serve_fleet cell's N-replica aggregate), so it cannot ride the
+    lower-is-better compare() path.  A drop past ``bound`` prints
+    loudly next to the verdict but never fails the gate — qps on the
+    shared bench host is wall-clock (scheduler-jittered), and the hard
+    serving gates are the exact-byte delta_bytes_per_publish and the
+    floor-protected serve_p99_ms.  Returns
+    [(cell, base_qps, cand_qps, rel, over_bound)]."""
+    rows = []
+    for cell in sorted(set(base) & set(cand)):
+        b = base[cell].get("serve_fleet_qps")
+        c = cand[cell].get("serve_fleet_qps")
+        if b is None or c is None:
+            continue
+        b, c = float(b), float(c)
+        rel = (c - b) / b if b > 0 else 0.0
+        rows.append((cell, b, c, rel, -rel > bound))
+    return rows
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         description="fail when bench traffic counters regressed")
@@ -575,6 +606,15 @@ def main(argv=None) -> int:
                    else f"within {args.trace_overhead_bound:.0%}")
         print(f"  trace overhead {cell}: step_ms {b_ms:.3f} -> "
               f"{c_ms:.3f} ({rel:+.1%}) — {verdict}")
+
+    for cell, b_q, c_q, rel, over in serve_qps_report(
+            {c: m for c, m in base.items() if not only or c in only},
+            {c: m for c, m in cand.items() if not only or c in only},
+            args.tolerance):
+        verdict = ("DROPPED PAST TOLERANCE (advisory)" if over
+                   else f"within {args.tolerance:.0%}")
+        print(f"  serve qps {cell}: {b_q:.0f} -> {c_q:.0f} "
+              f"({rel:+.1%}) — {verdict}")
 
     print(f"traffic budget OK: {covered} cell(s) within "
           f"{args.tolerance:.0%}")

@@ -157,6 +157,171 @@ def run_elastic(args) -> int:
     return 0
 
 
+def run_serve(args) -> int:
+    """The ISSUE 17 serve-fleet chaos drill: trainer + N replicas over
+    a delta-shipped snapshot stream under ``supervise_serve``.
+
+    Default shape: SIGKILL of replica ``--kill-rank`` mid-query-storm;
+    asserts the restart re-synced it via base+delta replay (its
+    ``serve/replica_version`` gauge is monotone per life and reaches
+    the manifest tail), the kill is attributed as an organic exit, and
+    the fleet saw zero unnoticed deaths.  ``--serve-kill-trainer``
+    kills the trainer instead with a zero trainer-restart budget: the
+    replicas must keep serving stale-but-bounded (``serve/staleness_s``
+    rising past the publish cadence) and exit cleanly.
+    """
+    from swiftmpi_tpu.obs.registry import parse_series_key
+    from swiftmpi_tpu.serve.shipper import read_manifest
+
+    fleet_dir = os.path.abspath(args.out)
+    os.makedirs(fleet_dir, exist_ok=True)
+    ship_dir = os.path.join(fleet_dir, "ship")
+    kill_trainer = args.serve_kill_trainer
+    victim = 0 if kill_trainer else args.kill_rank
+    kill_step = max(args.steps // 3, 2)
+    marker = os.path.join(fleet_dir, "kill_marker")
+    plan = FaultPlan().kill_rank(victim, at_step=kill_step,
+                                 marker=marker)
+    os.environ["SMTPU_FAULT_PLAN"] = plan.to_json()
+    os.environ["SMTPU_SERVE_STEPS"] = str(args.steps)
+    os.environ["SMTPU_SERVE_STEP_S"] = str(args.step_s)
+    os.environ["SMTPU_FLEET_HB_S"] = "0.25"
+    os.environ.setdefault("SMTPU_SERVE_EVERY", "4")
+    os.environ.setdefault("SMTPU_SERVE_VOCAB", "2048")
+    t0 = time.time()
+    rc = smtpu_launch.supervise_serve(
+        [sys.executable, os.path.join(_REPO, "scripts",
+                                      "_serve_child.py")],
+        args.replicas, fleet_dir=fleet_dir, ship_dir=ship_dir,
+        max_restarts=3, backoff_s=0.2,
+        trainer_restarts=0 if kill_trainer else None)
+    elapsed = time.time() - t0
+    failures = []
+    if kill_trainer:
+        if rc == 0:
+            failures.append("trainer killed with a zero restart budget "
+                            "but the world exited rc=0")
+    elif rc != 0:
+        print(f"FLEET_SMOKE FAIL: serve world exited rc={rc}")
+        return 1
+
+    fc = FleetCollector(fleet_dir, stall_after_s=args.stall_after,
+                        dead_after_s=4 * args.stall_after)
+    fc.poll(final=True)
+    timeline = fc.write_timeline()
+    s = fc.summary()
+    sv = fc.serve_view()
+    manifest = read_manifest(ship_dir)
+    tail_version = manifest[-1]["version"] if manifest else 0
+    members = fc.members()
+
+    def replica_versions(key: str):
+        """Per-life (stream-ordered) serve/replica_version writes."""
+        out = []
+        for st in members[key]["_streams"]:
+            vals = []
+            for r in st.records:
+                for gkey, v in (r.get("gauges") or {}).items():
+                    if parse_series_key(gkey)[0] == \
+                            "serve/replica_version":
+                        vals.append(int(v))
+            out.append(vals)
+        return out
+
+    if sv is None:
+        failures.append("no serve/* series in any member stream")
+    else:
+        if sv["serve_replicas"] != args.replicas:
+            failures.append(f"expected {args.replicas} replica members,"
+                            f" got {sv['serve_replicas']}")
+        if not manifest:
+            failures.append("trainer shipped nothing (empty manifest)")
+        if s["unnoticed_deaths"]:
+            failures.append(f"unnoticed deaths: {s['unnoticed_deaths']}")
+        # monotone versions: within every replica life, the applied
+        # version gauge never rewinds (the replica raises on a forked
+        # chain; this asserts the evidence made it to the timeline)
+        for r in range(1, args.replicas + 1):
+            key = str(r)
+            if key not in members:
+                failures.append(f"replica rank {r} never joined the "
+                                "fleet timeline")
+                continue
+            for life, vals in enumerate(replica_versions(key)):
+                if any(b < a for a, b in zip(vals, vals[1:])):
+                    failures.append(f"rank {r} life {life}: replica "
+                                    f"version rewound ({vals})")
+        organic = [e for e in fc.supervisor_events
+                   if e.get("kind") == "exit"
+                   and e.get("rank") == victim
+                   and e.get("rc") not in (0, None)
+                   and not e.get("by_supervisor")]
+        if not organic:
+            failures.append(f"kill of rank {victim} not attributed as "
+                            "an organic exit in the supervisor "
+                            "evidence")
+        if kill_trainer:
+            if not any(e.get("kind") == "rank_abandoned"
+                       and e.get("rank") == 0
+                       for e in fc.supervisor_events):
+                failures.append("dead trainer never marked abandoned")
+            bad = {k: v for k, v in s["health"].items()
+                   if k != "0" and v != "exited"}
+            if bad:
+                failures.append(f"replicas not cleanly exited after "
+                                f"trainer death: {bad}")
+            # stale-but-bounded: with no publishes after the kill the
+            # wall-clock staleness must end above the publish cadence
+            cadence_s = (int(os.environ["SMTPU_SERVE_EVERY"])
+                         * args.step_s)
+            if sv and sv["serve_staleness_max_s"] <= cadence_s:
+                failures.append(
+                    f"staleness never rose past the publish cadence "
+                    f"({sv['serve_staleness_max_s']:.2f}s <= "
+                    f"{cadence_s:.2f}s) after the trainer died")
+        else:
+            bad = {k: v for k, v in s["health"].items()
+                   if v != "exited"}
+            if bad:
+                failures.append(f"members not cleanly exited: {bad}")
+            if not any(e.get("kind") == "restart_rank"
+                       and e.get("rank") == victim
+                       for e in fc.supervisor_events):
+                failures.append(f"killed replica {victim} was never "
+                                "restarted")
+            # re-sync proof: the killed replica's restarted life must
+            # replay base+deltas up to the manifest tail
+            lives = replica_versions(str(victim))
+            final = max((v for vals in lives for v in vals), default=0)
+            if final < tail_version:
+                failures.append(
+                    f"killed replica resynced only to v{final} of "
+                    f"v{tail_version} — base+delta replay incomplete")
+
+    if args.json:
+        json.dump({"summary": s, "serve": sv and {
+            k: v for k, v in sv.items() if k != "members"}},
+            sys.stdout, indent=2, default=str)
+        print()
+    else:
+        deltas = [m for m in manifest if m["kind"] == "delta"]
+        print(f"serve smoke: 1+{args.replicas} ranks x {args.steps} "
+              f"steps in {elapsed:.1f}s -> {timeline}")
+        if sv:
+            print(f"  v{tail_version} ({len(deltas)}/{len(manifest)} "
+                  f"delta publishes)  qps_total="
+                  f"{sv['serve_qps_total']:.0f}  "
+                  f"lag_max={sv['serve_lag_max']:.0f}  "
+                  f"stale_max={sv['serve_staleness_max_s']:.2f}s  "
+                  f"health={s['health']}")
+    if failures:
+        for f in failures:
+            print(f"FLEET_SMOKE FAIL: {f}")
+        return 1
+    print("FLEET_SMOKE OK")
+    return 0
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description="4-process fleet smoke")
     ap.add_argument("--out", default="runs/fleet_smoke",
@@ -190,6 +355,19 @@ def main(argv=None) -> int:
                          "the merged timeline")
     ap.add_argument("--kill-rank", type=int, default=2,
                     help="rank the --elastic drill kills (default 2)")
+    ap.add_argument("--serve", action="store_true",
+                    help="run the ISSUE 17 serve-fleet chaos drill: "
+                         "trainer + --replicas readers under "
+                         "supervise_serve, SIGKILL of --kill-rank "
+                         "(a replica) mid-query-storm, assert monotone "
+                         "replayed versions, base+delta re-sync, kill "
+                         "attribution, zero unnoticed deaths")
+    ap.add_argument("--serve-kill-trainer", action="store_true",
+                    help="variant of --serve: kill the TRAINER with a "
+                         "zero restart budget; replicas must keep "
+                         "serving stale-but-bounded and exit cleanly")
+    ap.add_argument("--replicas", type=int, default=3,
+                    help="--serve replica reader count (default 3)")
     ap.add_argument("--json", action="store_true",
                     help="dump the fleet summary as JSON")
     args = ap.parse_args(argv)
@@ -198,6 +376,8 @@ def main(argv=None) -> int:
     if reason:
         print(f"FLEET_SMOKE SKIP: {reason}")
         return 0
+    if args.serve or args.serve_kill_trainer:
+        return run_serve(args)
     if args.elastic:
         return run_elastic(args)
 

@@ -37,6 +37,14 @@ if [ -n "$BENCH_BASELINE" ] && [ -n "$BENCH_CANDIDATE" ] && [ -r "$BENCH_BASELIN
   # (check_traffic_budget fails the run when wire_quant is armed but
   # the sparse_q/bitmap share is zero).  Grep-gated so bench files
   # predating the 4-way wire stay advisory-quiet.
+  # Serve-fleet gate: delta_bytes_per_publish (exact byte model, hard
+  # lower-is-better) + worst per-replica serve_p99_ms (0.1ms floor),
+  # with the aggregate-qps drop reported advisorily.  Grep-gated so
+  # bench files predating the shipping plane stay quiet.
+  if grep -q '"serve_fleet"' "$BENCH_BASELINE" && grep -q '"serve_fleet"' "$BENCH_CANDIDATE"; then
+    echo "--- serve-fleet budget (advisory) ---"
+    python "$(dirname "$0")/check_traffic_budget.py" --cells serve_fleet "$BENCH_BASELINE" "$BENCH_CANDIDATE" || echo "serve-fleet budget ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
   if grep -q '"w2v_1m_qwire"' "$BENCH_BASELINE" && grep -q '"w2v_1m_qwire"' "$BENCH_CANDIDATE"; then
     echo "--- qwire budget (advisory) ---"
     python "$(dirname "$0")/check_traffic_budget.py" --cells w2v_1m_qwire "$BENCH_BASELINE" "$BENCH_CANDIDATE" || echo "qwire budget ADVISORY FAILURE (tier-1 verdict unchanged)"
@@ -127,6 +135,23 @@ if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.p
   fi
 else
   echo "elastic smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
+fi
+# Advisory serve-fleet chaos drill (ISSUE 17): a trainer + 3 replica
+# world under launch.py -serve 3 — the trainer ships versioned snapshot
+# deltas through transfer/delta.py, replicas replay them and run paced
+# query storms, and one replica is SIGKILLed mid-storm.  fleet_smoke.py
+# --serve checks the kill was attributed (never unnoticed), survivors
+# kept serving, the restarted replica re-synced to the manifest tail
+# via base+delta replay, and every replica's version stream stayed
+# monotone per life.
+SERVE_OUT="$REPO_DIR/runs/serve_smoke_$(date +%Y%m%d_%H%M%S)"
+echo "--- serve smoke (advisory) ---"
+if timeout -k 10 300 env JAX_PLATFORMS=cpu python "$(dirname "$0")/fleet_smoke.py" --out "$SERVE_OUT" --serve; then
+  if [ -r "$SERVE_OUT/fleet.jsonl" ]; then
+    python "$(dirname "$0")/telemetry_report.py" --fleet "$SERVE_OUT/fleet.jsonl" || echo "serve fleet report ADVISORY FAILURE (tier-1 verdict unchanged)"
+  fi
+else
+  echo "serve smoke ADVISORY FAILURE (tier-1 verdict unchanged)"
 fi
 # Advisory calibration staleness check: verdicts recorded under another
 # jaxlib/libtpu stack no longer steer data-plane gates — say so next to

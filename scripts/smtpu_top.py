@@ -114,6 +114,7 @@ def frame(fc: FleetCollector) -> dict:
     members = fc.members()
     summary = fc.summary()
     health = summary["health"]
+    serve = fc.serve_view() or {"members": {}}
     rows = []
     for key in sorted(members, key=lambda k: (len(k), k)):
         m = members[key]
@@ -158,6 +159,9 @@ def frame(fc: FleetCollector) -> dict:
             "straggler": key == summary["straggler_rank"],
             "grad_norm": norms[max(norms)] if norms else None,
             "anomalies": anomalies,
+            # serve-fleet plane (ISSUE 17): shipping/replay digest for
+            # members that published serve/* (trainer or replica role)
+            "serve": serve["members"].get(key),
         })
     rows.sort(key=lambda r: (_HEALTH_ORDER.get(r["health"], 9),
                              r["rank"]))
@@ -223,6 +227,31 @@ def render(fr: dict) -> str:
             f"({s.get('numerics_critical_total', 0)} critical), "
             f"grad_norm divergence "
             f"{s.get('fleet_grad_norm_divergence', 0.0):.1f}x")
+    # serve-fleet section (ISSUE 17): one row per shipping/serving
+    # member — role, replayed version + lag, read rate and tail latency
+    serving = [r for r in fr["members"] if r.get("serve")]
+    if serving:
+        lines.append(
+            f"serve: {s.get('serve_replicas', 0)} replicas, "
+            f"{s.get('serve_qps_total', 0.0):,.0f} qps aggregate, "
+            f"v{int(s.get('serve_version') or 0)} "
+            f"lag_max={s.get('serve_lag_max', 0):.0f} "
+            f"stale_max={s.get('serve_staleness_max_s', 0.0):.1f}s, "
+            f"publish bytes delta/full "
+            f"{s.get('serve_delta_bytes', 0):,}/"
+            f"{s.get('serve_full_bytes', 0):,}")
+        for r in serving:
+            sv = r["serve"]
+            lag = (f"lag={sv['lag']:.0f}" if sv["lag"] is not None
+                   else "lag=-")
+            lat = (f"p50={sv['p50_ms']:.2f}ms p99={sv['p99_ms']:.2f}ms"
+                   if sv["p50_ms"] is not None else "p50=- p99=-")
+            hit = (f"hit={sv['hit_ratio']:.2f}"
+                   if sv["hit_ratio"] is not None else "hit=-")
+            lines.append(
+                f"  {r['rank']:<6}{sv['role'] or '?':>8}"
+                f"  v{int(sv['version'] or 0)} {lag} "
+                f"qps={sv['qps']:,.0f} {lat} {hit}")
     return "\n".join(lines)
 
 

@@ -359,6 +359,7 @@ _SERVE_ALLOW = {
     "serve/snapshot.py": ("jax.device_get", "jax.tree_util"),
     "serve/reader.py": (),
     "serve/query.py": (),
+    "serve/shipper.py": (),
 }
 
 

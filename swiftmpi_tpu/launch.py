@@ -39,6 +39,14 @@ Flags (reference CMDLine style, ``-key value``):
                     ``-shards K``, ``-join-timeout S``, ``-dead-after S``
                     tune the member table, rejoin deadline, and
                     hung-rank detection.
+* ``-serve N``    — serve-fleet mode (ISSUE 17): rank 0 is the trainer,
+                    ranks 1..N are replica readers replaying the
+                    delta-shipped snapshot stream from ``-ship-dir``
+                    (default ``<fleet-dir>/ship``).  Replica restarts
+                    ride the per-rank budgets; a dead trainer leaves
+                    the replicas serving stale-but-bounded.
+                    ``-trainer-restarts R`` budgets the trainer
+                    separately.  Requires ``-fleet-dir``.
 * ``-fleet-dir D`` — arm fleet observability (ISSUE 12): children get
                     ``SMTPU_FLEET_DIR=D`` (their StepRecorder writes
                     per-rank heartbeat'd JSONL streams there, see
@@ -635,6 +643,178 @@ def supervise_elastic(argv: List[str], nprocs: int, *, fleet_dir: str,
         fleet_log.close()
 
 
+#: role env var the serve-fleet children read: "trainer" or "replica"
+ENV_SERVE_ROLE = "SMTPU_SERVE_ROLE"
+#: snapshot ship directory (serve/shipper.py stream) for both roles
+ENV_SHIP_DIR = "SMTPU_SHIP_DIR"
+
+
+def supervise_serve(argv: List[str], n_replicas: int, *, fleet_dir: str,
+                    ship_dir: Optional[str] = None,
+                    cpu_devices: int = 0, port: int = 0,
+                    kill_grace_s: float = 5.0, max_restarts: int = 2,
+                    trainer_restarts: Optional[int] = None,
+                    backoff_s: float = 0.5, backoff_factor: float = 2.0,
+                    backoff_max_s: float = 30.0,
+                    stable_after_s: Optional[float] = None,
+                    poll_s: float = 0.1) -> int:
+    """Serve-fleet supervisor (ISSUE 17): one trainer rank + N replica
+    reader ranks over a shared snapshot-ship directory.
+
+    Failure domains are per-rank, riding the PR-16 budget machinery,
+    but the roles are asymmetric in exactly the way serving wants:
+
+    * a **replica** dying takes zero write-path capacity with it — it
+      restarts alone under its per-rank backoff budget and re-syncs by
+      replaying the newest full base + deltas from the ship dir (the
+      version chain IS the recovery path; no peer coordination);
+    * the **trainer** dying does NOT tear the replicas down: they keep
+      serving the last shipped version — stale but bounded, with the
+      replica-side ``serve/staleness_s`` gauge rising — while the
+      trainer restarts (its shipper resumes the version stream past
+      the manifest tail, forced full) or is abandoned.
+
+    Ranks: 0 = trainer, 1..N = replicas; children learn their role via
+    ``SMTPU_SERVE_ROLE`` and the stream location via ``SMTPU_SHIP_DIR``
+    (default ``<fleet_dir>/ship``).  Returns 0 when every rank finished
+    rc=0, else the first abandoned rank's rc.
+    """
+    from swiftmpi_tpu.obs.collector import SupervisorLog
+
+    nprocs = n_replicas + 1
+    os.makedirs(fleet_dir, exist_ok=True)
+    ship_dir = ship_dir or os.path.join(fleet_dir, "ship")
+    os.makedirs(ship_dir, exist_ok=True)
+    port = port or _free_port()
+    if trainer_restarts is None:
+        trainer_restarts = max_restarts
+    fleet_log = SupervisorLog(fleet_dir)
+    fleet_log.event("world_start", nprocs=nprocs, mode="serve_fleet",
+                    n_replicas=n_replicas, ship_dir=ship_dir,
+                    max_restarts=max_restarts,
+                    trainer_restarts=trainer_restarts, argv=list(argv))
+
+    def role_of(rank: int) -> str:
+        return "trainer" if rank == 0 else "replica"
+
+    def budget_of(rank: int) -> int:
+        return trainer_restarts if rank == 0 else max_restarts
+
+    print_lock = threading.Lock()
+    procs: Dict[int, subprocess.Popen] = {}
+    threads: List[threading.Thread] = []
+    attempts: Dict[int, int] = {r: 0 for r in range(nprocs)}
+    last_start: Dict[int, float] = {}
+    restart_due: Dict[int, float] = {}
+    finished: set = set()
+    abandoned: set = set()
+    terminated: set = set()
+    rc_final = 0
+
+    def reader(rank: int, stream) -> None:
+        try:
+            for line in stream:
+                with print_lock:
+                    sys.stdout.write(f"[rank {rank}] {line}")
+                    sys.stdout.flush()
+        except (ValueError, OSError):
+            pass
+
+    def spawn(rank: int) -> None:
+        env = _child_env(os.environ, port, rank, nprocs, cpu_devices,
+                         fleet_dir)
+        env[ENV_SERVE_ROLE] = role_of(rank)
+        env[ENV_SHIP_DIR] = ship_dir
+        p = subprocess.Popen(argv, env=env, stdout=subprocess.PIPE,
+                             stderr=subprocess.STDOUT, text=True)
+        procs[rank] = p
+        last_start[rank] = time.monotonic()
+        fleet_log.event("spawn", rank=rank, pid=p.pid,
+                        role=role_of(rank), attempt=attempts[rank])
+        t = threading.Thread(target=reader, args=(rank, p.stdout),
+                             daemon=True)
+        t.start()
+        threads.append(t)
+
+    def note_exit(rank: int, p, code: int) -> None:
+        fleet_log.event("exit", rank=rank, pid=p.pid,
+                        rc=_normalize_rc(code), role=role_of(rank),
+                        by_supervisor=rank in terminated,
+                        attempt=attempts[rank])
+        terminated.discard(rank)
+
+    for rank in range(nprocs):
+        spawn(rank)
+    try:
+        while procs or restart_due:
+            now = time.monotonic()
+            for rank, p in list(procs.items()):
+                code = p.poll()
+                if code is None:
+                    continue
+                note_exit(rank, p, code)
+                del procs[rank]
+                if code == 0:
+                    finished.add(rank)
+                    continue
+                if stable_after_s is not None and attempts[rank] \
+                        and now - last_start[rank] >= stable_after_s:
+                    fleet_log.event("stable_reset", rank=rank,
+                                    ran_s=now - last_start[rank],
+                                    attempt=attempts[rank])
+                    attempts[rank] = 0
+                if attempts[rank] >= budget_of(rank):
+                    rcn = _normalize_rc(code)
+                    print(f"[launch] serve {role_of(rank)} rank {rank} "
+                          f"out of restart budget ({budget_of(rank)}); "
+                          f"abandoned rc={rcn}", file=sys.stderr)
+                    fleet_log.event("rank_abandoned", rank=rank,
+                                    role=role_of(rank), rc=rcn)
+                    abandoned.add(rank)
+                    rc_final = rc_final or rcn
+                else:
+                    delay = min(backoff_s * (backoff_factor
+                                             ** attempts[rank]),
+                                backoff_max_s)
+                    attempts[rank] += 1
+                    fleet_log.event("restart_rank", rank=rank,
+                                    role=role_of(rank),
+                                    rc=_normalize_rc(code),
+                                    attempt=attempts[rank],
+                                    delay_s=delay)
+                    restart_due[rank] = now + delay
+            for rank, due in list(restart_due.items()):
+                if now >= due:
+                    del restart_due[rank]
+                    spawn(rank)
+            time.sleep(poll_s)
+        fleet_log.event("world_exit", rc=rc_final,
+                        finished=sorted(finished),
+                        abandoned=sorted(abandoned))
+        return rc_final
+    finally:
+        for rank, p in procs.items():
+            if p.poll() is None:
+                terminated.add(rank)
+                p.kill()
+        for rank, p in procs.items():
+            try:
+                p.wait(timeout=kill_grace_s)
+            except subprocess.TimeoutExpired:
+                pass
+            note_exit(rank, p, p.poll() if p.poll() is not None else -9)
+        for t in threads:
+            t.join(timeout=2.0)
+        for rank, p in procs.items():
+            try:
+                p.stdout.close()
+            except (ValueError, OSError):
+                pass
+        for t in threads:
+            t.join(timeout=1.0)
+        fleet_log.close()
+
+
 def main(args: Optional[List[str]] = None) -> int:
     from swiftmpi_tpu.utils.cmdline import CMDLine
 
@@ -668,6 +848,16 @@ def main(args: Optional[List[str]] = None) -> int:
     cmd.registerParameter("dead-after",
                           "elastic hung-rank detection: kill a rank "
                           "silent this many seconds")
+    cmd.registerParameter("serve",
+                          "serve-fleet mode (ISSUE 17): N replica "
+                          "reader ranks beside one trainer rank; "
+                          "requires -fleet-dir")
+    cmd.registerParameter("ship-dir",
+                          "snapshot ship directory (default "
+                          "<fleet-dir>/ship)")
+    cmd.registerParameter("trainer-restarts",
+                          "serve-fleet trainer restart budget "
+                          "(default: -max-restarts)")
     cmd.registerParameter("fleet-dir",
                           "fleet telemetry directory (ISSUE 12)")
     cmd.registerParameter("profile-at",
@@ -694,6 +884,25 @@ def main(args: Optional[List[str]] = None) -> int:
                  if cmd.hasParameter("fleet-dir") else None)
     stable_after_s = (float(cmd.get_value("stable-after"))
                       if cmd.hasParameter("stable-after") else None)
+    if cmd.hasParameter("serve") and int(cmd.get_value("serve")):
+        if not fleet_dir:
+            print("launch: -serve requires -fleet-dir (the supervisor "
+                  "log and ship stream live there)", file=sys.stderr)
+            return 2
+        return supervise_serve(
+            prog, int(cmd.get_value("serve")), fleet_dir=fleet_dir,
+            ship_dir=(cmd.get_value("ship-dir")
+                      if cmd.hasParameter("ship-dir") else None),
+            cpu_devices=cpu,
+            port=int(cmd.get_value("port"))
+            if cmd.hasParameter("port") else 0,
+            max_restarts=int(cmd.get_value("max-restarts"))
+            if cmd.hasParameter("max-restarts") else 2,
+            trainer_restarts=int(cmd.get_value("trainer-restarts"))
+            if cmd.hasParameter("trainer-restarts") else None,
+            backoff_s=float(cmd.get_value("backoff"))
+            if cmd.hasParameter("backoff") else 0.5,
+            stable_after_s=stable_after_s)
     if cmd.hasParameter("elastic") and int(cmd.get_value("elastic")):
         if not fleet_dir:
             print("launch: -elastic requires -fleet-dir (the member "

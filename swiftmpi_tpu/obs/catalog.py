@@ -60,6 +60,12 @@ SERIES = frozenset({
     "serve/queries", "serve/rows_read", "serve/hits", "serve/misses",
     "serve/topk_queries", "serve/latency_ms", "serve/snapshots",
     "serve/snapshot_version", "serve/staleness_steps",
+    # snapshot shipping (serve/shipper.py, ISSUE 17): trainer-side
+    # publish kind/byte counters (delta_fmt carries a fmt= label) and
+    # the replica-side replay gauges ({replica=r<rank>} labeled)
+    "serve/delta_publishes", "serve/delta_bytes", "serve/delta_fmt",
+    "serve/full_publishes", "serve/full_bytes", "serve/ship_version",
+    "serve/replica_version", "serve/replica_lag", "serve/staleness_s",
     # control plane (control/controller.py)
     "control/evaluations", "control/decisions",
     "control/decisions_applied", "control/sketch_observed",
@@ -98,6 +104,9 @@ SERIES = frozenset({
     "elastic/epoch", "elastic/loss", "elastic/rows_owned",
     "elastic/migration_bytes",
     "fleet/epoch", "fleet/reconverge_steps", "fleet/migration_bytes",
+    # serve-fleet mirrors (obs/collector.py serve_view, ISSUE 17)
+    "fleet/serve_replicas", "fleet/serve_qps", "fleet/serve_lag_max",
+    "fleet/serve_version",
 }) | frozenset("transfer/" + k for k in TRANSFER_KEYS)
 
 #: Dynamic-name families: an f-string series name passes the catalog

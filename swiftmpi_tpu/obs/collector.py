@@ -640,6 +640,105 @@ class FleetCollector:
                 "epoch_first_step": first_at,
                 "laggards": laggards}
 
+    # -- serve fleet plane (serve/shipper.py, ISSUE 17) --------------------
+    @staticmethod
+    def _member_serve(member: dict) -> Optional[dict]:
+        """One member's serving digest, or None when it never published
+        a ``serve/*`` series.  Role comes from which side of the ship
+        stream the member booked: ``serve/ship_version`` → trainer,
+        ``serve/replica_version`` → replica."""
+        gauges: Dict[str, float] = {}
+        counters: Dict[str, float] = {}
+        bounds = None
+        hist_counts: Optional[List[int]] = None
+        for s in member["_streams"]:
+            for r in s.records:
+                for gkey, v in (r.get("gauges") or {}).items():
+                    name, _ = parse_series_key(gkey)
+                    if name.startswith("serve/"):
+                        gauges[name] = float(v)     # last write wins
+                for ckey, delta in (r.get("counters") or {}).items():
+                    name, _ = parse_series_key(ckey)
+                    if name.startswith("serve/"):
+                        counters[name] = (counters.get(name, 0.0)
+                                          + float(delta))
+                for hkey, h in (r.get("hists") or {}).items():
+                    name, _ = parse_series_key(hkey)
+                    if name != "serve/latency_ms":
+                        continue
+                    if h.get("bounds") is not None:
+                        bounds = list(h["bounds"])
+                    cs = h.get("counts") or []
+                    if hist_counts is None:
+                        hist_counts = list(cs)
+                    else:
+                        for i, c in enumerate(cs):
+                            hist_counts[i] += c
+        if not gauges and not counters:
+            return None
+        role = ("trainer" if "serve/ship_version" in gauges
+                else "replica" if "serve/replica_version" in gauges
+                or "serve/queries" in counters else None)
+        span_s = max((member["last_seen"] or 0.0)
+                     - (member["first_seen"] or 0.0), 1e-9)
+        queries = counters.get("serve/queries", 0.0)
+        hits = counters.get("serve/hits", 0.0)
+        rows = counters.get("serve/rows_read", 0.0)
+        p50 = p99 = None
+        if bounds is not None and hist_counts:
+            from swiftmpi_tpu.obs.registry import quantile_from_buckets
+            p50 = quantile_from_buckets(bounds, hist_counts, 0.50)
+            p99 = quantile_from_buckets(bounds, hist_counts, 0.99)
+        return {
+            "role": role,
+            "version": gauges.get("serve/replica_version",
+                                  gauges.get("serve/ship_version")),
+            "lag": gauges.get("serve/replica_lag"),
+            "staleness_s": gauges.get("serve/staleness_s"),
+            "queries": int(queries),
+            "qps": queries / span_s,
+            "p50_ms": p50, "p99_ms": p99,
+            "hit_ratio": (hits / rows) if rows else None,
+            "delta_publishes": int(
+                counters.get("serve/delta_publishes", 0)),
+            "full_publishes": int(
+                counters.get("serve/full_publishes", 0)),
+            "delta_bytes": int(counters.get("serve/delta_bytes", 0)),
+            "full_bytes": int(counters.get("serve/full_bytes", 0)),
+        }
+
+    def serve_view(self, at: Optional[float] = None) -> Optional[dict]:
+        """Fleet digest of the serve-fleet plane, or None when no member
+        published ``serve/*`` (a training-only world).  Aggregate qps
+        sums the replica readers; version/lag expose the delta-chain
+        replay state the staleness bound rides on."""
+        members = self.members()
+        per = {k: v for k, m in members.items()
+               if (v := self._member_serve(m)) is not None}
+        if not per:
+            return None
+        replicas = [k for k, v in per.items() if v["role"] == "replica"]
+        versions = [v["version"] for v in per.values()
+                    if v["version"] is not None]
+        lags = [v["lag"] for v in per.values() if v["lag"] is not None]
+        stale = [v["staleness_s"] for v in per.values()
+                 if v["staleness_s"] is not None]
+        return {
+            "members": per,
+            "serve_replicas": len(replicas),
+            "serve_qps_total": sum(
+                per[k]["qps"] for k in replicas),
+            "serve_version": max(versions) if versions else None,
+            "serve_lag_max": max(lags) if lags else 0.0,
+            "serve_staleness_max_s": max(stale) if stale else 0.0,
+            "delta_publishes": sum(
+                v["delta_publishes"] for v in per.values()),
+            "full_publishes": sum(
+                v["full_publishes"] for v in per.values()),
+            "delta_bytes": sum(v["delta_bytes"] for v in per.values()),
+            "full_bytes": sum(v["full_bytes"] for v in per.values()),
+        }
+
     # -- fleet summary -----------------------------------------------------
     @staticmethod
     def _p50(vals: List[float]) -> float:
@@ -740,7 +839,19 @@ class FleetCollector:
             "fleet_epoch": ev["fleet_epoch"],
             "fleet_reconverge_steps": ev["fleet_reconverge_steps"],
             "migration_bytes": ev["migration_bytes"],
-        } if (ev := self.elastic_view(at)) is not None else {})
+        } if (ev := self.elastic_view(at)) is not None else {}) | ({
+            # serve-fleet plane (ISSUE 17) — same conditional-merge
+            # contract: training-only summaries are byte-identical
+            "serve_replicas": sv["serve_replicas"],
+            "serve_qps_total": sv["serve_qps_total"],
+            "serve_version": sv["serve_version"],
+            "serve_lag_max": sv["serve_lag_max"],
+            "serve_staleness_max_s": sv["serve_staleness_max_s"],
+            "serve_delta_publishes": sv["delta_publishes"],
+            "serve_full_publishes": sv["full_publishes"],
+            "serve_delta_bytes": sv["delta_bytes"],
+            "serve_full_bytes": sv["full_bytes"],
+        } if (sv := self.serve_view(at)) is not None else {})
 
     # -- merged timeline ---------------------------------------------------
     def _health_transitions(self, at: float) -> List[dict]:
@@ -867,3 +978,12 @@ class FleetCollector:
             if s["fleet_reconverge_steps"] is not None:
                 reg.gauge("fleet/reconverge_steps").set(
                     float(s["fleet_reconverge_steps"]))
+        if "serve_replicas" in s:
+            reg.gauge("fleet/serve_replicas").set(
+                float(s["serve_replicas"]))
+            reg.gauge("fleet/serve_qps").set(float(s["serve_qps_total"]))
+            reg.gauge("fleet/serve_lag_max").set(
+                float(s["serve_lag_max"]))
+            if s["serve_version"] is not None:
+                reg.gauge("fleet/serve_version").set(
+                    float(s["serve_version"]))

@@ -20,6 +20,14 @@ the read side the training machinery earns.  Three pieces:
   one normalized ``(Q, d) @ (d, V)`` matmul + ``argpartition`` over the
   snapshot's host rows (``device=True`` opts into the jitted MXU kernel
   under ``jax.named_scope("serve/topk")`` for trainer-thread bulk use).
+* :mod:`~swiftmpi_tpu.serve.shipper` — ``SnapshotShipper`` /
+  ``SnapshotReplica``: the cross-process half (ISSUE 17).  The trainer
+  ships each published snapshot into a version-chained delta stream
+  (full base + PR-10-encoded row deltas via the shared
+  ``transfer.delta`` codec); replica processes replay the chain into a
+  local host table exposing the publisher's reader surface, so
+  ``EmbeddingReader(replica)`` serves unchanged behind a cross-process
+  staleness bound (``launch.py -serve N`` runs the fleet).
 
 Metrics land in the ``obs`` registry under ``serve/*`` (qps, hit ratio,
 staleness, latency histograms) when telemetry is on; the readers also
@@ -27,8 +35,10 @@ keep always-on plain-int counters for the bench cell.
 """
 
 from swiftmpi_tpu.serve.reader import EmbeddingReader, LruTailFront
+from swiftmpi_tpu.serve.shipper import SnapshotReplica, SnapshotShipper
 from swiftmpi_tpu.serve.snapshot import (SnapshotPublisher, SnapshotUnavailable,
                                          TableSnapshot)
 
 __all__ = ["EmbeddingReader", "LruTailFront", "SnapshotPublisher",
-           "SnapshotUnavailable", "TableSnapshot"]
+           "SnapshotReplica", "SnapshotShipper", "SnapshotUnavailable",
+           "TableSnapshot"]

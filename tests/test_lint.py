@@ -367,6 +367,40 @@ def test_telemetry_trips_on_undeclared_compile_series(tmp_path):
     assert "compile/retracez" in new[0].message
 
 
+def test_telemetry_covers_ship_series(tmp_path):
+    """ISSUE 17 satellite: the snapshot shipper/replica book catalog-
+    declared series like any other plane — the delta/full publish
+    counters, the fmt-labeled decision counter, the version-chain
+    gauges, the replica-labeled replay gauges, and the fleet serve
+    mirrors all pass as written."""
+    new = lint_src(tmp_path, "pkg/serve/shipper.py", """
+    def book(reg, dec, ident):
+        reg.counter("serve/delta_publishes").inc(1)
+        reg.counter("serve/delta_bytes").inc(100)
+        reg.counter("serve/delta_fmt", fmt=dec).inc(1)
+        reg.counter("serve/full_publishes").inc(1)
+        reg.counter("serve/full_bytes").inc(100)
+        reg.gauge("serve/ship_version").set(3)
+        reg.gauge("serve/replica_version", replica=ident).set(3)
+        reg.gauge("serve/replica_lag", replica=ident).set(0)
+        reg.gauge("serve/staleness_s", replica=ident).set(0.1)
+        reg.gauge("fleet/serve_replicas").set(3)
+        reg.gauge("fleet/serve_qps").set(400.0)
+        reg.gauge("fleet/serve_lag_max").set(0)
+        reg.gauge("fleet/serve_version").set(3)
+    """)
+    assert new == []
+
+
+def test_telemetry_trips_on_undeclared_ship_series(tmp_path):
+    new = lint_src(tmp_path, "pkg/serve/shipper.py", """
+    def book(reg):
+        reg.counter("serve/delta_bytez").inc(100)
+    """)
+    assert rules_of(new) == {"TELEMETRY-CATALOG"}
+    assert "serve/delta_bytez" in new[0].message
+
+
 def test_telemetry_checks_both_ifexp_branches(tmp_path):
     new = lint_src(tmp_path, "pkg/thing.py", """
     def record(reg, ok):

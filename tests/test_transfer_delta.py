@@ -1,0 +1,146 @@
+"""Shared delta codec tests (transfer/delta.py, ISSUE 17): byte-model
+golden parity for every wire format, decode round-trips, the
+dense/bitmap demotion rules, the atomic writer, and the
+re-export-compat contract that keeps ``cluster.elastic`` importers
+working after the extraction."""
+
+import os
+
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.transfer.delta import (atomic_savez, decode_delta,
+                                         delta_wire_bytes, encode_delta)
+
+
+# -- byte-model golden parity ----------------------------------------------
+# Frozen numbers, not re-derived from the pricing helper: a pricing
+# change that silently shifts the shipped-byte model must fail here.
+
+def test_sparse_golden_bytes_and_lossless_roundtrip():
+    keys = np.arange(10, dtype=np.int64)
+    vals = np.random.default_rng(0).normal(
+        size=(10, 8)).astype(np.float32)
+    enc = encode_delta(keys, vals, capacity=4096, quant="off")
+    assert str(np.asarray(enc["format"])) == "sparse"
+    # eff * (key + row) = 10 * (4 + (4 + 8*4)) = 400
+    assert delta_wire_bytes(enc) == 400
+    k, v = decode_delta(enc)
+    np.testing.assert_array_equal(k, keys)
+    np.testing.assert_array_equal(v, vals)     # f32 pairs: lossless
+
+
+def test_sparse_q_golden_bytes_and_bounded_error():
+    rng = np.random.default_rng(1)
+    keys = np.arange(64, dtype=np.int64)
+    vals = rng.normal(size=(64, 16)).astype(np.float32)
+    enc = encode_delta(keys, vals, capacity=1 << 20, quant="int8")
+    assert str(np.asarray(enc["format"])) == "sparse_q"
+    # eff * (key + (scale + int8*d + pad)) = 64 * (4 + (4 + 16 + 4))
+    assert delta_wire_bytes(enc) == 64 * 28
+    _, v = decode_delta(enc)
+    # per-row scale = max|v|/127: error bounded by half a quant step
+    step = np.max(np.abs(vals), axis=1, keepdims=True) / 127.0
+    assert np.all(np.abs(v - vals) <= step * 0.5 + 1e-7)
+
+
+def test_sparse_q_zero_row_is_safe():
+    enc = encode_delta([5], np.zeros((1, 4), np.float32),
+                       capacity=1 << 20, quant="int8")
+    if str(np.asarray(enc["format"])) == "sparse_q":
+        _, v = decode_delta(enc)
+        np.testing.assert_array_equal(v, np.zeros((1, 4), np.float32))
+
+
+def test_bitmap_golden_bytes_and_roundtrip():
+    # bitmap is only priced with quant armed (the 4-way menu); narrow
+    # rows + a touched set dense enough that dropping the per-row key
+    # beats both f32 pairs and the guarded bf16 rung
+    cap, d = 1024, 2
+    pos = np.arange(256, dtype=np.int64)
+    vals = np.random.default_rng(2).normal(
+        size=(len(pos), d)).astype(np.float32)
+    enc = encode_delta(pos, vals, capacity=cap, quant="bf16",
+                       positions=pos)
+    assert str(np.asarray(enc["format"])) == "bitmap"
+    # capacity/8 mask + eff * values = 128 + 256 * 8 = 2176
+    assert delta_wire_bytes(enc) == cap // 8 + len(pos) * (d * 4)
+    k, v = decode_delta(enc)
+    np.testing.assert_array_equal(k, pos)
+    np.testing.assert_array_equal(v, vals)   # values ride f32: lossless
+
+
+def test_bitmap_demotes_to_sparse_without_positions():
+    # the same dense shape with NO dense position space offered must
+    # not pick bitmap (nothing to mask over)
+    cap, d = 256, 8
+    keys = np.arange(0, cap, 2, dtype=np.int64)
+    vals = np.zeros((len(keys), d), np.float32)
+    enc = encode_delta(keys, vals, capacity=cap, quant="off")
+    assert str(np.asarray(enc["format"])) != "bitmap"
+
+
+def test_dense_demotes_to_sparse():
+    # every row touched: window pricing says dense, but a delta payload
+    # must never ship untouched-row framing — the codec demotes
+    cap, d = 64, 4
+    keys = np.arange(cap, dtype=np.int64)
+    vals = np.ones((cap, d), np.float32)
+    enc = encode_delta(keys, vals, capacity=cap, quant="off")
+    assert str(np.asarray(enc["format"])) in ("sparse", "bitmap")
+
+
+def test_empty_delta_roundtrip():
+    enc = encode_delta([], np.zeros((0, 8), np.float32), capacity=256)
+    k, v = decode_delta(enc)
+    assert len(k) == 0 and v.shape == (0, 8)
+    assert delta_wire_bytes(enc) == 0
+
+
+# -- atomic writer ----------------------------------------------------------
+
+def test_atomic_savez_replaces_whole_and_leaves_no_tmp(tmp_path):
+    path = str(tmp_path / "payload.npz")
+    a = np.arange(12, dtype=np.float32).reshape(3, 4)
+    atomic_savez(path, rows=a)
+    atomic_savez(path, rows=a * 2)       # overwrite: last replace wins
+    with np.load(path) as z:
+        np.testing.assert_array_equal(z["rows"], a * 2)
+    assert os.listdir(tmp_path) == ["payload.npz"]   # tmp cleaned up
+
+
+# -- re-export compat (the extraction contract) ----------------------------
+
+def test_elastic_reexports_are_the_shared_codec():
+    """cluster.elastic's codec names must BE transfer.delta's — object
+    identity, so the migration path and the snapshot shipper can never
+    price or encode differently."""
+    from swiftmpi_tpu.cluster import elastic
+    from swiftmpi_tpu.transfer import delta
+
+    assert elastic.encode_delta is delta.encode_delta
+    assert elastic.decode_delta is delta.decode_delta
+    assert elastic.delta_wire_bytes is delta.delta_wire_bytes
+    assert elastic._atomic_savez is delta.atomic_savez
+
+
+@pytest.mark.parametrize("quant", ["off", "int8", "bf16"])
+def test_golden_parity_both_import_paths(quant):
+    """Same inputs through both import paths -> byte-identical payloads
+    (the satellite's golden parity check: extraction changed nothing)."""
+    from swiftmpi_tpu.cluster.elastic import encode_delta as enc_el
+
+    rng = np.random.default_rng(3)
+    keys = np.sort(rng.choice(4096, size=32, replace=False)).astype(
+        np.int64)
+    vals = rng.normal(size=(32, 8)).astype(np.float32)
+    a = encode_delta(keys, vals, capacity=4096, quant=quant)
+    b = enc_el(keys, vals, capacity=4096, quant=quant)
+    assert sorted(a) == sorted(b)
+    for k in a:
+        np.testing.assert_array_equal(np.asarray(a[k]),
+                                      np.asarray(b[k]))
+    ka, va = decode_delta(a)
+    kb, vb = decode_delta(b)
+    np.testing.assert_array_equal(ka, kb)
+    np.testing.assert_array_equal(va, vb)
