@@ -578,7 +578,7 @@ W2V_1M_VOCAB = 1_000_000
 
 def build_w2v_1m_model(device, stencil=False, hybrid=False,
                        window_steps=1, pipeline=0, control=None,
-                       wire_quant=None):
+                       wire_quant=None, wire_sketch=False):
     """The 1M-vocab cell's model (BASELINE config #3 shape: demo.conf
     hyperparameters over a ~1M-word Zipf vocabulary / 1.3M-row table).
     ONE builder shared by the bench cell and the profiler ablation
@@ -617,7 +617,14 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
     wire_quant: int8|bf16) — the 4-way crossover may then pick the
     quantized sparse rung (per-bucket scales + error-feedback
     residuals) or the bitmap rung.  The BENCH_ONLY=scale_qwire cell's
-    shape; ``None`` keeps the lossless PR-9 wire."""
+    shape; ``None`` keeps the lossless PR-9 wire.
+
+    ``wire_sketch``: admit the counting-sketch index rung ([cluster]
+    wire_sketch: 1) — the TrafficPlan pricer may then pick
+    ``sparse_sketch`` (bucketed uint16 counts + uint8 offsets instead
+    of i32 indices; lossless, EF-compatible) where its byte model beats
+    sparse/bitmap/sparse_q.  The BENCH_ONLY=scale_sketchwire cell's
+    shape."""
     import jax
     import numpy as np
     from swiftmpi_tpu.cluster.cluster import Cluster
@@ -636,7 +643,8 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
                     **({"push_window": int(window_steps)}
                        if window_steps > 1 else {}),
                     **({"wire_quant": str(wire_quant)}
-                       if wire_quant else {})},
+                       if wire_quant else {}),
+                    **({"wire_sketch": 1} if wire_sketch else {})},
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
                      "sample": -1, "learning_rate": 0.05,
                      # BENCH_SCALE_SHARED=1: the batch-shared negative
@@ -681,7 +689,7 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
 
 
 def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
-                  window_steps=1, wire_quant=None):
+                  window_steps=1, wire_quant=None, wire_sketch=False):
     """BASELINE config #3 shape: the same fused step over a ~1M-word
     vocabulary (1.3M-row table).  Batches are synthesized directly in
     vocab-index space (uniform centers/contexts, Zipf counts for the
@@ -698,7 +706,8 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
     V = W2V_1M_VOCAB
     model, rng = build_w2v_1m_model(device, stencil=stencil, hybrid=hybrid,
                                     window_steps=window_steps,
-                                    wire_quant=wire_quant)
+                                    wire_quant=wire_quant,
+                                    wire_sketch=wire_sketch)
     tr0 = None
     if hybrid or window_steps > 1:
         # arm the traffic counters BEFORE the jit build: the per-step
@@ -784,13 +793,16 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
         out["wire_bytes_per_step"] = round(tr["wire_bytes"] / steps, 1)
         out["window_sparse"] = tr["window_sparse"]
         out["window_dense"] = tr["window_dense"]
-        # the 4-way decision mix: which wire format each window closed
-        # on (sparse_q/bitmap booked at their ENCODED size) — the
-        # budget gate's decision-mix floor reads these next to the
-        # wire_quant detail
-        for fmt in ("dense", "sparse", "q", "bitmap"):
+        # the 5-way decision mix: which wire format each window closed
+        # on (sparse_q/bitmap/sketch booked at their ENCODED size) —
+        # the budget gate's decision-mix floor reads these next to the
+        # wire_quant / wire_sketch detail
+        for fmt in ("dense", "sparse", "q", "bitmap", "sketch"):
             out[f"window_fmt_{fmt}"] = tr.get(f"window_fmt_{fmt}", 0)
         out["wire_quant"] = str(wire_quant) if wire_quant else "off"
+        out["wire_sketch"] = 1 if wire_sketch else 0
+        out["plan_compiles"] = tr.get("plan_compiles", 0)
+        out["plan_cache_hits"] = tr.get("plan_cache_hits", 0)
         out["coalesced_rows_in"] = tr["coalesced_rows_in"]
         out["coalesced_rows_out"] = tr["coalesced_rows_out"]
         if tr["coalesced_rows_in"]:
@@ -801,6 +813,34 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
                          hbm_bytes=_w2v_step_bytes(model, B),
                          fn=("w2v_multi", "w2v_step")))
     return out
+
+
+def _sketch_price_evidence():
+    """Static 5-way pricer table at the two canonical mid-density Zipf
+    shapes (capacity 1024, E[unique] = 64 rows/window; d=1 scalar rows
+    and d=32 embedding rows) — the regime the sparse_sketch rung exists
+    for, recorded next to the live cell so the artifact carries the
+    byte-model crossover, not just the decision it produced.  At d=1
+    the sketch (584 B) undercuts the best lossless alternative (bitmap,
+    640 B) AND the guarded sparse_q price; at d=32 it still beats every
+    lossless rung (8520 vs bitmap 8576) while int8 sparse_q wins the
+    overall pick — exactly the lossless/lossy boundary the guard
+    documents."""
+    from swiftmpi_tpu.parameter.key_index import price_window_formats
+    evidence = {}
+    for d in (1, 32):
+        row_bytes = 4 + 4 * d + 4          # i32 index + f32 row + counts
+        qrb = 4 + (d + 4) + 4              # int8 values + scale + counts
+        decision, prices = price_window_formats(
+            64, 1024, row_bytes, expected_unique=64.0,
+            quant="int8", quant_row_bytes=qrb, sketch=True)
+        lossless = min(prices[k] for k in ("sparse", "bitmap"))
+        evidence[f"d{d}"] = {
+            "decision": decision,
+            **{k: int(v) for k, v in sorted(prices.items())},
+            "sketch_below_best_lossless":
+                bool(prices["sparse_sketch"] < lossless)}
+    return evidence
 
 
 def _bench_w2v_1m_pipeline(device, timed_calls):
@@ -2153,6 +2193,28 @@ def child_main(which: str) -> None:
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
+    if os.environ.get("BENCH_ONLY") == "scale_sketchwire":
+        # sketch-indexed window wire at 1M vocab: the w2v_1m_qwire
+        # shape with [cluster] wire_sketch armed on top of wire_quant,
+        # so the TrafficPlan pricer runs the full 5-way ladder and may
+        # pick the sparse_sketch rung — bucketed uint16 counts + uint8
+        # in-bucket offsets instead of i32 index words; lossless and
+        # EF-compatible.  Own child + own key; identical declared
+        # rendering/window to w2v_1m_qwire, so the wire_bytes_per_step
+        # delta between the two cells is the index-compression win and
+        # window_fmt_sketch proves engagement.  sketch_pricing embeds
+        # the static d=1/d=32 mid-density crossover evidence (sketch
+        # below the best lossless rung) next to the live counters
+        win = int(os.environ.get("BENCH_WINDOW", INNER_STEPS))
+        wq = os.environ.get("BENCH_WIRE_QUANT", "int8")
+        cell = _bench_w2v_1m(device, max(timed // 2, 1), hybrid=True,
+                             window_steps=win, wire_quant=wq,
+                             wire_sketch=True)
+        cell["sketch_pricing"] = _sketch_price_evidence()
+        out["w2v_1m_sketchwire"] = cell
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
     if os.environ.get("BENCH_ONLY") == "scale_fused":
         # on-chip Pallas data plane A/B at 1M vocab: the fused stencil-
         # gather kernel vs the XLA chain, both arms inside ONE cell
@@ -2606,6 +2668,8 @@ _SECONDARY_CELLS = (
     ("w2v_1m_hybrid", "w2v_1m_hybrid", "words_per_sec", "words/s"),
     ("w2v_1m_window", "w2v_1m_window", "words_per_sec", "words/s"),
     ("w2v_1m_qwire", "w2v_1m_qwire", "words_per_sec", "words/s"),
+    ("w2v_1m_sketchwire", "w2v_1m_sketchwire", "words_per_sec",
+     "words/s"),
     ("w2v_1m_pipeline", "w2v_1m_pipeline", "words_per_sec", "words/s"),
     ("w2v_1m_fused", "w2v_1m_fused", "words_per_sec", "words/s"),
     ("w2v_fleet8", "w2v_fleet8", "words_per_sec", "words/s"),

@@ -62,7 +62,9 @@ TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
                    "delta_bytes_per_publish")
 DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "window_fmt_sparse", "window_fmt_q",
-                  "window_fmt_bitmap", "wire_quant", "coalesce_ratio",
+                  "window_fmt_bitmap", "window_fmt_sketch",
+                  "wire_quant", "wire_sketch",
+                  "plan_compiles", "plan_cache_hits", "coalesce_ratio",
                   "push_window", "host_stall_ms", "queue_depth",
                   "pipeline", "speedup_vs_off", "qps", "p50_ms",
                   "hit_ratio", "streams", "snapshots",
@@ -136,7 +138,8 @@ def load_telemetry_cells(path: str) -> dict:
         cell["stall_ms_per_step"] = t["stall_ms_per_step"]
     for decision in ("window_sparse", "window_dense", "window_fmt_dense",
                      "window_fmt_sparse", "window_fmt_q",
-                     "window_fmt_bitmap"):
+                     "window_fmt_bitmap", "window_fmt_sketch",
+                     "plan_compiles", "plan_cache_hits"):
         total = sum(m.get(decision, 0.0) for m in t["transfer"].values())
         if total:
             cell[decision] = total
@@ -343,24 +346,29 @@ def compare(base: dict, cand: dict, tolerance: float,
 
 
 def decision_mix_violations(cells: dict) -> list:
-    """Cells that claim wire compression is on (``wire_quant`` detail
-    present and not ``off``) and booked window decisions, yet never once
-    chose an encoded format — the calibration equivalent of a feature
-    flag that silently no-ops.  Such a cell means the crossover model
-    and the live traffic disagree so badly the quantized rung never
+    """Cells that claim wire compression is on (``wire_quant`` not
+    ``off``, or ``wire_sketch`` truthy) and booked window decisions, yet
+    never once chose an encoded format — the calibration equivalent of a
+    feature flag that silently no-ops.  Such a cell means the crossover
+    model and the live traffic disagree so badly the armed rung never
     fires, which is a gate failure, not a tuning preference."""
     bad = []
     fmt_keys = ("window_fmt_dense", "window_fmt_sparse",
-                "window_fmt_q", "window_fmt_bitmap")
+                "window_fmt_q", "window_fmt_bitmap",
+                "window_fmt_sketch")
     for cell, m in sorted(cells.items()):
         quant = m.get("wire_quant")
-        if quant in (None, "off"):
+        sketch = m.get("wire_sketch")
+        armed = quant not in (None, "off") or bool(sketch)
+        if not armed:
             continue
         total = sum(float(m.get(k, 0.0)) for k in fmt_keys)
         encoded = float(m.get("window_fmt_q", 0.0)) \
-            + float(m.get("window_fmt_bitmap", 0.0))
+            + float(m.get("window_fmt_bitmap", 0.0)) \
+            + float(m.get("window_fmt_sketch", 0.0))
         if total > 0 and encoded <= 0:
-            bad.append((cell, quant, total))
+            knob = quant if quant not in (None, "off") else "sketch"
+            bad.append((cell, knob, total))
     return bad
 
 

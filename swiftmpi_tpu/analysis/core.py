@@ -193,6 +193,11 @@ def load_baseline(path: str) -> Dict[str, dict]:
 
 def write_baseline(path: str, findings: Iterable[Finding],
                    justification: str = "TODO: justify or fix") -> int:
+    """Write a baseline of grandfathered findings.  The default
+    ``justification`` is a deliberate placeholder: an entry still
+    carrying it (or any empty/TODO text) is NOT a justified suppression,
+    and :func:`run_lint` surfaces it as a ``BASELINE-JUSTIFY`` finding
+    until a real reason is written in."""
     entries = [{"rule": f.rule, "path": f.path, "line_hint": f.line,
                 "fingerprint": f.fingerprint,
                 "justification": justification}
@@ -202,6 +207,14 @@ def write_baseline(path: str, findings: Iterable[Finding],
                   indent=2, sort_keys=True)
         f.write("\n")
     return len(entries)
+
+
+def _unjustified(entry: dict) -> bool:
+    """True when a baseline entry's justification is missing, blank, or
+    still the ``write_baseline`` placeholder (any text starting with
+    ``TODO``, case-insensitive)."""
+    j = str(entry.get("justification") or "").strip()
+    return not j or j.upper().startswith("TODO")
 
 
 # -- runner -----------------------------------------------------------------
@@ -251,7 +264,25 @@ def run_lint(paths: Optional[Sequence[str]] = None,
             k = seen.get(key, 0)
             seen[key] = k + 1
             f.fingerprint = fingerprint(f.rule, lf.rel, text, k)
-            (old if f.fingerprint in baseline else new).append(f)
+            entry = baseline.get(f.fingerprint)
+            if entry is None:
+                new.append(f)
+                continue
+            old.append(f)
+            if _unjustified(entry):
+                # a suppression without a reason is not a suppression —
+                # the placeholder write_baseline stamps in must be
+                # replaced by a human-written justification, or the
+                # finding keeps gating
+                bj = Finding(
+                    "BASELINE-JUSTIFY", f.path, f.line, f.col,
+                    f"baseline entry for {f.rule} ({f.fingerprint}) has "
+                    "an empty or placeholder justification — write the "
+                    f"reason into {BASELINE_NAME} or fix the finding",
+                    fingerprint("BASELINE-JUSTIFY", lf.rel, text, k))
+                if not lf.suppressed("BASELINE-JUSTIFY", f.line) and \
+                        bj.fingerprint not in baseline:
+                    new.append(bj)
     return new, old
 
 

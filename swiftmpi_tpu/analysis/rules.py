@@ -509,7 +509,7 @@ def _ledger_keys() -> Set[str]:
         from swiftmpi_tpu.obs.catalog import TRANSFER_KEYS
         _LEDGER_KEYS = set(TRANSFER_KEYS) | {
             "window_fmt_dense", "window_fmt_sparse", "window_fmt_q",
-            "window_fmt_bitmap"}
+            "window_fmt_bitmap", "window_fmt_sketch"}
     return _LEDGER_KEYS
 
 
@@ -912,6 +912,87 @@ class KnobDoc(Rule):
         return last in _CONFIG_RECEIVERS
 
 
+# ---------------------------------------------------------------------------
+# PLAN-DISPATCH
+
+#: the wire-format ladder (mirrors transfer.plan.WIRE_FORMATS; literal
+#: so the linter never imports jax)
+_WIRE_FORMAT_NAMES = frozenset(
+    ("dense", "sparse", "bitmap", "sparse_q", "sparse_sketch"))
+
+#: attribute/function names whose CALL is the wire-format question
+_PLAN_QUESTIONS = frozenset(
+    ("decide_wire_format", "price_window_formats", "window_wire_format",
+     "compile_window_plan"))
+
+#: transfer-layer modules allowed to interpret plans: the interpreter
+#: itself, the plan compiler, and the codec modules its tables point at
+#: (a codec IMPLEMENTS formats — encode/decode/byte-model — which is
+#: the opposite of a backend dispatching on them; delta.py is the
+#: PR-17 row-delta codec, sketch.py the sparse_sketch codec)
+_PLAN_INTERPRETER_FILES = frozenset(
+    ("api.py", "plan.py", "sketch.py", "delta.py"))
+
+
+class PlanDispatch(Rule):
+    """The TrafficPlan interpreter (transfer/api.py ``push_window``) is
+    the ONE dispatch point of the transfer stack: backend modules are
+    primitive providers and must neither ask the wire-format question
+    (``decide_wire_format``/``price_window_formats``/
+    ``compile_window_plan``) nor branch on a wire-format name.  A new
+    format is a plan-table edit plus a codec module — the moment a
+    backend compares against ``"bitmap"`` the table stops being the
+    single source of truth and every future rung pays four backends
+    again (the pre-PR-18 tax this rule pins out)."""
+
+    id = "PLAN-DISPATCH"
+    description = ("wire-format branch or pricing call in a transfer "
+                   "backend (belongs in the plan interpreter)")
+
+    def check(self, f, ctx):
+        rel = "/" + f.rel.replace("\\", "/")
+        if "/transfer/" not in rel:
+            return
+        if rel.rsplit("/", 1)[-1] in _PLAN_INTERPRETER_FILES:
+            return
+        for node in ast.walk(f.tree):
+            if isinstance(node, ast.Compare):
+                name = self._format_operand(node)
+                if name is not None:
+                    yield self.finding(
+                        f, node,
+                        f"comparison against wire format {name!r} in a "
+                        "transfer backend — format dispatch belongs in "
+                        "the TrafficPlan interpreter "
+                        "(transfer/api.py); add formats via "
+                        "transfer/plan.py tables")
+            elif isinstance(node, ast.Call):
+                chain = attr_chain(node.func) or ""
+                leaf = chain.split(".")[-1]
+                if leaf in _PLAN_QUESTIONS:
+                    yield self.finding(
+                        f, node,
+                        f"`{leaf}` called from a transfer backend — "
+                        "only the TrafficPlan interpreter "
+                        "(transfer/api.py) asks the wire-format "
+                        "question; backends receive a compiled plan")
+
+    @staticmethod
+    def _format_operand(node: ast.Compare):
+        """The wire-format name a comparison tests against, if any:
+        catches ``x == "bitmap"`` and ``x in ("dense", "sparse")``."""
+        for side in (node.left, *node.comparators):
+            if isinstance(side, ast.Constant) and \
+                    side.value in _WIRE_FORMAT_NAMES:
+                return side.value
+            if isinstance(side, (ast.Tuple, ast.List, ast.Set)):
+                for e in side.elts:
+                    if isinstance(e, ast.Constant) and \
+                            e.value in _WIRE_FORMAT_NAMES:
+                        return e.value
+        return None
+
+
 RULES = (DonateEscape(), ReaderPureHost(), ProducerNoRng(),
          ProducerNoDevice(), LedgerMonotonic(), TelemetryCatalog(),
-         LockGuard(), EpochGuard(), KnobDoc())
+         LockGuard(), EpochGuard(), KnobDoc(), PlanDispatch())

@@ -272,6 +272,17 @@ class Word2Vec:
         if self.wire_quant not in ("off", "int8", "bf16"):
             raise ValueError("[cluster] wire_quant must be off, int8 or "
                              f"bf16, got {self.wire_quant!r}")
+        # [cluster] wire_sketch: 0|1 — admit the counting-sketch index
+        # rung (sparse_sketch: bucketed uint16 counts + uint8 in-bucket
+        # offsets instead of i32 indices) to the window wire-format
+        # crossover.  Lossless and EF-compatible; the TrafficPlan pricer
+        # (parameter/key_index.py) still picks per family, so arming the
+        # knob only changes the wire where the sketch byte model wins.
+        # Only meaningful with push_window > 1.
+        self.wire_sketch = g("cluster", "wire_sketch", 0).to_int32()
+        if self.wire_sketch not in (0, 1):
+            raise ValueError("[cluster] wire_sketch must be 0 or 1, got "
+                             f"{self.wire_sketch!r}")
         # [worker] pipeline: K > 0 turns on the asynchronous input
         # pipeline (io/pipeline.py) — a producer thread renders batches
         # K ahead and eagerly device_puts them so H2D overlaps compute.
@@ -416,6 +427,15 @@ class Word2Vec:
                     "[cluster] wire_quant: %s has no effect at "
                     "push_window: 1 (per-step pushes ship f32); "
                     "ignoring", self.wire_quant)
+        if self.wire_sketch:
+            if self.push_window_size > 1 and hasattr(
+                    self.transfer, "wire_sketch"):
+                self.transfer.wire_sketch = True
+            else:
+                log.warning(
+                    "[cluster] wire_sketch has no effect at "
+                    "push_window: 1 (per-step pushes ship indexed "
+                    "rows); ignoring")
         prob, alias = build_unigram_alias(self.vocab.counts)
         self._alias_prob = jnp.asarray(prob)
         self._alias_idx = jnp.asarray(alias)
@@ -2272,7 +2292,7 @@ class Word2Vec:
         """Refresh the per-window wire-format crossover input: the
         expected unique-row count under the DECAYED histogram.  Win =
         relative drift of E[U] since it was last baked in.  Evidence
-        carries the 4-way format the crossover would pick under the old
+        carries the priced format the crossover would pick under the old
         vs the new estimate (a representative one-field window family),
         so a decision log shows when a retune actually flips the baked
         format rather than just nudging the estimate."""
@@ -2298,7 +2318,8 @@ class Word2Vec:
                 dense_ratio=self.transfer.wire_dense_ratio("window"),
                 expected_unique=eu, quant=self.wire_quant,
                 quant_row_bytes=qrb,
-                quant_guard=self.transfer.wire_quant_guard)
+                quant_guard=self.transfer.wire_quant_guard,
+                sketch=bool(getattr(self.transfer, "wire_sketch", False)))
 
         return Proposal(float(new), abs(new - old) / max(float(old), 1.0),
                         {"old_expected_unique": float(old),
@@ -2308,9 +2329,11 @@ class Word2Vec:
 
     def _apply_wire(self, eu, evidence) -> bool:
         self.transfer.window_expected_unique = float(eu)
-        # the sparse/dense decision is host-static, baked at trace time
-        # (transfer.decide_wire_format in _push_window_flat) — recompile
-        # so the new crossover takes effect at this safe point
+        # the wire-format decision is host-static, baked at trace time
+        # (the TrafficPlan compiled in transfer/api.py's window
+        # interpreter; the plan cache keys on expected_unique, so this
+        # write invalidates the cached plan) — recompile so the new
+        # crossover takes effect at this safe point
         self._rebuild_step()
         return True
 

@@ -34,7 +34,8 @@ from __future__ import annotations
 TRANSFER_KEYS = frozenset({
     "wire_bytes", "dispatches",
     "window_sparse", "window_dense",            # legacy 2-way decisions
-    "window_fmt",                               # 4-way, fmt= label
+    "window_fmt",                               # 5-way, fmt= label
+    "plan_compiles", "plan_cache_hits",         # TrafficPlan compiler
     "coalesced_rows_in", "coalesced_rows_out",
     "pull_bytes", "pull_rows", "pull_hot_rows",
     "routed_rows", "overflow_dropped",          # tpu routing ledger

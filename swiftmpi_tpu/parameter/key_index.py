@@ -95,7 +95,8 @@ def window_wire_format(rows: int, capacity: int, row_bytes: int,
                        expected_unique: Optional[float] = None,
                        quant: str = "off",
                        quant_row_bytes: Optional[int] = None,
-                       quant_guard: float = 1.25) -> str:
+                       quant_guard: float = 1.25,
+                       sketch: bool = False) -> str:
     """Wire format for one coalesced push window.
 
     The same crossover rule :func:`calibrate_hot_k` applies to placement
@@ -133,6 +134,23 @@ def window_wire_format(rows: int, capacity: int, row_bytes: int,
                  error feedback
       =========  =====================================================
 
+    ``sketch=True`` (the ``wire_sketch`` knob) arms a fifth, lossless
+    rung — ``sparse_sketch``, S2 Reducer's counting-sketch index
+    compression (transfer/sketch.py):
+
+      ``sketch_base + eff * (1 + value_bytes)`` — a uint16 per-bucket
+      occupancy sketch (``2 * ceil(capacity / 256)`` bytes) replaces
+      both the index words and the bitmap mask; each row ships one
+      uint8 in-bucket offset plus packed values.  Wins in the
+      mid-density band between ``sparse`` (low density: 4-byte indices
+      are cheap) and ``bitmap`` (high density: a 1-bit mask beats 1
+      byte/row).
+
+    Whenever the ladder extends past 2-way the sketch rung is PRICED
+    (its volume lands in the evidence dict alongside the other four)
+    but it can only WIN with ``sketch=True`` — so arming quantization
+    alone leaves every historical decision bit-identical.
+
     The lossless minimum always beats sparse_q unless the quantized
     volume clears the **quantization-error guard**: sparse_q is picked
     only when ``q_vol * quant_guard <= lossless_vol`` (default 1.25 —
@@ -140,7 +158,8 @@ def window_wire_format(rows: int, capacity: int, row_bytes: int,
     decision, _ = price_window_formats(
         rows, capacity, row_bytes, dense_ratio=dense_ratio,
         expected_unique=expected_unique, quant=quant,
-        quant_row_bytes=quant_row_bytes, quant_guard=quant_guard)
+        quant_row_bytes=quant_row_bytes, quant_guard=quant_guard,
+        sketch=sketch)
     return decision
 
 
@@ -149,15 +168,18 @@ def price_window_formats(rows: int, capacity: int, row_bytes: int,
                          expected_unique: Optional[float] = None,
                          quant: str = "off",
                          quant_row_bytes: Optional[int] = None,
-                         quant_guard: float = 1.25):
+                         quant_guard: float = 1.25,
+                         sketch: bool = False):
     """The :func:`window_wire_format` decision WITH its evidence: returns
     ``(decision, prices)`` where ``prices`` maps every candidate format
     that was actually priced to its modeled byte volume — the "why did
     this window densify" record the wire-tracing plane
-    (:mod:`swiftmpi_tpu.obs.trace`) attaches to each trace record.  The
-    decision logic is byte-for-byte the one documented on
-    :func:`window_wire_format` (which delegates here); with ``quant ==
-    "off"`` only the 2-way sparse/dense pair is priced, so the candidate
+    (:mod:`swiftmpi_tpu.obs.trace`) attaches to each trace record, and
+    the pricing half of the TrafficPlan compiler
+    (:mod:`swiftmpi_tpu.transfer.plan`).  The decision logic is
+    byte-for-byte the one documented on :func:`window_wire_format`
+    (which delegates here); with ``quant == "off"`` and ``sketch``
+    unset only the 2-way sparse/dense pair is priced, so the candidate
     set itself records which rungs were even in play."""
     eff = float(min(rows, capacity))
     if expected_unique is not None:
@@ -167,15 +189,23 @@ def price_window_formats(rows: int, capacity: int, row_bytes: int,
     prices = {"sparse": sparse_vol, "dense": dense_vol}
     if sparse_vol * dense_ratio >= dense_vol:
         return "dense", prices
-    if quant == "off":
+    if quant == "off" and not sketch:
         return "sparse", prices
     value_bytes = max(float(row_bytes) - 4.0, 0.0)
     bitmap_vol = capacity / 8.0 + eff * value_bytes
     prices["bitmap"] = bitmap_vol
+    from swiftmpi_tpu.transfer.sketch import sketch_wire_bytes
+    sketch_vol = sketch_wire_bytes(capacity, eff, value_bytes)
+    prices["sparse_sketch"] = sketch_vol
     best, best_vol = "sparse", sparse_vol
     if bitmap_vol < best_vol:
         best, best_vol = "bitmap", bitmap_vol
-    if quant_row_bytes is not None:
+    # the sketch rung is always PRICED past 2-way but only ELIGIBLE
+    # when armed — quant-only configurations keep their exact
+    # historical decisions
+    if sketch and sketch_vol < best_vol:
+        best, best_vol = "sparse_sketch", sketch_vol
+    if quant != "off" and quant_row_bytes is not None:
         q_vol = eff * (4.0 + float(quant_row_bytes))
         prices["sparse_q"] = q_vol
         if q_vol * quant_guard <= best_vol:

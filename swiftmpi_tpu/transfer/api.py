@@ -294,6 +294,8 @@ class Transfer:
                 "window_sparse": 0, "window_dense": 0,
                 "window_fmt_dense": 0, "window_fmt_sparse": 0,
                 "window_fmt_q": 0, "window_fmt_bitmap": 0,
+                "window_fmt_sketch": 0,
+                "plan_compiles": 0, "plan_cache_hits": 0,
                 "coalesced_rows_in": 0, "coalesced_rows_out": 0,
                 "pull_bytes": 0, "pull_rows": 0, "pull_hot_rows": 0,
                 "pending": [], "pull_pending": [],
@@ -307,7 +309,8 @@ class Transfer:
     _WINDOW_FMT_KEY = {"dense": "window_fmt_dense",
                        "sparse": "window_fmt_sparse",
                        "sparse_q": "window_fmt_q",
-                       "bitmap": "window_fmt_bitmap"}
+                       "bitmap": "window_fmt_bitmap",
+                       "sparse_sketch": "window_fmt_sketch"}
 
     def _obs_inc(self, key: str, n, **labels) -> None:
         """Mirror a ledger increment into the telemetry registry as
@@ -597,6 +600,14 @@ class Transfer:
     #: the next decision.
     wire_quant_guard = 1.25
 
+    #: arm the ``sparse_sketch`` wire rung (transfer/sketch.py):
+    #: counting-sketch index compression between the ``bitmap`` and
+    #: ``sparse`` rungs.  Lossless, so unlike ``wire_quant`` it needs no
+    #: EF planes — but with both knobs off the decision stays the exact
+    #: legacy 2-way (bit-identity guarantee), so arming requires the
+    #: usual step rebuild.  Set from ``[cluster] wire_sketch``.
+    wire_sketch = False
+
     def _ratio_state(self) -> dict:
         st = self.__dict__.get("_wire_ratios")
         if st is None:
@@ -619,38 +630,53 @@ class Transfer:
         are made host-side per call, so no recompile is needed."""
         self._ratio_state()[family] = float(ratio)
 
+    def _window_plan(self, rows: int, capacity: int, row_bytes: int,
+                     quant_row_bytes: Optional[int] = None,
+                     family: Optional[str] = "window",
+                     with_counts: bool = True):
+        """Compile (or fetch) this instance's :class:`TrafficPlan` for
+        one window shape (transfer/plan.py) and fire the plan's
+        observation side-channels: compile/hit counters on the wire
+        ledger, and — armed — the full candidate pricing on the wire
+        tracer, so each runtime window record can say WHY its format
+        won (obs/trace.py).  The on_decision tap fires per CALL, not
+        per compile: trace streams see every window, cached or not."""
+        from swiftmpi_tpu.transfer.plan import compile_window_plan
+        plan, hit = compile_window_plan(
+            self, int(rows), int(capacity), int(row_bytes),
+            quant_row_bytes, with_counts, family=family)
+        if getattr(self, "count_traffic", False):
+            key = "plan_cache_hits" if hit else "plan_compiles"
+            self._wire_state()[key] += 1
+            self._obs_inc(key, 1)
+        tr = obs.get_tracer()
+        if tr is not None:
+            tr.on_decision(self.name, plan.wire_format, plan.prices,
+                           plan.rows, plan.capacity, plan.row_bytes,
+                           quant=plan.quant)
+        return plan
+
     def decide_wire_format(self, rows: int, capacity: int,
                            row_bytes: int,
                            family: Optional[str] = None,
                            quant_row_bytes: Optional[int] = None) -> str:
-        """``"sparse" | "dense"`` — or, with ``wire_quant`` armed and a
-        ``quant_row_bytes`` estimate supplied, the full 4-way
-        ``"sparse" | "dense" | "bitmap" | "sparse_q"`` — for one
-        exchange of ``rows`` candidate rows against a ``capacity``-row
-        dense alternative.  The ONE place backends ask the wire-format
-        question — call sites no longer read config/module constants
-        directly, so the control plane can steer the crossover (ratio
-        and expected-unique estimate) without touching compiled code.
+        """``"sparse" | "dense"`` — or, with ``wire_quant`` /
+        ``wire_sketch`` armed and a ``quant_row_bytes`` estimate
+        supplied, the full 5-way ``"sparse" | "dense" | "bitmap" |
+        "sparse_q" | "sparse_sketch"`` — for one exchange of ``rows``
+        candidate rows against a ``capacity``-row dense alternative.
+        The ONE place the wire-format question is asked — call sites no
+        longer read config/module constants directly, so the control
+        plane can steer the crossover (ratio and expected-unique
+        estimate) without touching compiled code.
 
-        When the wire tracer is armed the full candidate pricing (every
-        format's modeled byte volume, not just the winner) is cached on
-        it, so each runtime window record can say WHY its format won
-        (obs/trace.py)."""
-        from swiftmpi_tpu.parameter.key_index import price_window_formats
-        quant = (self.wire_quant if quant_row_bytes is not None
-                 else "off")
-        decision, prices = price_window_formats(
-            int(rows), int(capacity), int(row_bytes),
-            dense_ratio=self.wire_dense_ratio(family),
-            expected_unique=self.window_expected_unique,
-            quant=quant,
-            quant_row_bytes=quant_row_bytes,
-            quant_guard=self.wire_quant_guard)
-        tr = obs.get_tracer()
-        if tr is not None:
-            tr.on_decision(self.name, decision, prices, int(rows),
-                           int(capacity), int(row_bytes), quant=quant)
-        return decision
+        Thin shim over :meth:`_window_plan`: the pricing, caching and
+        trace taps all live in the TrafficPlan compiler now; this keeps
+        the historical ask-for-a-string entry point for the control
+        plane and the calibration tools."""
+        return self._window_plan(rows, capacity, row_bytes,
+                                 quant_row_bytes=quant_row_bytes,
+                                 family=family).wire_format
 
     def _trace_keys(self, ded_slots, cap_per_shard: Optional[int] = None,
                     n_shards: Optional[int] = None) -> None:
@@ -733,9 +759,68 @@ class Transfer:
         optimizer's window staleness (bounded by W-1 steps; envelope
         documented in docs/ARCHITECTURE.md "Window-coalesced push").
 
-        The base implementation flattens and delegates; the tpu/hybrid
-        backends override with a density-adaptive wire format (dedup +
-        sparse all_to_all below the crossover, dense psum above)."""
+        This method is THE TrafficPlan interpreter (the single dispatch
+        point the PLAN-DISPATCH lint rule pins): it compiles a plan
+        (transfer/plan.py) per window family and executes it over the
+        backend's primitives — ``_prim_window_dedup``, ``_prim_ef_drain``,
+        ``_prim_window_exchange``, ``_push_window_dense`` — with every
+        obs/trace/numerics tap fired from HERE.  Backends never ask the
+        wire-format question and never branch on a format name.  W == 1
+        windows (and local/xla windows with every compression knob off)
+        take :meth:`_push_window_passthrough` untouched — bit-identical
+        to the pre-plan wire by construction."""
+        from swiftmpi_tpu.transfer.plan import window_route
+        route = window_route(self.name)
+        if route.eager:
+            shaped = np.asarray(slots, np.int64)
+        else:
+            shaped = slots = jnp.asarray(slots, jnp.int32)
+        if shaped.ndim < 2 or shaped.shape[0] == 1:
+            return self._push_window_passthrough(state, slots, grads,
+                                                 access, mean=mean,
+                                                 counts=counts)
+        armed = self.wire_quant != "off" or bool(self.wire_sketch)
+        if not route.always_decide and not armed:
+            return self._push_window_passthrough(state, slots, grads,
+                                                 access, mean=mean,
+                                                 counts=counts)
+        # flatten the (W, B) window ONCE, in the route's element space
+        if route.eager:
+            flat = shaped.reshape(-1)
+            fgrads = {}
+            for f, g in grads.items():
+                g = np.asarray(g, np.float32)
+                fgrads[f] = g.reshape((-1,) + g.shape[2:])
+            fcounts = None if counts is None else np.asarray(
+                counts, np.float32).reshape(-1)
+        else:
+            flat = shaped.reshape(-1)
+            fgrads = {f: jnp.asarray(g).reshape(
+                (-1,) + jnp.asarray(g).shape[2:])
+                for f, g in grads.items()}
+            fcounts = None if counts is None else jnp.asarray(
+                counts, jnp.float32).reshape(-1)
+        if not route.counts_follow_data and fcounts is None:
+            # oracle routes price and ship with_counts rows regardless
+            # (legacy local/xla behavior, kept bit-identical)
+            fcounts = (np.ones(flat.shape, np.float32) if route.eager
+                       else jnp.ones(flat.shape, jnp.float32))
+        if route.placement == "hot_split":
+            return self._interpret_window_hot_split(
+                state, flat, fgrads, fcounts, access, mean,
+                counts_present=counts is not None)
+        return self._interpret_window_flat(
+            state, flat, fgrads, access, mean, fcounts,
+            passthrough=(slots, grads, counts))
+
+    def _push_window_passthrough(self, state: TableState, slots, grads,
+                                 access: AccessMethod, mean: bool = False,
+                                 counts=None) -> TableState:
+        """The no-plan window executor: flatten and delegate to the
+        per-step ``push``/``push_span``.  Taken for W == 1 windows on
+        every backend and for whole W > 1 windows on the non-
+        ``always_decide`` routes with all compression knobs off — the
+        paths whose bit-identity the parity goldens pin."""
         slots = jnp.asarray(slots)
         flat = slots.reshape(-1)
         fgrads = {f: jnp.asarray(g).reshape((-1,) + jnp.asarray(g).shape[2:])
@@ -745,6 +830,204 @@ class Transfer:
                                   jnp.asarray(counts).reshape(-1),
                                   access, mean=mean)
         return self.push(state, flat, fgrads, access, mean=mean)
+
+    def _trace_shard_args(self, capacity: int) -> dict:
+        """Keyword arguments the interpreter forwards to
+        :meth:`_trace_keys` — a backend that knows its slot -> shard
+        owner mapping (tpu) returns ``cap_per_shard``/``n_shards`` so
+        window records carry the per-destination row split."""
+        return {}
+
+    def _prim_window_dedup(self, flat, fgrads, fcounts, capacity: int):
+        """Backend dedup primitive: collapse repeated slots of the
+        flattened window into their first occurrence, summing grads and
+        counts.  Returns ``(ded_slots, ded_grads, ded_counts)`` — same
+        leading shape with non-representatives marked ``-1`` (device
+        routes) or compacted unique rows (the eager oracle).
+
+        Default: the single-device representative trick (sort-free
+        positional scatter-min over a (capacity+1,) plane — exactly the
+        ``XlaTransfer.push_span`` machinery), which any one-program
+        device backend can use as-is."""
+        B = flat.shape[0]
+        valid = flat >= 0
+        pos = jnp.arange(B, dtype=jnp.int32)
+        safe = jnp.where(valid, flat, capacity)
+        rep = jnp.full((capacity + 1,), B, jnp.int32).at[safe].min(
+            jnp.where(valid, pos, B), mode="drop")
+        owner = jnp.where(valid, jnp.take(rep, safe), B)
+        is_owner = valid & (owner == pos)
+        ded_grads = {}
+        for f, g in fgrads.items():
+            g = jnp.asarray(g)
+            ded_grads[f] = jnp.zeros_like(g).at[owner].add(
+                g * valid[:, None].astype(g.dtype), mode="drop")
+        ded_counts = jnp.zeros(fcounts.shape, jnp.float32).at[owner].add(
+            fcounts * valid, mode="drop")
+        return jnp.where(is_owner, flat, -1), ded_grads, ded_counts
+
+    def _prim_ef_drain(self, state, ded_slots, ded_grads, capacity: int,
+                       quant: str):
+        """Backend EF primitive: drain residual planes into the deduped
+        sums, quantize-dequantize the values, bank the new error.
+        Returns ``(state', ded_grads')``.  The numerics/trace taps fire
+        inside :func:`ef_quantize_window` (device twin) or the local
+        oracle's numpy override — both under the interpreter's plan."""
+        return ef_quantize_window(state, ded_slots, ded_grads, capacity,
+                                  quant, trace_backend=self.name)
+
+    def _prim_window_exchange(self, state, ded_slots, ded_grads,
+                              ded_counts, access, mean: bool,
+                              need_counts: bool, wire):
+        """Backend exchange primitive for a deduped, encoded window:
+        ship the surviving rows, booking the exchange at the plan's
+        encoded ``(row_bytes, base_bytes)``.  Default: the span family
+        (counts always ride — the oracle routes' legacy contract)."""
+        return self.push_span(state, ded_slots, ded_grads, ded_counts,
+                              access, mean=mean, _wire=wire)
+
+    def _interpret_window_flat(self, state, flat, fgrads, access,
+                               mean: bool, fcounts, pre_deduped=False,
+                               passthrough=None):
+        """Execute one compiled plan over a flattened W > 1 window.
+
+        ``pre_deduped``: the rows already went through a unified-space
+        dedup (the hybrid hot-split stage) — skip the dedup primitive
+        and book a traced-zero coalesce so the decision still lands on
+        this backend's ledger/trace.  ``passthrough``: the original
+        ``(slots, grads, counts)`` triple, supplied by routes whose
+        dense/sparse decisions execute as the legacy passthrough."""
+        from swiftmpi_tpu.transfer.plan import window_route
+        route = window_route(self.name)
+        capacity = next(iter(state.values())).shape[0]
+        with_counts = ((fcounts is not None) if route.counts_follow_data
+                       else True)
+        row_bytes = grad_row_bytes(fgrads, with_counts=with_counts)
+        quant = self.wire_quant
+        qrb = (quant_grad_row_bytes(fgrads, quant,
+                                    with_counts=with_counts)
+               if quant != "off" else None)
+        plan = self._window_plan(flat.shape[0], capacity, row_bytes,
+                                 quant_row_bytes=qrb, family="window",
+                                 with_counts=with_counts)
+        spec = plan.spec
+        decision = plan.wire_format
+        if decision == "dense" and route.always_decide:
+            if getattr(self, "count_traffic", False):
+                # wire volume is the static table size, not the row
+                # count — the `flat[0] * 0 + capacity` token keeps the
+                # value traced so the callback fires once per compiled
+                # execution
+                self._record_exchange(
+                    flat[0].astype(jnp.int32) * 0 + capacity,
+                    grad_row_bytes(fgrads, with_index=False,
+                                   with_counts=mean),
+                    decision="dense")
+            return self._push_window_dense(state, flat, fgrads, access,
+                                           mean, fcounts)
+        if not spec.dedup and not route.dedups_lossless:
+            # oracle routes execute dense/sparse as the legacy
+            # passthrough; the decision is still booked (traced zero
+            # keeps the callback firing once per compiled execution)
+            if route.eager:
+                self._record_coalesce(0, 0, decision=decision)
+            elif getattr(self, "count_traffic", False):
+                zero = jnp.sum(flat >= 0) * 0
+                self._record_coalesce(zero, zero, decision=decision)
+            slots0, grads0, counts0 = passthrough
+            return self._push_window_passthrough(
+                state, slots0, grads0, access, mean=mean, counts=counts0)
+        # dedup stage (plan taps: keys reservoir BEFORE the coalesce
+        # callback opens the window record, obs/trace.py)
+        if pre_deduped:
+            ded_slots, ded_grads, ded_counts = flat, fgrads, fcounts
+            self._trace_keys(ded_slots, **self._trace_shard_args(capacity))
+            if getattr(self, "count_traffic", False):
+                # the hot-split stage already logged the dedup row
+                # deltas on its own ledger, but the wire decision is
+                # made HERE — log it with zero row deltas
+                zero = jnp.sum(flat >= 0) * 0
+                self._record_coalesce(zero, zero, decision=decision)
+        else:
+            ded_slots, ded_grads, ded_counts = self._prim_window_dedup(
+                flat, fgrads, fcounts, capacity)
+            self._trace_keys(ded_slots, **self._trace_shard_args(capacity))
+            if route.eager:
+                self._record_coalesce(int((flat >= 0).sum()),
+                                      int((ded_slots >= 0).sum()),
+                                      decision=decision)
+            elif getattr(self, "count_traffic", False):
+                self._record_coalesce(jnp.sum(flat >= 0),
+                                      jnp.sum(ded_slots >= 0),
+                                      decision=decision)
+        if spec.ef:
+            state, ded_grads = self._prim_ef_drain(
+                state, ded_slots, ded_grads, capacity, quant)
+        # mean needs the original contribution multiplicities (dedup
+        # collapsed them into ded_counts); plain sums need no counts at
+        # all — pre-summing commutes with the owner-side segment sum
+        need_counts = ((mean or with_counts) if route.counts_follow_data
+                       else True)
+        wire = (plan.spec.wire(ded_grads, quant, capacity, need_counts)
+                if spec.encoded else None)
+        return self._prim_window_exchange(state, ded_slots, ded_grads,
+                                          ded_counts, access, mean,
+                                          need_counts, wire)
+
+    def _interpret_window_hot_split(self, state, flat, fgrads, fcounts,
+                                    access, mean: bool,
+                                    counts_present: bool):
+        """Execute the ``hot_split`` placement (hybrid): pad, dedup ONCE
+        in the unified slot space, reconcile the hot slice with the
+        dense psum primitive, re-interpret the tail slice on the tail
+        backend (``pre_deduped`` — the dedup pass is not paid twice).
+        Uses the hybrid backend's structural primitives (``_pad_batch``,
+        ``_split_state``, ``_hot_push``) — only reachable on routes
+        declaring ``placement="hot_split"``."""
+        from swiftmpi_tpu.parameter.sparse_table import hot_name
+        flat, fgrads, fcounts, _ = self._pad_batch(flat, fgrads, fcounts)
+        tail_state, hot_state = self._split_state(state)
+        n_hot = self._n_hot(state)
+        if n_hot == 0:
+            return self.tail._interpret_window_flat(
+                tail_state, flat, fgrads, access, mean, fcounts)
+        cap_tail = next(iter(tail_state.values())).shape[0]
+        ded_slots, ded_grads, ded_counts = self.tail._prim_window_dedup(
+            flat, fgrads, fcounts, n_hot + cap_tail)
+        if self.count_traffic:
+            self._record_coalesce(jnp.sum(flat >= 0),
+                                  jnp.sum(ded_slots >= 0))
+        is_hot = (ded_slots >= 0) & (ded_slots < n_hot)
+        tail_slots = jnp.where(ded_slots >= n_hot, ded_slots - n_hot, -1)
+        # stage the hot/tail split for the wire tracer under the TAIL's
+        # name: the tail backend owns the decision-carrying window
+        # record this callback's extras attach to (obs/trace.py)
+        tr = obs.get_tracer()
+        if tr is not None:
+            hot_rows = jnp.sum(is_hot)
+            cb = (lambda v, _tr=tr, _n=self.tail.name:
+                  _tr.stage(_n, hot_rows=int(v)))
+            if isinstance(hot_rows, jax.core.Tracer):
+                jax.debug.callback(cb, hot_rows)
+            else:
+                cb(hot_rows)
+        # mean normalization now depends on the collapsed
+        # multiplicities, so both slices take the counts wire format
+        need_counts = mean or counts_present
+        new_tail = self.tail._interpret_window_flat(
+            tail_state, tail_slots, ded_grads, access, mean,
+            ded_counts if need_counts else None, pre_deduped=True)
+        if self.count_traffic:
+            width_bytes = sum(
+                np.dtype(jnp.asarray(g).dtype).itemsize * g.shape[1]
+                for g in ded_grads.values()) + 4
+            self._record_hot(jnp.sum(is_hot), n_hot * width_bytes)
+            self._record_exchange(jnp.sum(is_hot) * 0 + n_hot, width_bytes)
+        new_hot = self._hot_push(hot_state, ded_slots, ded_grads, access,
+                                 mean, ded_counts if need_counts else None)
+        out = dict(new_tail)
+        out.update({hot_name(f): v for f, v in new_hot.items()})
+        return out
 
 
 def get_transfer(name: Optional[str] = None,

@@ -39,7 +39,6 @@ import numpy as np
 from jax.sharding import Mesh, PartitionSpec as P
 
 from swiftmpi_tpu.utils import jax_compat  # noqa: F401  (jax.shard_map alias)
-from swiftmpi_tpu import obs
 from swiftmpi_tpu.cluster.mesh import SHARD_AXIS
 from swiftmpi_tpu.parameter.sparse_table import (base_field, hot_name,
                                                  is_hot_field)
@@ -125,6 +124,17 @@ class HybridTransfer(Transfer):
     def wire_quant_guard(self, v: float):
         self.tail.wire_quant_guard = float(v)
 
+    @property
+    def wire_sketch(self) -> bool:
+        """Counting-sketch wire rung arm (``sparse_sketch``); lives on
+        the tail, whose window plan prices the ladder.  Hot rows are
+        untouched — their dense psum ships no index stream at all."""
+        return self.tail.wire_sketch
+
+    @wire_sketch.setter
+    def wire_sketch(self, v: bool):
+        self.tail.wire_sketch = bool(v)
+
     def wire_dense_ratio(self, family=None):
         return self.tail.wire_dense_ratio(family)
 
@@ -186,7 +196,8 @@ class HybridTransfer(Transfer):
                "overflow_dropped": t["overflow_dropped"]}
         for k in ("wire_bytes", "dispatches", "window_sparse",
                   "window_dense", "window_fmt_dense", "window_fmt_sparse",
-                  "window_fmt_q", "window_fmt_bitmap",
+                  "window_fmt_q", "window_fmt_bitmap", "window_fmt_sketch",
+                  "plan_compiles", "plan_cache_hits",
                   "coalesced_rows_in", "coalesced_rows_out",
                   "pull_bytes", "pull_rows", "pull_hot_rows"):
             out[k] = t.get(k, 0) + w.get(k, 0)
@@ -292,66 +303,11 @@ class HybridTransfer(Transfer):
                          counts=counts)
 
     # -- window-coalesced push ---------------------------------------------
-    def push_window(self, state, slots, grads, access, mean=False,
-                    counts=None):
-        """Window-coalesced push over the hot/tail split.  ``W == 1``
-        delegates to the per-step :meth:`push` (bit-identical).  For
-        ``W > 1`` the window is deduplicated ONCE in the unified slot
-        space, then split: the hot slice reconciles with the usual single
-        dense psum, the tail slice rides the TpuTransfer window path
-        (``pre_deduped`` — the dedup pass is not paid twice)."""
-        slots = jnp.asarray(slots, jnp.int32)
-        if slots.ndim < 2 or slots.shape[0] == 1:
-            return super().push_window(state, slots, grads, access,
-                                       mean=mean, counts=counts)
-        flat = slots.reshape(-1)
-        fgrads = {f: jnp.asarray(g).reshape((-1,) + jnp.asarray(g).shape[2:])
-                  for f, g in grads.items()}
-        fcounts = None if counts is None else jnp.asarray(
-            counts, jnp.float32).reshape(-1)
-        flat, fgrads, fcounts, _ = self._pad_batch(flat, fgrads, fcounts)
-        tail_state, hot_state = self._split_state(state)
-        n_hot = self._n_hot(state)
-        if n_hot == 0:
-            return self.tail._push_window_flat(tail_state, flat, fgrads,
-                                               access, mean, fcounts)
-        cap_tail = next(iter(tail_state.values())).shape[0]
-        ded_slots, ded_grads, ded_counts = self.tail._window_dedup(
-            flat, fgrads, fcounts, n_hot + cap_tail)
-        if self.count_traffic:
-            self._record_coalesce(jnp.sum(flat >= 0),
-                                  jnp.sum(ded_slots >= 0))
-        is_hot = (ded_slots >= 0) & (ded_slots < n_hot)
-        tail_slots = jnp.where(ded_slots >= n_hot, ded_slots - n_hot, -1)
-        # stage the hot/tail split for the wire tracer under the TAIL's
-        # name: the tail TpuTransfer owns the decision-carrying window
-        # record this callback's extras attach to (obs/trace.py)
-        tr = obs.get_tracer()
-        if tr is not None:
-            hot_rows = jnp.sum(is_hot)
-            cb = (lambda v, _tr=tr, _n=self.tail.name:
-                  _tr.stage(_n, hot_rows=int(v)))
-            if isinstance(hot_rows, jax.core.Tracer):
-                jax.debug.callback(cb, hot_rows)
-            else:
-                cb(hot_rows)
-        # mean normalization now depends on the collapsed multiplicities,
-        # so both slices take the counts wire format
-        need_counts = mean or (counts is not None)
-        new_tail = self.tail._push_window_flat(
-            tail_state, tail_slots, ded_grads, access, mean,
-            ded_counts if need_counts else None, pre_deduped=True)
-        if self.count_traffic:
-            width_bytes = sum(
-                np.dtype(jnp.asarray(g).dtype).itemsize * g.shape[1]
-                for g in ded_grads.values()) + 4
-            self._record_hot(jnp.sum(is_hot), n_hot * width_bytes)
-            self._record_exchange(jnp.sum(is_hot) * 0 + n_hot, width_bytes)
-        new_hot = self._hot_push(hot_state, ded_slots, ded_grads, access,
-                                 mean, ded_counts if need_counts else None)
-        out = dict(new_tail)
-        out.update({hot_name(f): v for f, v in new_hot.items()})
-        return out
+    # No override: the base-class TrafficPlan interpreter
+    # (api.Transfer.push_window) drives the window path through its
+    # ``hot_split`` placement stage, which composes this backend's
+    # structural primitives — `_pad_batch`, `_split_state`, the tail's
+    # dedup/exchange primitives, and `_hot_push` below.
 
     def _hot_push(self, hot_state, slots, grads, access, mean, counts):
         with_counts = counts is not None

@@ -17,7 +17,6 @@ from swiftmpi_tpu.parameter.sparse_table import ef_name
 from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
                                        numerics_quant_err,
                                        pull_row_bytes,
-                                       quant_grad_row_bytes,
                                        quantize_dequantize)
 
 
@@ -109,37 +108,17 @@ class LocalTransfer(Transfer):
             out[f][uniq] = np.asarray(updated[f])
         return out
 
-    # -- window-coalesced push ---------------------------------------------
-    def push_window(self, state, slots, grads, access, mean=False,
-                    counts=None):
-        """Window-push oracle.  ``wire_quant`` off (default): the base
-        flatten-and-delegate, bit-identical to the legacy wire.  Armed:
-        the same 4-way decision the device backends make, with the
-        dedup / EF drain / quantize pipeline spelled out in numpy — the
-        exactness reference the envelope tests diff against."""
-        slots_a = np.asarray(slots, np.int64)
-        if slots_a.ndim < 2 or slots_a.shape[0] == 1 \
-                or self.wire_quant == "off":
-            return super().push_window(state, slots, grads, access,
-                                       mean=mean, counts=counts)
-        flat = slots_a.reshape(-1)
-        fgrads = {}
-        for f, g in grads.items():
-            g = np.asarray(g, np.float32)
-            fgrads[f] = g.reshape((-1,) + g.shape[2:])
-        fcounts = (np.ones(flat.shape, np.float32) if counts is None
-                   else np.asarray(counts, np.float32).reshape(-1))
-        capacity = next(iter(state.values())).shape[0]
-        row_bytes = grad_row_bytes(fgrads, with_counts=True)
-        qrb = quant_grad_row_bytes(fgrads, self.wire_quant,
-                                   with_counts=True)
-        decision = self.decide_wire_format(
-            len(flat), capacity, row_bytes, family="window",
-            quant_row_bytes=qrb)
-        if decision in ("dense", "sparse"):
-            self._record_coalesce(0, 0, decision=decision)
-            return super().push_window(state, slots, grads, access,
-                                       mean=mean, counts=counts)
+    # -- window-plan primitives --------------------------------------------
+    # The window push itself lives in ONE place — the TrafficPlan
+    # interpreter (api.Transfer.push_window).  The oracle contributes
+    # only eager numpy primitives; it never sees the wire-format
+    # question, which is what makes it the exactness reference the
+    # envelope tests diff the device backends against.
+
+    def _prim_window_dedup(self, flat, fgrads, fcounts, capacity):
+        """Eager oracle dedup: compact the flattened window to sorted
+        unique rows with summed grads/counts (``np.unique`` +
+        ``np.add.at`` — the numpy twin of the representative trick)."""
         valid = flat >= 0
         uniq = np.unique(flat[valid])
         pos = np.searchsorted(uniq, flat[valid])
@@ -150,43 +129,34 @@ class LocalTransfer(Transfer):
             acc = np.zeros((len(uniq), g.shape[1]), np.float32)
             np.add.at(acc, pos, g[valid])
             sums[f] = acc
-        # wire tracer key reservoir (eager numpy twin of the device
-        # backends' tap; no-op unless armed)
-        self._trace_keys(uniq)
-        self._record_coalesce(int(valid.sum()), len(uniq),
-                              decision=decision)
-        if decision == "sparse_q":
-            # drain residual, quantize the SUM, bank the new error —
-            # same order of operations as api.ef_quantize_window
-            state = dict(state)
-            err_sq = 0.0
-            drained = rebanked = 0.0
-            banked = False
-            for f in list(sums):
-                efk = ef_name(f)
-                if efk not in state:
-                    continue
-                ef = np.asarray(state[efk], np.float32).copy()
-                tot = sums[f] + ef[uniq]
-                drained += float(np.sum(np.abs(ef[uniq])))
-                deq = np.asarray(
-                    quantize_dequantize(tot, self.wire_quant),
-                    np.float32)
-                ef[uniq] = tot - deq
-                state[efk] = ef
-                sums[f] = deq
-                err_sq += float(np.sum((tot - deq) ** 2))
-                rebanked += float(np.sum(np.abs(tot - deq)))
-                banked = True
-            if banked:
-                numerics_quant_err(err_sq)
-                tracer = obs.get_tracer()
-                if tracer is not None:
-                    tracer.stage_ef(self.name, drained, rebanked)
-            wire = (quant_grad_row_bytes(sums, self.wire_quant,
-                                         with_counts=True), 0)
-        else:       # bitmap: same payload at mask-indexed encoding
-            wire = (grad_row_bytes(sums, with_index=False,
-                                   with_counts=True), capacity // 8)
-        return self.push_span(state, uniq, sums, csum, access,
-                              mean=mean, _wire=wire)
+        return uniq, sums, csum
+
+    def _prim_ef_drain(self, state, uniq, sums, capacity, quant):
+        """Eager EF drain: residual in, quantize the SUM, bank the new
+        error — same order of operations as api.ef_quantize_window,
+        spelled out in numpy with the same numerics/trace taps."""
+        state = dict(state)
+        sums = dict(sums)
+        err_sq = 0.0
+        drained = rebanked = 0.0
+        banked = False
+        for f in list(sums):
+            efk = ef_name(f)
+            if efk not in state:
+                continue
+            ef = np.asarray(state[efk], np.float32).copy()
+            tot = sums[f] + ef[uniq]
+            drained += float(np.sum(np.abs(ef[uniq])))
+            deq = np.asarray(quantize_dequantize(tot, quant), np.float32)
+            ef[uniq] = tot - deq
+            state[efk] = ef
+            sums[f] = deq
+            err_sq += float(np.sum((tot - deq) ** 2))
+            rebanked += float(np.sum(np.abs(tot - deq)))
+            banked = True
+        if banked:
+            numerics_quant_err(err_sq)
+            tracer = obs.get_tracer()
+            if tracer is not None:
+                tracer.stage_ef(self.name, drained, rebanked)
+        return state, sums

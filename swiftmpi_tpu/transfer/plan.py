@@ -1,0 +1,251 @@
+"""TrafficPlan compiler: ONE pricing/dispatch table for the transfer stack.
+
+ROADMAP item 4: the transfer matrix grew to four backends x three
+renderings x window coalescing x a 5-way wire x EF x numerics/trace
+taps, every plane threaded through per-call-site conditionals — PRs 13,
+15 and 17 each had to touch all four backends again.  This module is
+the fix: every window push now compiles an explicit :class:`TrafficPlan`
+(placement, dedup stage, wire format, quantization/EF, observation
+taps) from calibration + the live knobs, and ONE interpreter —
+``Transfer.push_window`` in :mod:`swiftmpi_tpu.transfer.api` — executes
+it over backend *primitives*.  The backends (local/xla/tpu/hybrid) keep
+only structural primitives (dedup kernels, dense psum programs, the
+hot-psum, routed push/push_span executors); they never ask the
+wire-format question, never branch on a format name, and never fire an
+obs/trace/numerics tap for the window path.  The PLAN-DISPATCH lint
+rule (analysis/rules.py) pins that invariant statically.
+
+Adding a wire format is now a table edit here plus a codec module —
+the ``sparse_sketch`` rung (transfer/sketch.py) landed exactly that
+way: one :data:`FORMAT_TABLE` row, one pricer term
+(parameter/key_index.py), zero backend edits.
+
+The compile step is cached per pricing signature — every input that
+can change the decision (rows, capacity, row bytes, quant mode and
+row-byte estimate, the sketch knob, the per-family dense ratio, the
+expected-unique hint, the quant guard) is part of the key, so a
+Controller knob apply (e.g. ``wire_format`` retuning
+``window_expected_unique``) re-prices plans on the next window with no
+invalidation protocol.  Compiles and cache hits are booked on the
+ledger (``transfer/plan_compiles`` / ``transfer/plan_cache_hits``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+from swiftmpi_tpu.transfer.sketch import OFFSET_BYTES, sketch_base_bytes
+
+#: the wire-format ladder, cheapest-machinery first.  Every decision
+#: the pricer can return appears here; the interpreter refuses to
+#: execute a format this table doesn't know.
+WIRE_FORMATS = ("dense", "sparse", "bitmap", "sparse_q", "sparse_sketch")
+
+
+@dataclass(frozen=True)
+class WireFormatSpec:
+    """One rung of the wire ladder: what the interpreter must DO for a
+    window that chose this format.
+
+    ``dedup``: the window must be globally deduplicated before the
+    exchange (the encoded representations index *unique* rows).
+    ``ef``: drain/re-bank error-feedback residuals around a lossy value
+    encoding.  ``encoded``: the exchange is booked at encoded size via
+    :meth:`wire` rather than the executor's default sparse row model.
+    """
+
+    name: str
+    lossless: bool
+    dedup: bool
+    ef: bool
+    encoded: bool
+
+    def wire(self, grads, quant: str, capacity: int,
+             with_counts: bool) -> Optional[Tuple[int, int]]:
+        """``(row_bytes, base_bytes)`` the ledger books one exchange of
+        this format at, or ``None`` for the executor's default model.
+        Must agree with the pricer's byte models in
+        ``parameter.key_index.price_window_formats`` — the goldens in
+        tests/test_traffic_plan.py diff the two."""
+        from swiftmpi_tpu.transfer.api import (grad_row_bytes,
+                                               quant_grad_row_bytes)
+        if self.name == "sparse_q":
+            return (quant_grad_row_bytes(grads, quant,
+                                         with_counts=with_counts), 0)
+        if self.name == "bitmap":
+            return (grad_row_bytes(grads, with_index=False,
+                                   with_counts=with_counts),
+                    capacity // 8)
+        if self.name == "sparse_sketch":
+            return (grad_row_bytes(grads, with_index=False,
+                                   with_counts=with_counts)
+                    + OFFSET_BYTES,
+                    sketch_base_bytes(capacity))
+        return None
+
+
+#: name -> spec.  THE table a new wire format is added to.
+FORMAT_TABLE: Dict[str, WireFormatSpec] = {
+    "dense": WireFormatSpec("dense", lossless=True, dedup=False,
+                            ef=False, encoded=False),
+    "sparse": WireFormatSpec("sparse", lossless=True, dedup=False,
+                             ef=False, encoded=False),
+    "bitmap": WireFormatSpec("bitmap", lossless=True, dedup=True,
+                             ef=False, encoded=True),
+    "sparse_q": WireFormatSpec("sparse_q", lossless=False, dedup=True,
+                               ef=True, encoded=True),
+    "sparse_sketch": WireFormatSpec("sparse_sketch", lossless=True,
+                                    dedup=True, ef=False, encoded=True),
+}
+
+
+@dataclass(frozen=True)
+class WindowRoute:
+    """Per-backend structural facts the interpreter composes a window
+    plan from.  These describe what the backend's primitives ARE, not
+    what the wire does — the wire half lives in :data:`FORMAT_TABLE`.
+
+    ``eager``: primitives are host/numpy (the local oracle).
+    ``always_decide``: the backend prices every W>1 window even with
+    all compression knobs off (tpu/hybrid — their sparse/dense split
+    exists regardless); unset, quant-off+sketch-off windows take the
+    legacy flatten-and-delegate passthrough untouched (local/xla
+    bit-identity).
+    ``dedups_lossless``: the ``sparse`` decision still runs the
+    backend's dedup primitive before the exchange (tpu/hybrid collapse
+    repeats device-locally to cut routed rows; local/xla ship sparse
+    windows through the passthrough).
+    ``counts_follow_data``: the pricing row-byte model counts the f32
+    counts column only when the family actually ships one (tpu/hybrid);
+    unset, the oracle paths always price ``with_counts`` rows
+    (local/xla legacy behavior, kept bit-identical).
+    ``placement``: ``flat`` or ``hot_split`` (hybrid: replicated hot
+    head reconciled by one dense psum, deduped tail re-interpreted on
+    the tail backend).
+    ``collective``: descriptive label of the sparse-path exchange
+    primitive, carried into the plan for trace/debug dumps.
+    """
+
+    eager: bool = False
+    always_decide: bool = False
+    dedups_lossless: bool = False
+    counts_follow_data: bool = False
+    placement: str = "flat"
+    collective: str = "gather_scatter"
+
+
+#: backend name -> route.  THE table a new backend (or collective) is
+#: added to.
+WINDOW_ROUTES: Dict[str, WindowRoute] = {
+    "local": WindowRoute(eager=True, collective="eager"),
+    "xla": WindowRoute(collective="gather_scatter"),
+    "tpu": WindowRoute(always_decide=True, dedups_lossless=True,
+                       counts_follow_data=True, collective="all_to_all"),
+    "hybrid": WindowRoute(always_decide=True, dedups_lossless=True,
+                          counts_follow_data=True, placement="hot_split",
+                          collective="psum+all_to_all"),
+}
+
+
+def window_route(backend: str) -> WindowRoute:
+    try:
+        return WINDOW_ROUTES[backend]
+    except KeyError:
+        raise KeyError(f"transfer.plan: backend {backend!r} has no "
+                       "window route (add it to WINDOW_ROUTES)") from None
+
+
+@dataclass(frozen=True)
+class TrafficPlan:
+    """One compiled window-push plan: every decision the interpreter
+    needs, with the pricing evidence attached.  Frozen — a plan is a
+    value; re-pricing produces a new plan under a new cache key."""
+
+    family: str
+    backend: str
+    placement: str
+    dedup: str                    # none | backend | pre_deduped
+    wire_format: str
+    quant: str                    # off | int8 | bf16 (value encoding)
+    ef: bool
+    collective: str
+    taps: Tuple[str, ...]         # interpreter-owned observation taps
+    rows: int
+    capacity: int
+    row_bytes: int
+    quant_row_bytes: Optional[int]
+    priced: Tuple[Tuple[str, float], ...]
+
+    @property
+    def prices(self) -> Dict[str, float]:
+        return dict(self.priced)
+
+    @property
+    def spec(self) -> WireFormatSpec:
+        return FORMAT_TABLE[self.wire_format]
+
+
+_PLAN_CACHE: Dict[tuple, TrafficPlan] = {}
+_PLAN_CACHE_MAX = 4096
+
+
+def clear_plan_cache() -> None:
+    _PLAN_CACHE.clear()
+
+
+def compile_window_plan(transfer, rows: int, capacity: int,
+                        row_bytes: int,
+                        quant_row_bytes: Optional[int],
+                        with_counts: bool,
+                        family: Optional[str] = "window",
+                        ) -> Tuple[TrafficPlan, bool]:
+    """Compile (or fetch) the :class:`TrafficPlan` for one window shape
+    on ``transfer``; returns ``(plan, cache_hit)``.
+
+    The cache key carries EVERY pricing input, including the live
+    knobs (``wire_quant``, ``wire_sketch``, the per-family dense ratio,
+    ``window_expected_unique``, ``wire_quant_guard``) — a Controller
+    apply that moves any of them re-prices on the very next window,
+    which is how the ``wire_format`` knob "re-prices plans live"
+    without an invalidation protocol."""
+    from swiftmpi_tpu.parameter.key_index import price_window_formats
+    quant = transfer.wire_quant if quant_row_bytes is not None else "off"
+    sketch = bool(transfer.wire_sketch)
+    dense_ratio = transfer.wire_dense_ratio(family)
+    expected_unique = transfer.window_expected_unique
+    guard = transfer.wire_quant_guard
+    key = (transfer.name, family, int(rows), int(capacity),
+           int(row_bytes), quant_row_bytes, quant, sketch, dense_ratio,
+           expected_unique, guard, bool(with_counts))
+    plan = _PLAN_CACHE.get(key)
+    if plan is not None:
+        return plan, True
+    decision, prices = price_window_formats(
+        int(rows), int(capacity), int(row_bytes),
+        dense_ratio=dense_ratio, expected_unique=expected_unique,
+        quant=quant, quant_row_bytes=quant_row_bytes,
+        quant_guard=guard, sketch=sketch)
+    route = window_route(transfer.name)
+    spec = FORMAT_TABLE[decision]
+    dedup = ("backend" if spec.dedup
+             or (route.dedups_lossless and decision == "sparse")
+             else "none")
+    taps = ("decision", "coalesce")
+    if spec.dedup:
+        taps += ("keys",)
+    if spec.ef:
+        taps += ("ef", "numerics")
+    plan = TrafficPlan(
+        family=family or "window", backend=transfer.name,
+        placement=route.placement, dedup=dedup, wire_format=decision,
+        quant=quant, ef=spec.ef,
+        collective="psum_scatter" if decision == "dense"
+        else route.collective,
+        taps=taps, rows=int(rows), capacity=int(capacity),
+        row_bytes=int(row_bytes), quant_row_bytes=quant_row_bytes,
+        priced=tuple(sorted(prices.items())))
+    if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+        _PLAN_CACHE.clear()
+    _PLAN_CACHE[key] = plan
+    return plan, False

@@ -1,0 +1,150 @@
+"""``sparse_sketch`` wire codec: counting-sketch index compression.
+
+S2 Reducer (arXiv:2110.02140) observes that a sparse gradient exchange
+spends a large fraction of its bytes on the *index stream* — 4 bytes per
+surviving row under the legacy ``sparse`` format, ``capacity / 8`` bytes
+of occupancy mask under ``bitmap`` — and replaces it with a counting
+sketch of the index set.  This module is the swiftmpi_tpu rendering of
+that idea, shaped to slot between the ``bitmap`` and ``sparse`` rungs of
+the window wire-format ladder (parameter/key_index.py):
+
+* the slot space ``[0, capacity)`` is cut into buckets of
+  :data:`BUCKET_WIDTH` consecutive slots;
+* the **counting sketch** is one uint16 occupancy count per bucket —
+  ``2 * ceil(capacity / 256)`` bytes, 16x below the bitmap mask's
+  ``capacity / 8``;
+* each surviving row ships a single uint8 **in-bucket offset** (its
+  slot modulo the bucket width) in slot-sorted order, plus its packed
+  values.
+
+Decode is exact, not probabilistic: rows arrive slot-sorted, so bucket
+``b``'s ``counts[b]`` rows are contiguous and each row's slot is
+``b * BUCKET_WIDTH + offset``.  The rung is therefore LOSSLESS on both
+indices and values (EF-compatible by vacuity: residual planes are never
+touched), and its byte model
+
+    ``sketch_base_bytes(capacity) + rows * (1 + value_bytes)``
+
+beats ``bitmap`` whenever 1 byte/row of offsets undercuts the mask's
+amortized ``capacity / (8 * rows)`` bytes/row, and beats ``sparse``
+whenever rows are dense enough that 3 index bytes/row matter — the
+mid-density band the pricer (``price_window_formats``) resolves per
+window.
+
+Host-side codec only: the device payload rides the unchanged f32
+routing (the ``bitmap`` precedent — the format decision changes what
+the ledger *books*, not the routed math), while this module is the
+byte-exact encode/decode oracle the goldens and the serving/delta
+planes can call.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import numpy as np
+
+#: consecutive slots per sketch bucket.  256 keeps the in-bucket offset
+#: in one uint8; per-bucket occupancy can reach 256 (every slot of a
+#: bucket surviving), which overflows uint8 by exactly one — hence the
+#: uint16 counts plane.
+BUCKET_WIDTH = 256
+
+#: per-row index cost of the format: one uint8 in-bucket offset.
+OFFSET_BYTES = 1
+
+#: per-bucket cost of the counting sketch: one uint16 occupancy count.
+COUNT_BYTES = 2
+
+
+def n_buckets(capacity: int) -> int:
+    return -(-int(capacity) // BUCKET_WIDTH)
+
+
+def sketch_base_bytes(capacity: int) -> int:
+    """Row-count-independent bytes of one encoded exchange: the uint16
+    counting-sketch plane, the analogue of ``bitmap``'s ``capacity / 8``
+    mask."""
+    return COUNT_BYTES * n_buckets(capacity)
+
+
+def sketch_wire_bytes(capacity: int, rows: float, value_bytes: float) -> float:
+    """Modeled encoded bytes of one window: the pricing twin of
+    :func:`encode` (``parameter.key_index.price_window_formats`` calls
+    this, so the plan pricer and the codec can never disagree on the
+    byte model)."""
+    return (float(sketch_base_bytes(capacity))
+            + float(rows) * (OFFSET_BYTES + float(value_bytes)))
+
+
+def encode_index(slots, capacity: int) -> Tuple[np.ndarray, np.ndarray]:
+    """Encode a set of distinct slots in ``[0, capacity)`` as
+    ``(counts, offsets)``: the uint16 per-bucket occupancy sketch and
+    the slot-sorted uint8 in-bucket offsets.  ``-1`` padding is
+    dropped."""
+    slots = np.asarray(slots).reshape(-1)
+    slots = np.sort(slots[slots >= 0]).astype(np.int64)
+    if slots.size and int(slots[-1]) >= int(capacity):
+        raise ValueError(
+            f"sketch.encode_index: slot {int(slots[-1])} out of range "
+            f"for capacity {capacity}")
+    if slots.size != np.unique(slots).size:
+        raise ValueError("sketch.encode_index: slots must be distinct "
+                         "(encode AFTER the window dedup)")
+    counts = np.bincount(slots // BUCKET_WIDTH,
+                         minlength=n_buckets(capacity)).astype(np.uint16)
+    offsets = (slots % BUCKET_WIDTH).astype(np.uint8)
+    return counts, offsets
+
+
+def decode_index(counts, offsets) -> np.ndarray:
+    """Exact inverse of :func:`encode_index`: slot-sorted int64 slots."""
+    counts = np.asarray(counts, np.int64)
+    offsets = np.asarray(offsets, np.int64)
+    if int(counts.sum()) != offsets.size:
+        raise ValueError("sketch.decode_index: counts/offsets mismatch "
+                         f"({int(counts.sum())} != {offsets.size})")
+    base = np.repeat(np.arange(counts.size, dtype=np.int64),
+                     counts) * BUCKET_WIDTH
+    return base + offsets
+
+
+def encode(slots, values: Dict[str, np.ndarray], capacity: int) -> bytes:
+    """Byte-exact encode of one deduped window: the counting sketch,
+    the offset stream, then each field's rows packed in slot-sorted
+    order (fields in sorted name order; widths/dtypes are the
+    receiver's static plan metadata, not shipped)."""
+    raw = np.asarray(slots).reshape(-1)
+    keep = raw >= 0
+    order = np.argsort(raw[keep], kind="stable")
+    counts, offsets = encode_index(raw[keep], capacity)
+    parts = [counts.tobytes(), offsets.tobytes()]
+    for f in sorted(values):
+        v = np.ascontiguousarray(np.asarray(values[f])[keep][order])
+        parts.append(v.tobytes())
+    return b"".join(parts)
+
+
+def decode(payload: bytes, capacity: int,
+           fields: Dict[str, Tuple[int, np.dtype]]
+           ) -> Tuple[np.ndarray, Dict[str, np.ndarray]]:
+    """Inverse of :func:`encode` given the static field metadata
+    ``{name: (width, dtype)}``; returns slot-sorted ``(slots, values)``."""
+    m = n_buckets(capacity)
+    counts = np.frombuffer(payload[:COUNT_BYTES * m], np.uint16)
+    rows = int(counts.sum())
+    pos = COUNT_BYTES * m
+    offsets = np.frombuffer(payload[pos:pos + rows], np.uint8)
+    pos += rows
+    slots = decode_index(counts, offsets)
+    out: Dict[str, np.ndarray] = {}
+    for f in sorted(fields):
+        width, dtype = fields[f]
+        nbytes = rows * width * np.dtype(dtype).itemsize
+        out[f] = np.frombuffer(payload[pos:pos + nbytes],
+                               dtype).reshape(rows, width)
+        pos += nbytes
+    if pos != len(payload):
+        raise ValueError(f"sketch.decode: {len(payload) - pos} trailing "
+                         "bytes (field metadata mismatch?)")
+    return slots, out

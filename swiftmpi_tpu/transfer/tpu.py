@@ -39,9 +39,8 @@ from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
 from swiftmpi_tpu.obs import costs as obs_costs
 from swiftmpi_tpu.ops import (calibration, pallas_gather, pallas_ring,
                               pallas_scatter)
-from swiftmpi_tpu.transfer.api import (Transfer, ef_quantize_window,
-                                       grad_row_bytes, pull_row_bytes,
-                                       quant_grad_row_bytes)
+from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
+                                       pull_row_bytes)
 
 
 def _shard_gather(arr: jax.Array, flat_idx: jax.Array) -> jax.Array:
@@ -379,111 +378,27 @@ class TpuTransfer(Transfer):
         return self.push(state, slots, grads, access, mean=mean,
                          counts=counts)
 
-    # -- window-coalesced push ---------------------------------------------
-    def push_window(self, state, slots, grads, access, mean=False,
-                    counts=None):
-        """Coalesce a (W, B) window of per-step pushes into ONE exchange.
+    # -- window-plan primitives --------------------------------------------
+    # The window push lives in ONE place — the TrafficPlan interpreter
+    # (api.Transfer.push_window).  This backend contributes the sharded
+    # primitives below: the shard_map dedup pre-pass, the bucket-routed
+    # exchange, the dense psum program, and the shard-owner metadata
+    # for the key tracer.  No wire-format question is asked here.
 
-        ``W == 1`` delegates to the per-step path (bit-identical by
-        construction: the flattened (1, B) arrays are exactly the per-step
-        arrays).  For ``W > 1`` the wire format is chosen statically per
-        window shape (parameter.key_index.window_wire_format, same
-        dense_ratio=2.0 crossover as calibrate_hot_k):
+    def _trace_shard_args(self, capacity):
+        """This backend knows its slot -> shard owner mapping, so
+        window trace records carry the per-destination row split."""
+        return {"cap_per_shard": capacity // self.n, "n_shards": self.n}
 
-          sparse — a cached shard_map pre-pass segment-dedups the window
-            on-device (sort-free positional scatter-min, the push_span
-            trick), sums grads/counts into the first occurrence, then the
-            surviving rows go through the existing bucket routing ONCE.
-          dense — each device scatter-adds its window slice into a full
-            (capacity, width) buffer and one tiled ``psum_scatter``
-            reduce-scatters it onto the owning shard's slice; mean
-            normalization ships a (capacity,) counts plane the same way.
+    def _prim_window_dedup(self, flat, fgrads, fcounts, capacity):
+        return self._window_dedup(flat, fgrads, fcounts, capacity)
 
-        Both formats preserve sum-then-apply-once semantics; grads were
-        computed against window-start state, so staleness is bounded by
-        W-1 steps (envelope documented in ARCHITECTURE.md)."""
-        slots = jnp.asarray(slots, jnp.int32)
-        if slots.ndim < 2 or slots.shape[0] == 1:
-            return super().push_window(state, slots, grads, access,
-                                       mean=mean, counts=counts)
-        flat = slots.reshape(-1)
-        fgrads = {f: jnp.asarray(g).reshape((-1,) + jnp.asarray(g).shape[2:])
-                  for f, g in grads.items()}
-        fcounts = None if counts is None else jnp.asarray(
-            counts, jnp.float32).reshape(-1)
-        return self._push_window_flat(state, flat, fgrads, access, mean,
-                                      fcounts)
-
-    def _push_window_flat(self, state, flat, fgrads, access, mean, fcounts,
-                          pre_deduped=False):
-        """Flattened-window push; ``pre_deduped`` lets the hybrid backend
-        route its already-deduplicated tail slice here without paying the
-        dedup pass twice."""
-        capacity = next(iter(state.values())).shape[0]
-        with_counts = fcounts is not None
-        row_bytes = grad_row_bytes(fgrads, with_counts=with_counts)
-        # the crossover is asked through the base-class decision hook
-        # (seed behavior == window_wire_format at dense_ratio 2.0 with
-        # this instance's expected-unique hint) so the control plane can
-        # retune it per family without touching this call site; with
-        # wire_quant armed the quantized-row estimate widens it to the
-        # 4-way dense/sparse/bitmap/sparse_q decision
-        quant = self.wire_quant
-        qrb = (quant_grad_row_bytes(fgrads, quant,
-                                    with_counts=with_counts)
-               if quant != "off" else None)
-        decision = self.decide_wire_format(
-            int(flat.shape[0]), capacity, row_bytes, family="window",
-            quant_row_bytes=qrb)
-        if decision == "dense":
-            return self._push_window_dense(state, flat, fgrads, access,
-                                           mean, fcounts)
-        if pre_deduped:
-            ded_slots, ded_grads, ded_counts = flat, fgrads, fcounts
-            # wire tracer key reservoir + per-destination-shard rows
-            # (no-op unless armed); staged BEFORE the coalesce callback
-            # opens the window record
-            self._trace_keys(ded_slots,
-                             cap_per_shard=capacity // self.n,
-                             n_shards=self.n)
-            if self.count_traffic:
-                # the caller (hybrid) already logged the dedup row deltas
-                # on its own ledger, but the wire decision is made HERE —
-                # log it with zero row deltas; the traced zero keeps the
-                # callback firing once per compiled execution
-                zero = jnp.sum(flat >= 0) * 0
-                self._record_coalesce(zero, zero, decision=decision)
-        else:
-            ded_slots, ded_grads, ded_counts = self._window_dedup(
-                flat, fgrads, fcounts, capacity)
-            self._trace_keys(ded_slots,
-                             cap_per_shard=capacity // self.n,
-                             n_shards=self.n)
-            if self.count_traffic:
-                self._record_coalesce(jnp.sum(flat >= 0),
-                                      jnp.sum(ded_slots >= 0),
-                                      decision=decision)
-        # mean needs the original contribution multiplicities (dedup
-        # collapsed them into ded_counts); plain sums need no counts at
-        # all — pre-summing commutes with the owner-side segment sum
-        need_counts = mean or with_counts
-        wire = None
-        if decision == "sparse_q":
-            # drain EF residuals into the deduped sums, quantize the
-            # values (the routed payload stays dequantized f32), bank
-            # the new per-slot error; book the exchange at encoded size
-            state, ded_grads = ef_quantize_window(
-                state, ded_slots, ded_grads, capacity, quant,
-                trace_backend=self.name)
-            wire = (quant_grad_row_bytes(ded_grads, quant,
-                                         with_counts=need_counts), 0)
-        elif decision == "bitmap":
-            # same deduped sparse payload and routing — only the wire
-            # REPRESENTATION differs: a capacity/8-byte occupancy mask
-            # replaces the per-row index words, values ship packed
-            wire = (grad_row_bytes(ded_grads, with_index=False,
-                                   with_counts=need_counts),
-                    capacity // 8)
+    def _prim_window_exchange(self, state, ded_slots, ded_grads,
+                              ded_counts, access, mean, need_counts,
+                              wire):
+        """Routed exchange of the deduped window: the surviving rows go
+        through the existing bucket routing ONCE, booked at the plan's
+        encoded size when a ``wire`` override is supplied."""
         return self.push(state, ded_slots, ded_grads, access, mean=mean,
                          counts=ded_counts if need_counts else None,
                          _wire=wire)
@@ -553,14 +468,8 @@ class TpuTransfer(Transfer):
                 sig, obs_costs.track("tpu_window_dense", jax.jit(
                     self._build_push_window_dense(
                         state, access, tuple(sorted(fgrads)), mean))))
-        if self.count_traffic:
-            # wire volume is the static table size, not the row count —
-            # the `flat[0] * 0 + capacity` token keeps the value traced
-            # so the callback fires once per compiled execution
-            self._record_exchange(
-                flat[0].astype(jnp.int32) * 0 + capacity,
-                grad_row_bytes(fgrads, with_index=False, with_counts=mean),
-                decision="dense")
+        # ledger booking (an interpreter concern) fires from
+        # api.Transfer._interpret_window_flat before this primitive runs
         return fn(state, flat, fgrads, counts_in)
 
     def _build_push_window_dense(self, state, access, grad_fields, mean):

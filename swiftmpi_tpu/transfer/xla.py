@@ -25,9 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from swiftmpi_tpu.ops import calibration, pallas_gather, pallas_scatter
-from swiftmpi_tpu.transfer.api import (Transfer, ef_quantize_window,
-                                       grad_row_bytes, pull_row_bytes,
-                                       quant_grad_row_bytes)
+from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
+                                       pull_row_bytes)
 
 # replica-spread scatter: cap the R-fold temporary at ~256MB so the
 # measured-win gate can never OOM a large table's push
@@ -248,78 +247,12 @@ class XlaTransfer(Transfer):
         return out
 
     # -- window-coalesced push ---------------------------------------------
-    def push_window(self, state, slots, grads, access, mean=False,
-                    counts=None):
-        """Window push, oracle twin of the tpu backend's 4-way path.
-
-        With ``wire_quant`` off (the default) this is EXACTLY the base
-        flatten-and-delegate — bit-identical to the pre-quantization
-        wire.  Armed, the same calibrated decision runs: dense/sparse
-        windows still take the base path (their math is untouched by
-        quantization), while ``bitmap``/``sparse_q`` windows dedup
-        globally with the representative trick, drain/bank EF residuals
-        (sparse_q), and ship through :meth:`push_span` booked at
-        encoded size — single-device traced jnp all the way, which is
-        what the parity tests diff the tpu/hybrid windows against."""
-        slots = jnp.asarray(slots, jnp.int32)
-        if slots.ndim < 2 or slots.shape[0] == 1 \
-                or self.wire_quant == "off":
-            return super().push_window(state, slots, grads, access,
-                                       mean=mean, counts=counts)
-        flat = slots.reshape(-1)
-        fgrads = {f: jnp.asarray(g).reshape((-1,) + jnp.asarray(g).shape[2:])
-                  for f, g in grads.items()}
-        fcounts = (jnp.ones(flat.shape, jnp.float32) if counts is None
-                   else jnp.asarray(counts, jnp.float32).reshape(-1))
-        capacity = next(iter(state.values())).shape[0]
-        row_bytes = grad_row_bytes(fgrads, with_counts=True)
-        qrb = quant_grad_row_bytes(fgrads, self.wire_quant,
-                                   with_counts=True)
-        decision = self.decide_wire_format(
-            int(flat.shape[0]), capacity, row_bytes, family="window",
-            quant_row_bytes=qrb)
-        if decision in ("dense", "sparse"):
-            if self.count_traffic:
-                zero = jnp.sum(flat >= 0) * 0
-                self._record_coalesce(zero, zero, decision=decision)
-            return super().push_window(state, slots, grads, access,
-                                       mean=mean, counts=counts)
-        # global positional dedup (the representative trick over the
-        # whole flattened window — single-device, so no device-local
-        # residue like the tpu shard_map pass)
-        B = flat.shape[0]
-        valid = flat >= 0
-        pos = jnp.arange(B, dtype=jnp.int32)
-        safe = jnp.where(valid, flat, capacity)
-        rep = jnp.full((capacity + 1,), B, jnp.int32).at[safe].min(
-            jnp.where(valid, pos, B), mode="drop")
-        owner = jnp.where(valid, jnp.take(rep, safe), B)
-        is_owner = valid & (owner == pos)
-        ded_grads = {}
-        for f, g in fgrads.items():
-            g = jnp.asarray(g)
-            ded_grads[f] = jnp.zeros_like(g).at[owner].add(
-                g * valid[:, None].astype(g.dtype), mode="drop")
-        ded_counts = jnp.zeros(fcounts.shape, jnp.float32).at[owner].add(
-            fcounts * valid, mode="drop")
-        ded_slots = jnp.where(is_owner, flat, -1)
-        # wire tracer key reservoir (no-op unless armed); single-device
-        # oracle, so no destination-shard split
-        self._trace_keys(ded_slots)
-        if self.count_traffic:
-            self._record_coalesce(jnp.sum(valid), jnp.sum(is_owner),
-                                  decision=decision)
-        if decision == "sparse_q":
-            state, ded_grads = ef_quantize_window(
-                state, ded_slots, ded_grads, capacity, self.wire_quant,
-                trace_backend=self.name)
-            wire = (quant_grad_row_bytes(ded_grads, self.wire_quant,
-                                         with_counts=True), 0)
-        else:       # bitmap: same payload, mask-indexed representation
-            wire = (grad_row_bytes(ded_grads, with_index=False,
-                                   with_counts=True), capacity // 8)
-        return self.push_span(state, ded_slots, ded_grads, ded_counts,
-                              access, mean=mean, _wire=wire)
+    # No override: the base-class TrafficPlan interpreter
+    # (api.Transfer.push_window) drives this backend's window path, and
+    # the base `_prim_window_dedup` (single-device representative
+    # trick) + `push_span` ARE this backend's primitives — the traced
+    # single-device twin the parity tests diff the tpu/hybrid windows
+    # against.
 
     def _push_sparse(self, state, slots, grads, access, mean=False):
         capacity = next(iter(state.values())).shape[0]

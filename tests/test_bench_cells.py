@@ -196,3 +196,39 @@ def test_scale_qwire_cell_tiny(tiny_shapes, monkeypatch):
     # (quant-off self-description is pinned cheaply at unit level by
     # test_window_push.py::test_wire_quant_off_bit_identity_all_backends
     # — a second tiny bench build here would double the cell's cost)
+
+
+def test_scale_sketchwire_cell_tiny(tiny_shapes, monkeypatch):
+    """BENCH_ONLY=scale_sketchwire's cell: the qwire shape with
+    [cluster] wire_sketch armed on top — self-describes both knobs,
+    carries the full 5-way decision mix plus the TrafficPlan compile
+    counters, and embeds the static d=1/d=32 mid-density pricing
+    evidence the cell exists to publish."""
+    monkeypatch.setattr(bench, "W2V_1M_VOCAB", 5000)
+    dev = jax.devices()[0]
+    out = bench._bench_w2v_1m(dev, timed_calls=1, hybrid=True,
+                              window_steps=2, wire_quant="int8",
+                              wire_sketch=True)
+    assert out["wire_quant"] == "int8"
+    assert out["wire_sketch"] == 1
+    assert out["push_window"] == 2
+    assert out["words_per_sec"] > 0
+    fmts = [out[f"window_fmt_{f}"]
+            for f in ("dense", "sparse", "q", "bitmap", "sketch")]
+    assert all(v >= 0 for v in fmts) and sum(fmts) > 0
+    assert out["wire_bytes_per_step"] > 0
+    # every armed window decision flowed through the ONE plan compiler
+    assert out["plan_compiles"] + out["plan_cache_hits"] > 0
+    ev = bench._sketch_price_evidence()
+    # d=1 mid-density shape: the sketch rung strictly undercuts the
+    # best lossless alternative AND survives the sparse_q guard — the
+    # crossover the fifth rung was added to win
+    assert ev["d1"]["decision"] == "sparse_sketch"
+    assert ev["d1"]["sketch_below_best_lossless"]
+    assert ev["d1"]["sparse_sketch"] < min(ev["d1"]["sparse"],
+                                           ev["d1"]["bitmap"],
+                                           ev["d1"]["sparse_q"])
+    # d=32: still below every lossless rung; int8 sparse_q takes the
+    # overall pick (the documented lossless/lossy guard boundary)
+    assert ev["d32"]["sketch_below_best_lossless"]
+    assert ev["d32"]["decision"] == "sparse_q"

@@ -407,6 +407,7 @@ def test_hybrid_train_loss_parity_vs_xla(devices8):
     assert losses[-1] < losses[0]
 
 
+@pytest.mark.slow
 def test_hybrid_checkpoint_roundtrip_and_partition_guard(tmp_path,
                                                          devices8):
     from swiftmpi_tpu.io.checkpoint import load_checkpoint, save_checkpoint

@@ -401,6 +401,29 @@ def test_telemetry_trips_on_undeclared_ship_series(tmp_path):
     assert "serve/delta_bytez" in new[0].message
 
 
+def test_telemetry_covers_plan_compiler_series(tmp_path):
+    """ISSUE 18 satellite: the TrafficPlan compiler's ledger mirrors —
+    compile/cache-hit counters and the fmt-labeled 5-way decision series
+    (fmt=sketch included) — are catalog-declared and pass as written."""
+    new = lint_src(tmp_path, "pkg/obs/planview.py", """
+    def book(reg):
+        reg.counter("transfer/plan_compiles", backend="xla").inc(1)
+        reg.counter("transfer/plan_cache_hits", backend="xla").inc(1)
+        reg.counter("transfer/window_fmt", backend="xla",
+                    fmt="sketch").inc(1)
+    """)
+    assert new == []
+
+
+def test_telemetry_trips_on_undeclared_plan_series(tmp_path):
+    new = lint_src(tmp_path, "pkg/obs/planview.py", """
+    def book(reg):
+        reg.counter("transfer/plan_compilez", backend="xla").inc(1)
+    """)
+    assert rules_of(new) == {"TELEMETRY-CATALOG"}
+    assert "transfer/plan_compilez" in new[0].message
+
+
 def test_telemetry_checks_both_ifexp_branches(tmp_path):
     new = lint_src(tmp_path, "pkg/thing.py", """
     def record(reg, ok):
@@ -547,6 +570,48 @@ def test_knob_doc_ignores_plain_dict_get(tmp_path):
 
 
 # ---------------------------------------------------------------------------
+# PLAN-DISPATCH (the PR-18 single-dispatch-point invariant)
+
+def test_plan_dispatch_trips_on_format_branch_in_backend(tmp_path):
+    new = lint_src(tmp_path, "pkg/transfer/custom.py", """
+    def exchange(self, state, fmt):
+        if fmt == "bitmap":
+            return state
+        if fmt in ("sparse_q", "sparse_sketch"):
+            return state
+        return state
+    """)
+    assert [f.rule for f in new] == ["PLAN-DISPATCH", "PLAN-DISPATCH"]
+    assert "TrafficPlan interpreter" in new[0].message
+
+
+def test_plan_dispatch_trips_on_pricing_call_in_backend(tmp_path):
+    new = lint_src(tmp_path, "pkg/transfer/rdma.py", """
+    def exchange(self, rows, cap, rb):
+        return self.decide_wire_format(rows, cap, rb)
+    """)
+    assert [f.rule for f in new] == ["PLAN-DISPATCH"]
+    assert "decide_wire_format" in new[0].message
+
+
+def test_plan_dispatch_exempts_interpreter_codec_and_non_transfer(tmp_path):
+    """The interpreter/plan/codec modules ARE where the wire-format
+    question lives (delta.py is the PR-17 codec precedent), and the
+    rule is scoped to transfer/ — a controller comparing format names
+    is out of its jurisdiction."""
+    src = """
+    def interp(self, transfer, plan):
+        if plan.wire_format == "sparse_sketch":
+            return transfer.decide_wire_format(1, 2, 3)
+    """
+    for rel in ("pkg/transfer/api.py", "pkg/transfer/plan.py",
+                "pkg/transfer/sketch.py", "pkg/transfer/delta.py",
+                "pkg/control/tuner.py"):
+        assert "PLAN-DISPATCH" not in rules_of(
+            lint_src(tmp_path, rel, src)), rel
+
+
+# ---------------------------------------------------------------------------
 # suppression + baseline semantics
 
 def test_line_suppression(tmp_path):
@@ -619,6 +684,34 @@ def test_baseline_roundtrip_and_line_drift(tmp_path):
     assert old2[0].fingerprint == new[0].fingerprint
 
 
+def test_baseline_justify_flags_placeholder_justification(tmp_path):
+    """A suppression without a reason is not a suppression: the
+    write_baseline placeholder (or any blank/TODO text) keeps the entry
+    gating as BASELINE-JUSTIFY until a human-written reason lands."""
+    p = tmp_path / "pkg" / "serve" / "reader.py"
+    p.parent.mkdir(parents=True)
+    p.write_text("import jax.numpy as jnp\n")
+    new, _ = core.run_lint(paths=[str(p)], root=str(tmp_path))
+    bl_path = tmp_path / core.BASELINE_NAME
+
+    for j in (None, "", "   ", "TODO: justify or fix", "todo later"):
+        core.write_baseline(str(bl_path), new,
+                            **({} if j is None else {"justification": j}))
+        got, old = core.run_lint(paths=[str(p)], root=str(tmp_path),
+                                 baseline=core.load_baseline(str(bl_path)))
+        assert [f.rule for f in got] == ["BASELINE-JUSTIFY"], j
+        assert len(old) == 1       # the original finding stays baselined
+        assert "justification" in got[0].message
+        assert "READER-PURE-HOST" in got[0].message
+
+    # a real reason silences the escalation
+    core.write_baseline(str(bl_path), new,
+                        justification="host-only fixture reader")
+    got, old = core.run_lint(paths=[str(p)], root=str(tmp_path),
+                             baseline=core.load_baseline(str(bl_path)))
+    assert got == [] and len(old) == 1
+
+
 def test_parse_error_is_a_finding(tmp_path):
     new = lint_src(tmp_path, "pkg/broken.py", """
     def f(:
@@ -663,7 +756,14 @@ def test_cli_write_baseline(tmp_path, capsys):
     bl = json.loads((tmp_path / core.BASELINE_NAME).read_text())
     assert bl["schema"] == core.JSON_SCHEMA
     assert len(bl["findings"]) == 1
-    # with the baseline in place the same lint run is clean
+    # the freshly-written baseline still carries the deliberate
+    # placeholder justification, so the same run now gates on
+    # BASELINE-JUSTIFY — grandfathering is a two-step act on purpose
+    rc = lint_main(["--root", str(tmp_path), str(p)])
+    assert rc == 1
+    # writing the actual reason in completes the suppression
+    bl["findings"][0]["justification"] = "fixture: host-only reader"
+    (tmp_path / core.BASELINE_NAME).write_text(json.dumps(bl))
     rc = lint_main(["--root", str(tmp_path), str(p)])
     assert rc == 0
 

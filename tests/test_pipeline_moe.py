@@ -116,6 +116,7 @@ class TestMoE:
         assert kept.sum() < T                  # some were dropped
         assert kept.sum() > 0                  # some were processed
 
+    @pytest.mark.slow
     def test_grad_flows(self, devices8):
         n = 2
         mesh = Mesh(np.array(devices8[:n]), (EXPERT_AXIS,))

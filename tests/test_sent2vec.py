@@ -87,6 +87,7 @@ def test_profiler_step_timer():
     assert dt > 0 and t.mean > 0 and t.p50 > 0
 
 
+@pytest.mark.slow
 def test_sent2vec_cli(tmp_path, devices8):
     from swiftmpi_tpu.apps.sent2vec_main import main
     wm, corpus = trained_word_model()

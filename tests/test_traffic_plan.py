@@ -1,0 +1,335 @@
+"""TrafficPlan compiler suite (ISSUE 18 acceptance).
+
+The contract under test, per ``transfer/plan.py`` + the ``push_window``
+interpreter in ``transfer/api.py``:
+
+* The pricer's 5-way byte models, the ``WireFormatSpec.wire()`` ledger
+  models and the ``sparse_sketch`` codec's actual encoded length are
+  THE SAME numbers — goldens diff all three at the canonical d=1/d=32
+  mid-density shapes.
+* The sketch codec is an exact (lossless) index/value roundtrip, with
+  loud failures on malformed inputs.
+* ``compile_window_plan`` keys its cache on EVERY pricing input, so a
+  live knob move (``window_expected_unique``, ``wire_sketch``) re-prices
+  on the next window with no invalidation protocol.
+* Arming ``wire_sketch`` changes what the ledger BOOKS, never what the
+  math computes: plan-vs-legacy state parity is bit-exact on all four
+  backends, the sketch decision lands in ``window_fmt_sketch``, and the
+  eager/xla oracle ledgers agree series-for-series.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.cluster import SHARD_AXIS, ps_mesh
+from swiftmpi_tpu.parameter import KeyIndex, SparseTable, w2v_access
+from swiftmpi_tpu.parameter.key_index import price_window_formats
+from swiftmpi_tpu.transfer import sketch
+from swiftmpi_tpu.transfer.api import grad_row_bytes
+from swiftmpi_tpu.transfer.hybrid import HybridTransfer
+from swiftmpi_tpu.transfer.local import LocalTransfer
+from swiftmpi_tpu.transfer.plan import (FORMAT_TABLE, WINDOW_ROUTES,
+                                        clear_plan_cache,
+                                        compile_window_plan)
+from swiftmpi_tpu.transfer.tpu import TpuTransfer
+from swiftmpi_tpu.transfer.xla import XlaTransfer
+
+DIM = 8
+CAP = 1024
+
+
+def make_table(mesh=None, cap=CAP, seed=0):
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    ki = KeyIndex(8, cap)
+    table = SparseTable(access, ki, mesh=mesh,
+                        axis=SHARD_AXIS if mesh else None, seed=seed)
+    return table, ki, access
+
+
+def window_batch(ki, rng, W=4, B=16, key_hi=80):
+    """A mid-density (W, B) window at CAP=1024: ~55 unique rows of 64
+    requests — squarely inside the band where the sketch byte model
+    undercuts both sparse (4-byte indices) and bitmap (128-byte mask)."""
+    keys = rng.integers(0, key_hi, size=W * B).astype(np.uint64)
+    slots = np.asarray(ki.lookup(keys), np.int32).reshape(W, B)
+    slots[:, ::7] = -1
+    grads = {f: rng.normal(size=(W, B, DIM)).astype(np.float32)
+             for f in ("h", "v")}
+    counts = rng.integers(1, 4, size=(W, B)).astype(np.float32)
+    counts[slots < 0] = 0
+    return slots, grads, counts
+
+
+def backend(name, mesh):
+    if name == "local":
+        return LocalTransfer()
+    if name == "xla":
+        return XlaTransfer()
+    if name == "tpu":
+        return TpuTransfer(mesh)
+    return HybridTransfer(mesh)
+
+
+def device_state(name, table):
+    if name in ("tpu", "hybrid"):
+        return table.state
+    return {f: jnp.asarray(np.asarray(v)) for f, v in table.state.items()}
+
+
+@pytest.fixture(autouse=True)
+def fresh_plan_cache():
+    clear_plan_cache()
+    yield
+    clear_plan_cache()
+
+
+# -- byte-model goldens ----------------------------------------------------
+
+def test_pricer_5way_goldens_d1_d32():
+    """The canonical mid-density shapes (capacity 1024, eff=64 rows):
+    exact byte volumes per rung, and the crossover each shape exists to
+    pin — sketch beats every lossless rung at both widths; at d=1 it
+    wins outright (the guarded int8 price loses), at d=32 int8 sparse_q
+    takes the pick."""
+    d1, p1 = price_window_formats(64, 1024, 12, expected_unique=64.0,
+                                  quant="int8", quant_row_bytes=13,
+                                  sketch=True)
+    assert p1 == {"dense": 12288.0, "sparse": 1024.0, "bitmap": 640.0,
+                  "sparse_sketch": 584.0, "sparse_q": 1088.0}
+    assert d1 == "sparse_sketch"
+    d32, p32 = price_window_formats(64, 1024, 136, expected_unique=64.0,
+                                    quant="int8", quant_row_bytes=44,
+                                    sketch=True)
+    assert p32 == {"dense": 139264.0, "sparse": 8960.0, "bitmap": 8576.0,
+                   "sparse_sketch": 8520.0, "sparse_q": 3072.0}
+    assert d32 == "sparse_q"
+    # sketch is PRICED but cannot WIN unarmed: quant-only keeps the
+    # exact historical decision while the evidence shows the rung
+    d, p = price_window_formats(64, 1024, 12, expected_unique=64.0,
+                                quant="int8", quant_row_bytes=13)
+    assert d == "bitmap" and p["sparse_sketch"] == 584.0
+    # quant off + sketch off: the legacy 2-way pair, nothing else priced
+    d, p = price_window_formats(64, 1024, 12, expected_unique=64.0)
+    assert d == "sparse" and set(p) == {"sparse", "dense"}
+
+
+def test_spec_wire_model_matches_pricer_and_codec():
+    """The three byte models that must never disagree: the pricer's
+    volume, ``WireFormatSpec.wire()``'s (row_bytes, base) the ledger
+    books at, and the codec's actual encoded length."""
+    rng = np.random.default_rng(3)
+    rows = 64
+    slots = rng.choice(CAP, size=rows, replace=False)
+    grads = {f: rng.normal(size=(rows, DIM)).astype(np.float32)
+             for f in ("h", "v")}
+    counts = np.ones((rows, 1), np.float32)
+    rb = grad_row_bytes(grads, with_counts=True)          # pricer input
+    _, prices = price_window_formats(rows, CAP, rb,
+                                     expected_unique=float(rows),
+                                     sketch=True)
+    srow, sbase = FORMAT_TABLE["sparse_sketch"].wire(grads, "off", CAP,
+                                                     with_counts=True)
+    assert sbase + rows * srow == prices["sparse_sketch"]
+    brow, bbase = FORMAT_TABLE["bitmap"].wire(grads, "off", CAP,
+                                              with_counts=True)
+    assert bbase + rows * brow == prices["bitmap"]
+    payload = sketch.encode(slots, {**grads, "counts": counts}, CAP)
+    assert len(payload) == prices["sparse_sketch"] == \
+        sketch.sketch_wire_bytes(CAP, rows, rb - 4)
+
+
+# -- sketch codec oracle ---------------------------------------------------
+
+def test_sketch_index_roundtrip_exact():
+    rng = np.random.default_rng(0)
+    for cap in (256, 300, 1024, 100_000):
+        for n in (0, 1, 7, min(cap, 500)):
+            slots = rng.choice(cap, size=n, replace=False)
+            counts, offsets = sketch.encode_index(slots, cap)
+            assert counts.dtype == np.uint16
+            assert offsets.dtype == np.uint8
+            got = sketch.decode_index(counts, offsets)
+            np.testing.assert_array_equal(got, np.sort(slots))
+    # bucket-boundary slots and -1 padding
+    slots = np.array([-1, 0, 255, 256, 511, 1023, -1])
+    counts, offsets = sketch.encode_index(slots, 1024)
+    np.testing.assert_array_equal(sketch.decode_index(counts, offsets),
+                                  [0, 255, 256, 511, 1023])
+    # a fully-occupied bucket is the uint16 counts plane's reason to
+    # exist: 256 survivors in one bucket overflows uint8 by exactly one
+    counts, _ = sketch.encode_index(np.arange(256), 1024)
+    assert int(counts[0]) == 256
+
+
+def test_sketch_codec_error_cases():
+    with pytest.raises(ValueError, match="out of range"):
+        sketch.encode_index([1024], 1024)
+    with pytest.raises(ValueError, match="distinct"):
+        sketch.encode_index([3, 3], 1024)
+    counts, offsets = sketch.encode_index([1, 2], 1024)
+    with pytest.raises(ValueError, match="mismatch"):
+        sketch.decode_index(counts, offsets[:1])
+    payload = sketch.encode([1, 2], {"g": np.zeros((2, DIM), np.float32)},
+                            1024)
+    with pytest.raises(ValueError, match="trailing"):
+        sketch.decode(payload + b"x", 1024,
+                      {"g": (DIM, np.dtype(np.float32))})
+
+
+def test_sketch_payload_roundtrip_values_follow_slots():
+    """Values arrive slot-sorted and field-complete: decode recovers
+    every row of every field against its original slot."""
+    rng = np.random.default_rng(7)
+    rows = 90
+    slots = rng.choice(CAP, size=rows, replace=False)
+    vals = {"h": rng.normal(size=(rows, DIM)).astype(np.float32),
+            "n": rng.normal(size=(rows, 1)).astype(np.float32)}
+    payload = sketch.encode(slots, vals, CAP)
+    got_slots, got = sketch.decode(
+        payload, CAP, {f: (v.shape[1], v.dtype) for f, v in vals.items()})
+    order = np.argsort(slots)
+    np.testing.assert_array_equal(got_slots, slots[order])
+    for f, v in vals.items():
+        np.testing.assert_array_equal(got[f], v[order])
+
+
+# -- plan compile + cache --------------------------------------------------
+
+def test_compile_plan_sketch_route_and_taps():
+    t = LocalTransfer()
+    t.wire_sketch = True
+    plan, hit = compile_window_plan(t, rows=64, capacity=CAP,
+                                    row_bytes=72, quant_row_bytes=None,
+                                    with_counts=True)
+    assert not hit
+    assert plan.wire_format == "sparse_sketch"
+    assert plan.backend == "local" and plan.placement == "flat"
+    assert plan.dedup == "backend" and not plan.ef
+    assert plan.taps == ("decision", "coalesce", "keys")
+    assert plan.prices["sparse_sketch"] < min(plan.prices["sparse"],
+                                              plan.prices["bitmap"])
+    assert plan.spec is FORMAT_TABLE["sparse_sketch"]
+    _, hit = compile_window_plan(t, rows=64, capacity=CAP, row_bytes=72,
+                                 quant_row_bytes=None, with_counts=True)
+    assert hit
+
+
+def test_plan_cache_reprices_on_live_knob_move():
+    """The wire_format Controller knob's contract: writing
+    ``window_expected_unique`` (or flipping ``wire_sketch``) lands in
+    the cache key, so the NEXT window compiles a fresh plan — no
+    invalidation call anywhere."""
+    t = XlaTransfer()
+    t.wire_sketch = True
+    # capacity 100k: the sketch's uint16 counts plane costs 782 base
+    # bytes, amortized only past ~112 rows — 256 rows wins...
+    p1, hit1 = compile_window_plan(t, 256, 100_000, 72, None, True)
+    assert not hit1 and p1.wire_format == "sparse_sketch"
+    # ...but a sharpened E[U] of 8 makes 4-byte indices cheap again and
+    # the plan flips back to plain sparse on the very next compile
+    t.window_expected_unique = 8.0
+    p2, hit2 = compile_window_plan(t, 256, 100_000, 72, None, True)
+    assert not hit2 and p2.wire_format == "sparse"
+    t.wire_sketch = False
+    p3, hit3 = compile_window_plan(t, 256, 100_000, 72, None, True)
+    assert not hit3 and set(p3.prices) == {"sparse", "dense"}
+    # unchanged knobs: cached
+    _, hit4 = compile_window_plan(t, 256, 100_000, 72, None, True)
+    assert hit4
+
+
+def test_every_backend_has_a_window_route():
+    from swiftmpi_tpu.transfer.plan import window_route
+    assert set(WINDOW_ROUTES) == {"local", "xla", "tpu", "hybrid"}
+    with pytest.raises(KeyError, match="no[ \n]+window route"):
+        window_route("rdma")
+
+
+# -- plan-vs-legacy golden parity x4 --------------------------------------
+
+@pytest.mark.parametrize("name", ["local", "xla", "tpu", "hybrid"])
+def test_sketch_armed_state_bit_identical_all_backends(name, devices8):
+    """sparse_sketch is an index-stream encoding, not a value encoding:
+    arming it must leave the applied update bit-identical to the
+    quant-off wire on every backend (EF-compatible by vacuity)."""
+    mesh = ps_mesh()
+    rng = np.random.default_rng(11)
+    t_off, ki, access = make_table(mesh if name in ("tpu", "hybrid")
+                                   else None)
+    t_arm, _, _ = make_table(mesh if name in ("tpu", "hybrid") else None)
+    slots, grads, counts = window_batch(ki, rng)
+    off = backend(name, mesh)
+    arm = backend(name, mesh)
+    arm.wire_sketch = True
+    arm.count_traffic = True
+    got_off = off.push_window(device_state(name, t_off), slots, grads,
+                              access, mean=True, counts=counts)
+    got_arm = arm.push_window(device_state(name, t_arm), slots, grads,
+                              access, mean=True, counts=counts)
+    for f in access.fields:
+        assert np.array_equal(np.asarray(got_off[f]),
+                              np.asarray(got_arm[f])), (name, f)
+    tr = arm.traffic()
+    # the plan decision landed on the sketch rung and was booked there
+    assert tr["window_fmt_sketch"] == 1, (name, tr)
+    assert tr["plan_compiles"] >= 1, (name, tr)
+    assert tr["wire_bytes"] > 0 and tr["dispatches"] >= 1, (name, tr)
+    assert tr["coalesced_rows_in"] >= tr["coalesced_rows_out"] > 0
+
+
+def test_sketch_ledger_books_encoded_size_local_xla_agree():
+    """The eager oracle and the traced XLA interpreter book the SAME
+    series values, and wire_bytes is exactly the codec's byte model:
+    sketch base + unique_rows * (offset + packed values + counts)."""
+    rng = np.random.default_rng(11)
+    table_l, ki, access = make_table()
+    table_x, _, _ = make_table()
+    slots, grads, counts = window_batch(ki, rng)
+    uniq = np.unique(slots[slots >= 0]).size
+    cap = np.asarray(table_l.state["h"]).shape[0]
+    ledgers = {}
+    for name, table in (("local", table_l), ("xla", table_x)):
+        t = backend(name, None)
+        t.wire_sketch = True
+        t.count_traffic = True
+        t.push_window(device_state(name, table), slots, grads, access,
+                      mean=True, counts=counts)
+        ledgers[name] = t.traffic()
+    fgrads = {f: g.reshape(-1, DIM) for f, g in grads.items()}
+    row = grad_row_bytes(fgrads, with_index=False, with_counts=True) \
+        + sketch.OFFSET_BYTES
+    want = sketch.sketch_base_bytes(cap) + uniq * row
+    assert ledgers["local"]["wire_bytes"] == want
+    assert ledgers["local"] == ledgers["xla"]
+    assert ledgers["local"]["coalesced_rows_out"] == uniq
+    assert ledgers["local"]["window_fmt_sketch"] == 1
+
+
+@pytest.mark.parametrize("name", ["local", "xla", "tpu", "hybrid"])
+def test_plan_compile_once_then_cache_hits(name, devices8):
+    """Window 1 compiles the family's plan; window 2 (same shape, same
+    knobs) is a cache hit — both booked on the ledger."""
+    mesh = ps_mesh()
+    table, ki, access = make_table(mesh if name in ("tpu", "hybrid")
+                                   else None)
+    rng = np.random.default_rng(5)
+    t = backend(name, mesh)
+    t.wire_sketch = True
+    t.count_traffic = True
+    state = device_state(name, table)
+    for _ in range(2):
+        slots, grads, counts = window_batch(ki, rng)
+        state = t.push_window(state, slots, grads, access, mean=True,
+                              counts=counts)
+    tr = t.traffic()
+    assert tr["window_fmt_sketch"] == 2, (name, tr)
+    assert tr["plan_compiles"] >= 1, (name, tr)
+    assert tr["plan_cache_hits"] >= 1, (name, tr)
+
+
+def test_hybrid_wire_sketch_forwards_to_tail(devices8):
+    h = HybridTransfer(ps_mesh())
+    assert h.wire_sketch is False
+    h.wire_sketch = True
+    assert h.tail.wire_sketch is True and h.wire_sketch is True

@@ -136,6 +136,7 @@ class TestTraining:
 
 
 class TestRematPolicy:
+    @pytest.mark.slow
     def test_remat_policies_match_no_remat(self):
         """dots and full checkpoint policies re-execute the same ops, so
         the TRAINING trajectory must match the un-remat'd run to

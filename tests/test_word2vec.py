@@ -939,6 +939,7 @@ def test_w2v_dense_logits_trains_and_guards(devices8):
         m3._build_grads()
 
 
+@pytest.mark.slow
 def test_w2v_hogwild_with_dense_logits(devices8):
     """The two opt-ins compose: hogwild workers each compute dense-mode
     grads (capacity-shaped h push) and the ring reconciliation applies
