@@ -578,7 +578,8 @@ W2V_1M_VOCAB = 1_000_000
 
 def build_w2v_1m_model(device, stencil=False, hybrid=False,
                        window_steps=1, pipeline=0, control=None,
-                       wire_quant=None, wire_sketch=False):
+                       wire_quant=None, wire_sketch=False,
+                       collective=None, zipf_s=None, minibatch=None):
     """The 1M-vocab cell's model (BASELINE config #3 shape: demo.conf
     hyperparameters over a ~1M-word Zipf vocabulary / 1.3M-row table).
     ONE builder shared by the bench cell and the profiler ablation
@@ -624,7 +625,23 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
     ``sparse_sketch`` (bucketed uint16 counts + uint8 offsets instead
     of i32 indices; lossless, EF-compatible) where its byte model beats
     sparse/bitmap/sparse_q.  The BENCH_ONLY=scale_sketchwire cell's
-    shape."""
+    shape.
+
+    ``collective``: arm the hot-plane collective ladder ([cluster]
+    collective: auto|sparse_allreduce) — the hybrid head reconcile and
+    the window dense rung may then take the Ok-Topk sparse allreduce
+    (transfer/sparse_allreduce.py) where the touched-fraction
+    crossover beats the dense psum.  The BENCH_ONLY=scale_sparsear
+    cell's knob; ``None`` keeps the legacy bit-identical psum.
+
+    ``zipf_s``: replace the stock ``rng.zipf(1.3) % 1000`` vocab
+    histogram with an exact rank power law ``rank**-s`` — the
+    sparsear cell validates at Zipf(1.0), the shape the collective
+    crossover is priced against.
+
+    ``minibatch``: override [worker] minibatch (drives BOTH the hot-
+    head calibration's batch_rows hint and the seeded touched-fraction
+    draws; the pre-staged bench batches ignore it)."""
     import jax
     import numpy as np
     from swiftmpi_tpu.cluster.cluster import Cluster
@@ -634,7 +651,13 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
 
     V = W2V_1M_VOCAB
     rng = np.random.default_rng(0)
-    counts = np.maximum((rng.zipf(1.3, size=V) % 1000), 1).astype(np.int64)
+    if zipf_s is not None:
+        ranks = np.arange(1, V + 1, dtype=np.float64)
+        p = ranks ** -float(zipf_s)
+        counts = np.maximum((1e8 * p / p.sum()).astype(np.int64), 1)
+    else:
+        counts = np.maximum((rng.zipf(1.3, size=V) % 1000),
+                            1).astype(np.int64)
     vocab = Vocab(keys=np.arange(1, V + 1, dtype=np.uint64),
                   counts=counts, index={})
     cfg = ConfigParser().update({
@@ -644,7 +667,9 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
                        if window_steps > 1 else {}),
                     **({"wire_quant": str(wire_quant)}
                        if wire_quant else {}),
-                    **({"wire_sketch": 1} if wire_sketch else {})},
+                    **({"wire_sketch": 1} if wire_sketch else {}),
+                    **({"collective": str(collective)}
+                       if collective else {})},
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
                      "sample": -1, "learning_rate": 0.05,
                      # BENCH_SCALE_SHARED=1: the batch-shared negative
@@ -669,7 +694,7 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
         # grid halved the cap=262K gather in bf16)
         "server": {"initial_learning_rate": 0.7, "frag_num": 1000,
                    "dtype": os.environ.get("BENCH_DTYPE", "float32")},
-        "worker": {"minibatch": 5000,
+        "worker": {"minibatch": int(minibatch) if minibatch else 5000,
                    # scale_pipeline: the train()-path cell needs the
                    # fused group length in config (the pre-staged cells
                    # pass it to _build_multi_step directly) plus the
@@ -689,7 +714,8 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
 
 
 def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
-                  window_steps=1, wire_quant=None, wire_sketch=False):
+                  window_steps=1, wire_quant=None, wire_sketch=False,
+                  collective=None, zipf_s=None, minibatch=None):
     """BASELINE config #3 shape: the same fused step over a ~1M-word
     vocabulary (1.3M-row table).  Batches are synthesized directly in
     vocab-index space (uniform centers/contexts, Zipf counts for the
@@ -707,7 +733,9 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
     model, rng = build_w2v_1m_model(device, stencil=stencil, hybrid=hybrid,
                                     window_steps=window_steps,
                                     wire_quant=wire_quant,
-                                    wire_sketch=wire_sketch)
+                                    wire_sketch=wire_sketch,
+                                    collective=collective, zipf_s=zipf_s,
+                                    minibatch=minibatch)
     tr0 = None
     if hybrid or window_steps > 1:
         # arm the traffic counters BEFORE the jit build: the per-step
@@ -775,6 +803,16 @@ def _bench_w2v_1m(device, timed_calls, stencil=False, hybrid=False,
         out["routed_rows_per_step"] = round(tr["routed_rows"] / steps, 1)
         out["hot_rows_per_step"] = round(tr["hot_rows"] / steps, 1)
         out["psum_bytes_per_step"] = round(tr["psum_bytes"] / steps, 1)
+        # the collective ladder's gated metric (lower-is-better): the
+        # hot-plane reconcile wire under whichever collective each
+        # window's plan picked, plus the decision mix proving which —
+        # check_traffic_budget's collective-mix floor reads these
+        out["collective"] = str(collective) if collective else "psum"
+        out["hot_psum_bytes_per_step"] = out["psum_bytes_per_step"]
+        out["collective_psum"] = tr.get("collective_psum", 0)
+        out["collective_sparse_ar"] = tr.get("collective_sparse_ar", 0)
+        out["hot_psum_bytes_saved_per_step"] = round(
+            tr.get("hot_psum_bytes_saved", 0) / steps, 1)
         out["overflow_dropped"] = tr["overflow_dropped"]
         out["wire_bytes_per_step"] = round(tr.get("wire_bytes", 0) / steps,
                                            1)
@@ -1306,6 +1344,140 @@ def _bench_w2v_1m_fused(device, timed_calls):
             calibration.ab_verdict(
                 "stencil_fused", arms["xla"],
                 error=out.get("pallas_error", "pallas arm did not run"))
+    return out
+
+
+def _bench_w2v_1m_sparsear(device, timed_calls):
+    """In-cell psum-vs-sparse_allreduce A/B of the hot-plane collective
+    (transfer/sparse_allreduce.py) at the Zipf(1.0) validation shape.
+    Both arms build through the SAME builder
+    (``build_w2v_1m_model(hybrid=True, window_steps=2, zipf_s=1.0)``)
+    so the hot head, table capacity and compiled batch shapes are
+    identical; only ``[cluster] collective`` differs (absent = legacy
+    psum vs ``auto`` = the touched-fraction crossover, seeded from the
+    exact rank power-law histogram).  The cell's own batch is SMALL
+    relative to the replicated head (B=1024 vs the default 16K) and
+    the token stream is drawn BY FREQUENCY from the Zipf(1.0) law —
+    the window's per-shard touched sets then sit well under the head,
+    which is the regime the sparse collective exists for (a 16K
+    uniform batch saturates the head and auto correctly keeps psum).
+    Each arm is warmed by ``_timed_steps``' warmup calls; parity is
+    measured from identical-seed inits and identical batches: the hot
+    planes must agree within the window-AdaGrad envelope
+    |a-b| <= 1e-5 + 1e-3*|a| (the merge changes only the reduction
+    order) and the sharded tail must be BIT-identical (the collective
+    never touches the tail wire; the dense-rung delegation is exact).
+    The gate reads hot_psum_bytes_per_step (lower-is-better) plus the
+    collective decision mix — an armed auto arm that never picks
+    sparse_ar at this shape fails check_traffic_budget outright."""
+    import hashlib
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from swiftmpi_tpu.parameter.sparse_table import hot_name
+
+    PARITY_ENVELOPE = 1e-3
+    V = W2V_1M_VOCAB
+    win = int(os.environ.get("BENCH_SPARSEAR_WINDOW", 2))
+    Bc = int(os.environ.get("BENCH_SPARSEAR_BATCH", 1024))
+    mode = os.environ.get("BENCH_COLLECTIVE", "auto")
+    out = {"vocab": V, "zipf_s": 1.0, "batch": Bc, "push_window": win,
+           "collective": mode,
+           "dtype": os.environ.get("BENCH_DTYPE", "float32")}
+    batch_args = None
+    parity, tails, arms = {}, {}, {}
+    hot_fields = cap = S = None
+    for arm, coll in (("psum", None), ("sparse_ar", mode)):
+        model, _ = build_w2v_1m_model(device, hybrid=True,
+                                      window_steps=win, collective=coll,
+                                      zipf_s=1.0, minibatch=10000)
+        model.transfer.count_traffic = True
+        tr0 = model.transfer.traffic()
+        with jax.default_device(device):
+            step = model._build_multi_step(INNER_STEPS)
+            W = model.window
+            S, cap = Bc + 2 * W, model.table.capacity
+            if batch_args is None:
+                # Zipf(1.0)-weighted token stream, reused verbatim by
+                # the second arm: validation traffic follows the vocab
+                # law, not the uniform synthesis of the throughput cells
+                ranks = np.arange(1, V + 1, dtype=np.float64)
+                pz = ranks ** -1.0
+                pz /= pz.sum()
+                zr = np.random.default_rng(123)
+                tokens = jnp.asarray(
+                    zr.choice(V, size=(INNER_STEPS, S), p=pz), jnp.int32)
+                sent_id = jnp.broadcast_to(
+                    jnp.arange(S, dtype=jnp.int32) // SENT_LEN,
+                    (INNER_STEPS, S))
+                center_pos = jnp.broadcast_to(
+                    W + jnp.arange(Bc, dtype=jnp.int32),
+                    (INNER_STEPS, Bc))
+                half = jnp.asarray(
+                    zr.integers(1, W + 1, size=(INNER_STEPS, Bc)),
+                    jnp.int32)
+                batch_args = (tokens, sent_id, center_pos, half)
+            args = tuple(jax.device_put(x, device) for x in
+                         (model._slot_of_vocab, model._alias_prob,
+                          model._alias_idx) + batch_args)
+            hot_fields = tuple(hot_name(f)
+                               for f in model.access.grad_fields)
+
+            def fresh_state():
+                return {f: jax.device_put(jnp.array(v), device)
+                        for f, v in model.table.state.items()}
+
+            pstate, _, _ = step(fresh_state(), *args, jax.random.key(7))
+            # the replicated head is small — keep it whole for the
+            # envelope check; the 1.3M-row tail compares by digest
+            parity[arm] = {f: np.asarray(pstate[f]) for f in hot_fields}
+            tails[arm] = {
+                f: hashlib.sha1(np.asarray(v).tobytes()).hexdigest()
+                for f, v in pstate.items() if f not in hot_fields}
+            del pstate
+            _, dt, _ = _timed_steps(step, fresh_state(), args,
+                                    timed_calls, jax.random.key(0))
+        arms[arm] = dt / (timed_calls * INNER_STEPS) * 1e3
+        tr = model.transfer.traffic_delta(tr0)
+        # parity call + warmup + timed calls all book on the ledger
+        steps = (1 + WARMUP_CALLS + timed_calls) * INNER_STEPS
+        out[f"{arm}_step_ms"] = round(arms[arm], 3)
+        out[f"{arm}_hot_psum_bytes_per_step"] = round(
+            tr["psum_bytes"] / steps, 1)
+        out[f"{arm}_collective_psum"] = tr.get("collective_psum", 0)
+        out[f"{arm}_collective_sparse_ar"] = tr.get(
+            "collective_sparse_ar", 0)
+        out[f"{arm}_hot_rows_per_step"] = round(tr["hot_rows"] / steps, 1)
+        if arm == "sparse_ar":
+            out["hot_psum_bytes_saved_per_step"] = round(
+                tr.get("hot_psum_bytes_saved", 0) / steps, 1)
+            out["hot_head_rows"] = model.table.n_hot
+            out["seeded_touched_fraction"] = round(float(
+                model.transfer.hot_touched_fraction or 0.0), 4)
+    m = 0.0
+    for f in hot_fields:
+        a, b = parity["psum"][f], parity["sparse_ar"][f]
+        m = max(m, float(np.max(
+            np.abs(a - b) / (1e-5 + PARITY_ENVELOPE * np.abs(a)))))
+    out["parity_score"] = round(m, 4)
+    out["parity_ok"] = bool(m <= 1.0)
+    out["tail_bit_identical"] = bool(tails["psum"] == tails["sparse_ar"])
+    # the gated candidate number is the ARMED arm's reconcile wire; the
+    # psum arm rides along as the in-cell baseline and the headline
+    # reduction is the acceptance ratio (>= 2x at this shape)
+    out["hot_psum_bytes_per_step"] = out["sparse_ar_hot_psum_bytes_per_step"]
+    out["collective_psum"] = out["sparse_ar_collective_psum"]
+    out["collective_sparse_ar"] = out["sparse_ar_collective_sparse_ar"]
+    if out["sparse_ar_hot_psum_bytes_per_step"]:
+        out["hot_psum_reduction_x"] = round(
+            out["psum_hot_psum_bytes_per_step"]
+            / out["sparse_ar_hot_psum_bytes_per_step"], 2)
+    best = min(arms.values())
+    out.update({"words_per_sec": Bc * 1e3 / best,
+                "step_ms": round(best, 3), "span": S, "capacity": cap,
+                "transfer": "hybrid",
+                "rendering": getattr(model, "resolved_rendering", None)})
     return out
 
 
@@ -2215,6 +2387,20 @@ def child_main(which: str) -> None:
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)
         return
+    if os.environ.get("BENCH_ONLY") == "scale_sparsear":
+        # hot-plane collective A/B at the Zipf(1.0) validation shape:
+        # psum vs sparse_allreduce ([cluster] collective, BENCH_COLLECTIVE
+        # default auto), both arms warmed through the SAME builder,
+        # frequency-drawn tokens, small batch vs the replicated head —
+        # the regime where Ok-Topk's split-and-exchange pays.  Records
+        # the gated hot_psum_bytes_per_step, the collective decision
+        # mix, the >= 2x reduction headline and the hot-plane/tail
+        # parity verdicts
+        out["w2v_1m_sparsear"] = _bench_w2v_1m_sparsear(
+            device, max(timed // 2, 1))
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
     if os.environ.get("BENCH_ONLY") == "scale_fused":
         # on-chip Pallas data plane A/B at 1M vocab: the fused stencil-
         # gather kernel vs the XLA chain, both arms inside ONE cell
@@ -2670,6 +2856,7 @@ _SECONDARY_CELLS = (
     ("w2v_1m_qwire", "w2v_1m_qwire", "words_per_sec", "words/s"),
     ("w2v_1m_sketchwire", "w2v_1m_sketchwire", "words_per_sec",
      "words/s"),
+    ("w2v_1m_sparsear", "w2v_1m_sparsear", "words_per_sec", "words/s"),
     ("w2v_1m_pipeline", "w2v_1m_pipeline", "words_per_sec", "words/s"),
     ("w2v_1m_fused", "w2v_1m_fused", "words_per_sec", "words/s"),
     ("w2v_fleet8", "w2v_fleet8", "words_per_sec", "words/s"),

@@ -59,7 +59,13 @@ TRAFFIC_METRICS = ("wire_bytes_per_step", "dispatches_per_step",
                    # the serve_fleet cell — the number the shared
                    # transfer/delta.py codec exists to hold down.  An
                    # exact byte model, so no noise floor.
-                   "delta_bytes_per_publish")
+                   "delta_bytes_per_publish",
+                   # hot-plane reconcile wire under whichever collective
+                   # each window's plan picked (ISSUE 19): the number
+                   # the sparse allreduce exists to hold down.  An
+                   # exact byte model (transfer/sparse_allreduce.py),
+                   # so no noise floor.
+                   "hot_psum_bytes_per_step")
 DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "window_fmt_sparse", "window_fmt_q",
                   "window_fmt_bitmap", "window_fmt_sketch",
@@ -80,7 +86,11 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "retraces", "compile_ms", "peak_hbm_bytes",
                   "serve_fleet_qps", "qps_scaling_x", "delta_publishes",
                   "full_publishes", "delta_vs_full_ratio",
-                  "delta_fmt_mix", "staleness_s", "gates_pass")
+                  "delta_fmt_mix", "staleness_s", "gates_pass",
+                  "collective", "collective_psum", "collective_sparse_ar",
+                  "hot_psum_bytes_saved_per_step", "hot_psum_reduction_x",
+                  "seeded_touched_fraction", "parity_ok",
+                  "tail_bit_identical")
 #: absolute increase a metric must clear before it can regress: wall-
 #: clock metrics jitter run to run while the counter metrics are exact,
 #: so only the former get a floor (ms for the stall split; kernel_ms is
@@ -372,6 +382,28 @@ def decision_mix_violations(cells: dict) -> list:
     return bad
 
 
+def collective_mix_violations(cells: dict) -> list:
+    """Cells that armed the hot-plane collective ladder (``collective``
+    not ``psum``) and booked collective decisions, yet never once chose
+    the sparse allreduce — the decision-mix pattern applied to ISSUE
+    19's ladder: the sparsear cell runs at the Zipf(1.0) validation
+    shape where the touched-fraction crossover MUST price the sparse
+    exchange below the dense psum, so an armed ``auto`` that sits on
+    psum there means the density seeding and the live traffic disagree
+    badly enough that the feature silently no-ops — a gate failure,
+    not a tuning preference."""
+    bad = []
+    for cell, m in sorted(cells.items()):
+        mode = m.get("collective")
+        if mode in (None, "psum"):
+            continue
+        total = float(m.get("collective_psum", 0.0)) \
+            + float(m.get("collective_sparse_ar", 0.0))
+        if total > 0 and float(m.get("collective_sparse_ar", 0.0)) <= 0:
+            bad.append((cell, mode, total))
+    return bad
+
+
 def fleet_violations(cells: dict) -> list:
     """Candidate cells where a member died UNNOTICED — heartbeat gap
     says dead, supervisor log has no exit event.  That is not a
@@ -566,6 +598,15 @@ def main(argv=None) -> int:
         for cell, quant, total in mix:
             print(f"  {cell}: wire_quant={quant} with {total:g} window "
                   "decisions but zero sparse_q/bitmap picks")
+        return 1
+
+    coll = collective_mix_violations(
+        {c: m for c, m in cand.items() if not only or c in only})
+    if coll:
+        print("COLLECTIVE DECISION MIX FAILURE:")
+        for cell, mode, total in coll:
+            print(f"  {cell}: collective={mode} with {total:g} collective "
+                  "decisions but zero sparse_allreduce picks")
         return 1
 
     deaths = fleet_violations(

@@ -481,6 +481,14 @@ def traffic_summary(doc: dict) -> dict:
                 k = "window_fmt_" + labels.get("fmt", "?")
                 bd = transfer.setdefault(backend, {})
                 bd[k] = bd.get(k, 0.0) + total
+            elif name == "transfer/collective":
+                # same folding for the hot-plane collective decision
+                # mix: kind= label -> collective_psum /
+                # collective_sparse_ar (the ledger key names, so the
+                # budget gate's collective-mix floor sees live JSONL)
+                k = "collective_" + labels.get("kind", "?")
+                bd = transfer.setdefault(backend, {})
+                bd[k] = bd.get(k, 0.0) + total
             else:
                 transfer.setdefault(backend, {})[
                     name[len("transfer/"):]] = total
@@ -1059,6 +1067,26 @@ def _print_report(rep: dict) -> None:
                     else f"steps {run['first']}-{run['last']}")
             print(f"  {span}: {run['decision']} "
                   f"({run['windows']} record(s))")
+        # hot-plane collective decision mix (ISSUE 19), next to the
+        # wire-format ladder it extends: which collective the plan
+        # picked per window, per backend, with the booked byte delta
+        coll = {
+            b: {k: v for k, v in m.items()
+                if k.startswith("collective_")
+                or k == "hot_psum_bytes_saved"}
+            for b, m in (rep.get("traffic", {}).get("transfer")
+                         or {}).items()}
+        coll = {b: m for b, m in coll.items()
+                if any(k.startswith("collective_") for k in m)}
+        if coll:
+            print()
+            print("collective decisions (hot plane / dense rung):")
+            for b, m in sorted(coll.items()):
+                saved = m.get("hot_psum_bytes_saved", 0.0)
+                print(f"  {b}: psum={m.get('collective_psum', 0):g} "
+                      f"sparse_ar={m.get('collective_sparse_ar', 0):g}"
+                      + (f" ({saved:,.0f} B saved vs dense)"
+                         if saved else ""))
     if "decisions" in rep:
         print()
         print("control decisions:")

@@ -920,10 +920,16 @@ class KnobDoc(Rule):
 _WIRE_FORMAT_NAMES = frozenset(
     ("dense", "sparse", "bitmap", "sparse_q", "sparse_sketch"))
 
+#: the collective ladder (mirrors transfer.plan.COLLECTIVES minus the
+#: bare "psum", which is also a jax.lax primitive name and would false-
+#: positive on legitimate axis-name plumbing; comparing against either
+#: distinctive member of the ladder is what marks a dispatch)
+_COLLECTIVE_NAMES = frozenset(("sparse_allreduce", "psum_scatter"))
+
 #: attribute/function names whose CALL is the wire-format question
 _PLAN_QUESTIONS = frozenset(
     ("decide_wire_format", "price_window_formats", "window_wire_format",
-     "compile_window_plan"))
+     "compile_window_plan", "price_hot_collectives", "compile_hot_plan"))
 
 #: transfer-layer modules allowed to interpret plans: the interpreter
 #: itself, the plan compiler, and the codec modules its tables point at
@@ -931,7 +937,8 @@ _PLAN_QUESTIONS = frozenset(
 #: the opposite of a backend dispatching on them; delta.py is the
 #: PR-17 row-delta codec, sketch.py the sparse_sketch codec)
 _PLAN_INTERPRETER_FILES = frozenset(
-    ("api.py", "plan.py", "sketch.py", "delta.py"))
+    ("api.py", "plan.py", "sketch.py", "delta.py",
+     "sparse_allreduce.py"))
 
 
 class PlanDispatch(Rule):
@@ -943,7 +950,10 @@ class PlanDispatch(Rule):
     format is a plan-table edit plus a codec module — the moment a
     backend compares against ``"bitmap"`` the table stops being the
     single source of truth and every future rung pays four backends
-    again (the pre-PR-18 tax this rule pins out)."""
+    again (the pre-PR-18 tax this rule pins out).  Collective selection
+    (``"sparse_allreduce"`` vs the dense collectives) is the same
+    dispatch in a different column of the plan table, so comparing
+    against a collective name trips identically."""
 
     id = "PLAN-DISPATCH"
     description = ("wire-format branch or pricing call in a transfer "
@@ -959,9 +969,11 @@ class PlanDispatch(Rule):
             if isinstance(node, ast.Compare):
                 name = self._format_operand(node)
                 if name is not None:
+                    kind = ("collective" if name in _COLLECTIVE_NAMES
+                            else "wire format")
                     yield self.finding(
                         f, node,
-                        f"comparison against wire format {name!r} in a "
+                        f"comparison against {kind} {name!r} in a "
                         "transfer backend — format dispatch belongs in "
                         "the TrafficPlan interpreter "
                         "(transfer/api.py); add formats via "
@@ -979,16 +991,17 @@ class PlanDispatch(Rule):
 
     @staticmethod
     def _format_operand(node: ast.Compare):
-        """The wire-format name a comparison tests against, if any:
-        catches ``x == "bitmap"`` and ``x in ("dense", "sparse")``."""
+        """The wire-format or collective name a comparison tests
+        against, if any: catches ``x == "bitmap"``, ``x ==
+        "sparse_allreduce"`` and ``x in ("dense", "sparse")``."""
+        names = _WIRE_FORMAT_NAMES | _COLLECTIVE_NAMES
         for side in (node.left, *node.comparators):
-            if isinstance(side, ast.Constant) and \
-                    side.value in _WIRE_FORMAT_NAMES:
+            if isinstance(side, ast.Constant) and side.value in names:
                 return side.value
             if isinstance(side, (ast.Tuple, ast.List, ast.Set)):
                 for e in side.elts:
                     if isinstance(e, ast.Constant) and \
-                            e.value in _WIRE_FORMAT_NAMES:
+                            e.value in names:
                         return e.value
         return None
 

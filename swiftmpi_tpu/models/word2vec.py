@@ -283,6 +283,21 @@ class Word2Vec:
         if self.wire_sketch not in (0, 1):
             raise ValueError("[cluster] wire_sketch must be 0 or 1, got "
                              f"{self.wire_sketch!r}")
+        # [cluster] collective: psum|auto|sparse_allreduce — collective
+        # selection for the dense/hot reconcile planes (transfer/
+        # sparse_allreduce.py).  "psum" (default) keeps the legacy dense
+        # collectives bit-identically; "auto" prices the Ok-Topk sparse
+        # split-and-exchange against the dense psum per plan from the
+        # live hot-touch density (seeded from the vocab histogram,
+        # retuned by the Controller); "sparse_allreduce" pins it.
+        # Only meaningful on the hybrid/tpu window paths.
+        self.collective_mode = g("cluster", "collective",
+                                 "psum").to_string()
+        from swiftmpi_tpu.transfer.plan import COLLECTIVE_MODES
+        if self.collective_mode not in COLLECTIVE_MODES:
+            raise ValueError("[cluster] collective must be one of "
+                             f"{COLLECTIVE_MODES}, got "
+                             f"{self.collective_mode!r}")
         # [worker] pipeline: K > 0 turns on the asynchronous input
         # pipeline (io/pipeline.py) — a producer thread renders batches
         # K ahead and eagerly device_puts them so H2D overlaps compute.
@@ -436,6 +451,21 @@ class Word2Vec:
                     "[cluster] wire_sketch has no effect at "
                     "push_window: 1 (per-step pushes ship indexed "
                     "rows); ignoring")
+        if self.collective_mode != "psum":
+            if self.push_window_size > 1 and hasattr(
+                    self.transfer, "collective_mode"):
+                self.transfer.collective_mode = self.collective_mode
+                self.transfer.hot_touched_fraction = \
+                    self._seed_hot_touched_fraction()
+                log.info(
+                    "[cluster] collective: %s armed (seed hot-touch "
+                    "density %.4f)", self.collective_mode,
+                    self.transfer.hot_touched_fraction or 0.0)
+            else:
+                log.warning(
+                    "[cluster] collective: %s has no effect at "
+                    "push_window: 1 (the per-step hot psum is not "
+                    "plan-compiled); ignoring", self.collective_mode)
         prob, alias = build_unigram_alias(self.vocab.counts)
         self._alias_prob = jnp.asarray(prob)
         self._alias_idx = jnp.asarray(alias)
@@ -444,6 +474,30 @@ class Word2Vec:
         log.info("vocab: %d words, %d tokens; table capacity %d",
                  V, self.vocab.total_words, self.table.capacity)
         return self
+
+    def _seed_hot_touched_fraction(self):
+        """Expected fraction of the replicated hot head touched by ONE
+        coalesced window — the density signal the collective crossover
+        prices (key_index.price_hot_collectives): E[unique hot rows] =
+        sum over the head of 1-(1-p_i)^draws with p_i the key's FULL-
+        vocab probability (the window's draws land on the whole vocab,
+        only the head subset is priced), over n_hot.  Same saturation
+        model as the window_expected_unique seed
+        (hashfrag.expected_unique_rows), restricted to the head.
+        ``None`` when there is no hot head — auto then keeps psum."""
+        part = getattr(self.table.key_index, "partition", None)
+        n_hot = int(getattr(part, "n_hot", 0) or 0)
+        if n_hot <= 0:
+            return None
+        c = np.asarray(self.vocab.counts, np.float64).ravel()
+        total = c.sum()
+        if total <= 0:
+            return None
+        head = np.sort(c)[::-1][:n_hot] / total
+        draws = self.push_window_size * self.minibatch
+        touched = float(np.sum(-np.expm1(
+            draws * np.log1p(-np.minimum(head, 1.0)))))
+        return min(touched / n_hot, 1.0)
 
     # -- the fused step ----------------------------------------------------
     def _build_step(self):
@@ -2149,6 +2203,20 @@ class Word2Vec:
                     self.transfer.window_expected_unique or 0.0),
                 propose=self._propose_wire,
                 apply=self._apply_wire))
+        if (self.collective_mode != "psum"
+                and getattr(self.transfer, "name", "") == "hybrid"
+                and self.inner_steps > 1
+                and hasattr(self.transfer, "push_window")):
+            # collective crossover input: the hot-touch density the
+            # sparse-allreduce pricing reads (transfer/plan.py
+            # compile_hot_plan keys its cache on it, so an apply is a
+            # reprice, not an invalidation protocol)
+            knobs.append(Knob(
+                "collective",
+                current=lambda: float(
+                    self.transfer.hot_touched_fraction or 0.0),
+                propose=self._propose_collective,
+                apply=self._apply_collective))
         self.controller = Controller(st, transfer=self.transfer,
                                      sketch=self._control_sketch,
                                      knobs=knobs)
@@ -2326,6 +2394,57 @@ class Word2Vec:
                          "new_expected_unique": float(new),
                          "old_format": _fmt(float(old)),
                          "new_format": _fmt(float(new))})
+
+    def _propose_collective(self, counts, delta):
+        """Refresh the hot-touch density the collective crossover
+        prices by (key_index.price_hot_collectives): recompute the
+        expected touched fraction of the hot head under the DECAYED
+        histogram — the same saturation model the build seeds from the
+        static vocab counts.  Win = relative drift of the fraction.
+        Evidence carries the collective the crossover would pick under
+        the old vs new density (a representative one-field family, like
+        _propose_wire's), so the decision log shows when a retune flips
+        the baked collective rather than just nudging the signal."""
+        if counts is None or self.push_window_size <= 1:
+            return None
+        n_hot = int(self.table.key_index.n_hot)
+        if n_hot <= 0:
+            return None
+        old = getattr(self.transfer, "hot_touched_fraction", None)
+        if old is None:
+            return None
+        from swiftmpi_tpu.control import Proposal
+        from swiftmpi_tpu.parameter.key_index import price_hot_collectives
+        c = np.asarray(counts, np.float64).ravel()
+        total = c.sum()
+        if total <= 0:
+            return None
+        head = np.sort(c)[::-1][:n_hot] / total
+        draws = self.push_window_size * self.minibatch
+        new = min(float(np.sum(-np.expm1(
+            draws * np.log1p(-np.minimum(head, 1.0))))) / n_hot, 1.0)
+
+        def _pick(frac):
+            decision, _ = price_hot_collectives(
+                n_hot, 4 * self.len_vec + 4, frac,
+                sparse_ar_ratio=self.transfer.sparse_ar_ratio)
+            return decision
+
+        return Proposal(float(new),
+                        abs(new - old) / max(float(old), 1e-6),
+                        {"old_touched_fraction": float(old),
+                         "new_touched_fraction": float(new),
+                         "old_collective": _pick(float(old)),
+                         "new_collective": _pick(float(new))})
+
+    def _apply_collective(self, frac, evidence) -> bool:
+        self.transfer.hot_touched_fraction = float(frac)
+        # the collective is baked into the compiled reconcile at trace
+        # time; the hot plan cache keys on the density signal, so this
+        # write IS the reprice — recompile so it takes effect at this
+        # safe point
+        self._rebuild_step()
+        return True
 
     def _apply_wire(self, eu, evidence) -> bool:
         self.transfer.window_expected_unique = float(eu)

@@ -35,6 +35,8 @@ TRANSFER_KEYS = frozenset({
     "wire_bytes", "dispatches",
     "window_sparse", "window_dense",            # legacy 2-way decisions
     "window_fmt",                               # 5-way, fmt= label
+    "collective",                               # psum|sparse_ar, kind=
+    "hot_psum_bytes_saved",                     # sparse_ar wire delta
     "plan_compiles", "plan_cache_hits",         # TrafficPlan compiler
     "coalesced_rows_in", "coalesced_rows_out",
     "pull_bytes", "pull_rows", "pull_hot_rows",

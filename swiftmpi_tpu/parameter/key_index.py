@@ -213,6 +213,47 @@ def price_window_formats(rows: int, capacity: int, row_bytes: int,
     return best, prices
 
 
+def price_hot_collectives(capacity: int, width_bytes: int,
+                          touched_fraction: Optional[float],
+                          sparse_ar_ratio: float = 2.0):
+    """Collective crossover for a replicated/capacity-shaped reconcile
+    (the hybrid hot plane's psum, the window path's dense rung):
+    returns ``(decision, prices)`` with ``decision`` in ``{"psum",
+    "sparse_allreduce"}`` and ``prices`` the modeled byte volume of
+    each candidate — the evidence half, exactly like
+    :func:`price_window_formats`.
+
+    The byte models are the shared ones in
+    :mod:`swiftmpi_tpu.transfer.sparse_allreduce` (so the pricer, the
+    ledger booking and the budget gate agree by construction):
+
+      psum             ``capacity * width_bytes`` — the full buffer,
+                       no index stream
+      sparse_allreduce ``touched * (4 + width_bytes)`` — the touched
+                       (index, value) rows through Ok-Topk's
+                       split-and-exchange, ``touched =
+                       touched_fraction * capacity``
+
+    and the SparCML-style threshold mirrors the window wire crossover:
+    the dense psum keeps winning while ``sparse_vol * sparse_ar_ratio
+    >= dense_vol`` (default ratio 2.0 — "densify once sparse volume
+    passes half the dense size", arXiv:1802.08021).  With no
+    ``touched_fraction`` signal (None — nothing observed the hot-touch
+    density yet) the dense psum wins unconditionally: the sparse
+    collective is only ever an EVIDENCED downgrade."""
+    from swiftmpi_tpu.transfer.sparse_allreduce import (dense_psum_bytes,
+                                                        sparse_ar_bytes)
+    dense_vol = dense_psum_bytes(capacity, width_bytes)
+    if touched_fraction is None:
+        return "psum", {"psum": dense_vol}
+    frac = min(max(float(touched_fraction), 0.0), 1.0)
+    sparse_vol = sparse_ar_bytes(frac * capacity, width_bytes)
+    prices = {"psum": dense_vol, "sparse_allreduce": sparse_vol}
+    if sparse_vol * sparse_ar_ratio >= dense_vol:
+        return "psum", prices
+    return "sparse_allreduce", prices
+
+
 class HotColdPartition:
     """Frequency split of the key space: hot head vs sharded cold tail.
 

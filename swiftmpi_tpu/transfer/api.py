@@ -295,6 +295,8 @@ class Transfer:
                 "window_fmt_dense": 0, "window_fmt_sparse": 0,
                 "window_fmt_q": 0, "window_fmt_bitmap": 0,
                 "window_fmt_sketch": 0,
+                "collective_psum": 0, "collective_sparse_ar": 0,
+                "hot_psum_bytes_saved": 0,
                 "plan_compiles": 0, "plan_cache_hits": 0,
                 "coalesced_rows_in": 0, "coalesced_rows_out": 0,
                 "pull_bytes": 0, "pull_rows": 0, "pull_hot_rows": 0,
@@ -344,6 +346,41 @@ class Transfer:
         st[fmt_key] += 1
         self._obs_inc("window_fmt", 1,
                       fmt=fmt_key[len("window_fmt_"):])
+
+    #: collective decision -> ledger counter (the dense/hot reconcile's
+    #: sibling of ``_WINDOW_FMT_KEY``), mirrored as the kind-labeled
+    #: telemetry series ``transfer/collective{backend=, kind=}``.
+    _COLLECTIVE_KEY = {"psum": "collective_psum",
+                       "psum_scatter": "collective_psum",
+                       "sparse_allreduce": "collective_sparse_ar"}
+
+    def _count_collective(self, collective: str) -> None:
+        """Book one reconcile's collective decision.  Host-side eager —
+        the decision is plan-static per compiled window program, and
+        this fires once per push_window CALL (trace time under jit),
+        mirroring when the plan decision itself is made."""
+        if not getattr(self, "count_traffic", False):
+            return
+        key = self._COLLECTIVE_KEY[collective]
+        self._wire_state()[key] += 1
+        self._obs_inc("collective", 1, kind=key[len("collective_"):])
+
+    def _accum_saved(self, nbytes) -> None:
+        st = self._wire_state()
+        st["hot_psum_bytes_saved"] += int(nbytes)
+        self._obs_inc("hot_psum_bytes_saved", int(nbytes))
+
+    def _record_saved(self, nbytes) -> None:
+        """Record the wire bytes a sparse-allreduce reconcile saved over
+        the dense collective it replaced (``dense model - booked``);
+        traced values land via callback, same discipline as
+        :meth:`_record_exchange`."""
+        if not getattr(self, "count_traffic", False):
+            return
+        if isinstance(nbytes, jax.core.Tracer):
+            jax.debug.callback(self._accum_saved, nbytes)
+        else:
+            self._accum_saved(nbytes)
 
     def _accum_wire(self, row_bytes, rows, ndisp: int = 1,
                     decision: Optional[str] = None,
@@ -608,6 +645,31 @@ class Transfer:
     #: usual step rebuild.  Set from ``[cluster] wire_sketch``.
     wire_sketch = False
 
+    #: collective selection mode for the dense/hot reconcile planes
+    #: (``transfer.plan.COLLECTIVE_MODES``): ``"psum"`` (default — the
+    #: legacy dense collective, bit-identical to the pre-PR wire),
+    #: ``"sparse_allreduce"`` (pin the Ok-Topk split-and-exchange), or
+    #: ``"auto"`` (price by touched-fraction crossover,
+    #: key_index.price_hot_collectives).  Set from ``[cluster]
+    #: collective``; flipping it mid-run requires a step rebuild (the
+    #: collective is baked into the compiled reconcile).
+    collective_mode = "psum"
+
+    #: live hot-touch density signal for the ``auto`` crossover:
+    #: expected fraction of the hot/dense capacity touched per window.
+    #: Seeded by the model from the vocab histogram; retuned online by
+    #: the Controller from the DecayedSketch's hot-touch counts.
+    #: ``None`` = unknown → ``auto`` conservatively keeps the dense
+    #: collective.
+    hot_touched_fraction = None
+
+    #: SparCML-style safety factor on the sparse collective: the dense
+    #: collective wins while ``sparse_bytes * ratio >= dense_bytes``
+    #: (sparse must beat dense by this margin to pay for its irregular
+    #: index stream).  Host-side like wire_dense_ratio — takes effect
+    #: on the next plan compile.
+    sparse_ar_ratio = 2.0
+
     def _ratio_state(self) -> dict:
         st = self.__dict__.get("_wire_ratios")
         if st is None:
@@ -654,6 +716,27 @@ class Transfer:
             tr.on_decision(self.name, plan.wire_format, plan.prices,
                            plan.rows, plan.capacity, plan.row_bytes,
                            quant=plan.quant)
+        return plan
+
+    def _hot_plan(self, n_hot: int, width_bytes: int):
+        """Compile (or fetch) the hot-plane reconcile's
+        :class:`TrafficPlan` (transfer/plan.py's ``compile_hot_plan``) —
+        the hot sibling of :meth:`_window_plan`, with the same
+        observation discipline: compile/hit counters on the wire ledger,
+        and the collective's pricing evidence on the armed wire tracer
+        (decision key ``hot_<collective>`` so hot rows don't collide
+        with the window formats in the trace price cache)."""
+        from swiftmpi_tpu.transfer.plan import compile_hot_plan
+        plan, hit = compile_hot_plan(self, int(n_hot), int(width_bytes))
+        if getattr(self, "count_traffic", False):
+            key = "plan_cache_hits" if hit else "plan_compiles"
+            self._wire_state()[key] += 1
+            self._obs_inc(key, 1)
+        tr = obs.get_tracer()
+        if tr is not None:
+            tr.on_decision(self.name, "hot_" + plan.collective,
+                           plan.prices, plan.rows, plan.capacity,
+                           plan.row_bytes)
         return plan
 
     def decide_wire_format(self, rows: int, capacity: int,
@@ -886,6 +969,37 @@ class Transfer:
         return self.push_span(state, ded_slots, ded_grads, ded_counts,
                               access, mean=mean, _wire=wire)
 
+    def _prim_sparse_allreduce(self, state, flat, fgrads, access,
+                               mean: bool, fcounts):
+        """Backend sparse-allreduce primitive: reconcile the window's
+        touched-row (index, value) set into the full table — the
+        ``sparse_allreduce`` collective of the window ``dense`` rung
+        (Ok-Topk's split-and-exchange; see transfer/sparse_allreduce).
+        Default: the single-program twin — scatter-add merge of
+        duplicate indices + full-table apply, exactly what the
+        reduce-scatter/allgather degenerates to on a one-program world
+        (serves the xla backend and the base class).  Distributed
+        backends override with the real exchange (tpu: the tiled
+        ``psum_scatter`` already IS the balanced reduce-scatter landing
+        each reduced slice on its sharded owner, so no allgather is
+        needed — only the ledger booking differs from the dense
+        collective there)."""
+        from swiftmpi_tpu.transfer.sparse_allreduce import (merge_counts,
+                                                            merge_rows)
+        capacity = next(iter(state.values())).shape[0]
+        dense = {f: merge_rows(flat, jnp.asarray(g), capacity)
+                 for f, g in fgrads.items()}
+        if mean:
+            counts = (fcounts if fcounts is not None
+                      else jnp.ones(flat.shape, jnp.float32))
+            csum = merge_counts(flat, counts, capacity)
+            inv = (1.0 / jnp.maximum(csum, 1.0))[:, None]
+            dense = {f: a * inv for f, a in dense.items()}
+        new_fields = access.apply_push(state, dense)
+        out = dict(state)
+        out.update(new_fields)
+        return out
+
     def _interpret_window_flat(self, state, flat, fgrads, access,
                                mean: bool, fcounts, pre_deduped=False,
                                passthrough=None):
@@ -913,6 +1027,31 @@ class Transfer:
         spec = plan.spec
         decision = plan.wire_format
         if decision == "dense" and route.always_decide:
+            self._count_collective(plan.collective)
+            if plan.collective == "sparse_allreduce":
+                if getattr(self, "count_traffic", False):
+                    from swiftmpi_tpu.transfer.sparse_allreduce import \
+                        ROW_ID_BYTES
+                    val_bytes = grad_row_bytes(fgrads, with_index=False,
+                                               with_counts=mean)
+                    # semantic sparse payload: touched (index, value)
+                    # rows by occupancy — duplicate slots merge for
+                    # free in the local scatter-add, so only unique
+                    # rows pay wire (the booking the budget gate and
+                    # price_hot_collectives both model)
+                    valid = (flat >= 0) & (flat < capacity)
+                    safe = jnp.where(valid, flat, capacity)
+                    occ = jnp.zeros((capacity + 1,), jnp.int32).at[
+                        safe].add(1, mode="drop")
+                    touched = jnp.sum(occ[:capacity] > 0)
+                    self._record_exchange(touched,
+                                          ROW_ID_BYTES + val_bytes,
+                                          decision="dense")
+                    self._record_saved(
+                        capacity * val_bytes
+                        - touched * (ROW_ID_BYTES + val_bytes))
+                return self._prim_sparse_allreduce(
+                    state, flat, fgrads, access, mean, fcounts)
             if getattr(self, "count_traffic", False):
                 # wire volume is the static table size, not the row
                 # count — the `flat[0] * 0 + capacity` token keeps the
@@ -999,14 +1138,27 @@ class Transfer:
                                   jnp.sum(ded_slots >= 0))
         is_hot = (ded_slots >= 0) & (ded_slots < n_hot)
         tail_slots = jnp.where(ded_slots >= n_hot, ded_slots - n_hot, -1)
+        # hot-plane TrafficPlan: the collective decision (psum vs
+        # sparse_allreduce, transfer/plan.py compile_hot_plan) is made
+        # HERE — backends only execute the primitive the plan names.
+        # width_bytes includes the f32 counts column (+4), which is
+        # also the sparse wire's per-row index cost, so the same number
+        # prices both collectives
+        width_bytes = sum(
+            np.dtype(jnp.asarray(g).dtype).itemsize * g.shape[1]
+            for g in ded_grads.values()) + 4
+        hot_plan = self._hot_plan(n_hot, width_bytes)
+        sparse_ar = hot_plan.collective == "sparse_allreduce"
+        self._count_collective(hot_plan.collective)
         # stage the hot/tail split for the wire tracer under the TAIL's
         # name: the tail backend owns the decision-carrying window
         # record this callback's extras attach to (obs/trace.py)
         tr = obs.get_tracer()
         if tr is not None:
             hot_rows = jnp.sum(is_hot)
-            cb = (lambda v, _tr=tr, _n=self.tail.name:
-                  _tr.stage(_n, hot_rows=int(v)))
+            cb = (lambda v, _tr=tr, _n=self.tail.name,
+                  _c=hot_plan.collective:
+                  _tr.stage(_n, hot_rows=int(v), hot_collective=_c))
             if isinstance(hot_rows, jax.core.Tracer):
                 jax.debug.callback(cb, hot_rows)
             else:
@@ -1017,14 +1169,30 @@ class Transfer:
         new_tail = self.tail._interpret_window_flat(
             tail_state, tail_slots, ded_grads, access, mean,
             ded_counts if need_counts else None, pre_deduped=True)
-        if self.count_traffic:
-            width_bytes = sum(
-                np.dtype(jnp.asarray(g).dtype).itemsize * g.shape[1]
-                for g in ded_grads.values()) + 4
-            self._record_hot(jnp.sum(is_hot), n_hot * width_bytes)
-            self._record_exchange(jnp.sum(is_hot) * 0 + n_hot, width_bytes)
-        new_hot = self._hot_push(hot_state, ded_slots, ded_grads, access,
-                                 mean, ded_counts if need_counts else None)
+        if sparse_ar:
+            if self.count_traffic:
+                # semantic sparse payload: ded_slots hold one
+                # representative per slot PER SHARD (the tpu dedup is
+                # device-local), so the hot mask sum is exactly the sum
+                # of per-shard contributed (index, value) sets — the
+                # volume each shard feeds the reduce-scatter — with the
+                # delta vs the dense model landing on
+                # hot_psum_bytes_saved
+                touched = jnp.sum(is_hot)
+                self._record_hot_sparse(touched, width_bytes)
+                self._record_exchange(touched, width_bytes)
+                self._record_saved((n_hot - touched) * width_bytes)
+            new_hot = self._hot_push_sparse(
+                hot_state, ded_slots, ded_grads, access, mean,
+                ded_counts if need_counts else None)
+        else:
+            if self.count_traffic:
+                self._record_hot(jnp.sum(is_hot), n_hot * width_bytes)
+                self._record_exchange(jnp.sum(is_hot) * 0 + n_hot,
+                                      width_bytes)
+            new_hot = self._hot_push(hot_state, ded_slots, ded_grads,
+                                     access, mean,
+                                     ded_counts if need_counts else None)
         out = dict(new_tail)
         out.update({hot_name(f): v for f, v in new_hot.items()})
         return out

@@ -135,6 +135,34 @@ class HybridTransfer(Transfer):
     def wire_sketch(self, v: bool):
         self.tail.wire_sketch = bool(v)
 
+    @property
+    def collective_mode(self) -> str:
+        """Hot/dense collective selection mode (``psum | auto |
+        sparse_allreduce``); storage lives on the tail so the tail's
+        window plan (dense rung) and the hybrid's hot plan — both
+        compiled via transfer/plan.py — read the same knob."""
+        return self.tail.collective_mode
+
+    @collective_mode.setter
+    def collective_mode(self, v: str):
+        self.tail.collective_mode = v
+
+    @property
+    def hot_touched_fraction(self):
+        return self.tail.hot_touched_fraction
+
+    @hot_touched_fraction.setter
+    def hot_touched_fraction(self, v):
+        self.tail.hot_touched_fraction = v
+
+    @property
+    def sparse_ar_ratio(self) -> float:
+        return self.tail.sparse_ar_ratio
+
+    @sparse_ar_ratio.setter
+    def sparse_ar_ratio(self, v: float):
+        self.tail.sparse_ar_ratio = float(v)
+
     def wire_dense_ratio(self, family=None):
         return self.tail.wire_dense_ratio(family)
 
@@ -179,6 +207,19 @@ class HybridTransfer(Transfer):
                 for b, h in pending:
                     self._accum_hot(b, h)
 
+    def _accum_hot_sparse(self, row_bytes: int, hot) -> None:
+        # sparse-allreduce twin of _accum_hot: the byte volume depends
+        # on the TRACED touched-row count (touched * per-row bytes),
+        # not the static head size, so it is computed in the callback
+        self._accum_hot(int(hot) * int(row_bytes), hot)
+
+    def _record_hot_sparse(self, hot, row_bytes: int) -> None:
+        cb = partial(self._accum_hot_sparse, int(row_bytes))
+        if isinstance(hot, jax.core.Tracer):
+            jax.debug.callback(cb, hot)
+        else:
+            self._accum_hot_sparse(int(row_bytes), hot)
+
     def traffic(self) -> Dict[str, int]:
         """Cumulative per-step traffic counters (counted while
         ``count_traffic`` is set): ``routed_rows`` (tail rows through
@@ -197,6 +238,8 @@ class HybridTransfer(Transfer):
         for k in ("wire_bytes", "dispatches", "window_sparse",
                   "window_dense", "window_fmt_dense", "window_fmt_sparse",
                   "window_fmt_q", "window_fmt_bitmap", "window_fmt_sketch",
+                  "collective_psum", "collective_sparse_ar",
+                  "hot_psum_bytes_saved",
                   "plan_compiles", "plan_cache_hits",
                   "coalesced_rows_in", "coalesced_rows_out",
                   "pull_bytes", "pull_rows", "pull_hot_rows"):
@@ -368,3 +411,96 @@ class HybridTransfer(Transfer):
             return out
 
         return _hot
+
+    def _hot_push_sparse(self, hot_state, slots, grads, access, mean,
+                         counts):
+        """Sparse-allreduce hot-plane reconcile (the plan interpreter
+        dispatches here when the hot TrafficPlan's collective says so —
+        this backend never reads the collective name itself)."""
+        with_counts = counts is not None
+        sig = (self.tail._signature(hot_state, slots, grads),
+               mean, with_counts, "sparse_ar")
+        fn = self._hot_push_cache.get(sig)
+        if fn is None:
+            from swiftmpi_tpu.obs import costs as obs_costs
+            fn = self._hot_push_cache.setdefault(
+                sig, obs_costs.track("hybrid_hot_push_sparse", jax.jit(
+                    self._build_hot_push_sparse(
+                        hot_state, access, tuple(sorted(grads)), mean,
+                        with_counts))))
+        if with_counts:
+            return fn(hot_state, slots, grads,
+                      jnp.asarray(counts, jnp.float32))
+        return fn(hot_state, slots, grads)
+
+    def _build_hot_push_sparse(self, hot_state, access, grad_fields,
+                               mean, with_counts):
+        """Ok-Topk split-and-exchange for the replicated hot head
+        (transfer/sparse_allreduce): each shard scatter-adds its local
+        touched rows into a bucket-PERMUTED dense accumulator (row r →
+        bucket r % n, so the frequency-ranked Zipf head spreads evenly
+        over shards), a tiled ``psum_scatter`` over the permuted layout
+        is the balanced reduce-scatter merging duplicate indices, and
+        an ``all_gather`` + unpermute is the sparse allgather
+        rebroadcasting the reduced rows to every replica.  Semantically
+        identical to the dense psum up to float reduction order (the
+        parity test pins allclose, not bit-identity); the wire ledger
+        books the touched-row payload a variable-length wire ships —
+        see the module docstring of transfer/sparse_allreduce."""
+        from swiftmpi_tpu.transfer.sparse_allreduce import (
+            bucket_layout, bucket_permute, bucket_unpermute)
+        n_hot = next(iter(hot_state.values())).shape[0]
+        n = int(self.mesh.shape[self.axis])
+        cap_bucket, n_pad = bucket_layout(n_hot, n)
+        bspec = self.tail._batch_spec()
+        dp_axis = self.tail.dp_axis
+        state_specs = {f: P() for f in hot_state}
+        grad_specs = {f: bspec for f in grad_fields}
+        in_specs = (state_specs, bspec, grad_specs)
+        if with_counts:
+            in_specs += (bspec,)
+
+        def _reduce_bucketed(plane):
+            # permuted layout → tiled psum_scatter IS the balanced
+            # reduce-scatter over row-hash buckets; the all_gather is
+            # the sparse allgather back to the replicated head
+            b = bucket_permute(plane, n)
+            b = jax.lax.psum_scatter(b, self.axis, scatter_dimension=0,
+                                     tiled=True)
+            if dp_axis:
+                b = jax.lax.psum(b, dp_axis)
+            g = jax.lax.all_gather(b, self.axis, axis=0, tiled=True)
+            return bucket_unpermute(g, n)[:n_hot]
+
+        @partial(jax.shard_map, mesh=self.mesh, in_specs=in_specs,
+                 out_specs=state_specs, check_vma=False)
+        def _hot_sparse(hot_l, slots_l, grads_l, *maybe_counts):
+            valid = (slots_l >= 0) & (slots_l < n_hot)
+            # tail and padding slots scatter out-of-bounds and drop;
+            # pad rows [n_hot, n_pad) are never touched and contribute
+            # exact zeros through the exchange
+            safe = jnp.where(valid, slots_l, n_pad)
+            if with_counts:
+                c = maybe_counts[0] * valid
+            else:
+                c = valid.astype(jnp.float32)
+            acc = {}
+            for f in grad_fields:
+                g = jnp.asarray(grads_l[f])
+                local = jnp.zeros((n_pad, g.shape[1]), g.dtype).at[
+                    safe].add(g * valid[:, None].astype(g.dtype),
+                              mode="drop")
+                with jax.named_scope("wire_exchange"):
+                    acc[f] = _reduce_bucketed(local)
+            csum = _reduce_bucketed(
+                jnp.zeros((n_pad,), jnp.float32).at[safe].add(
+                    c, mode="drop"))
+            if mean:
+                inv = (1.0 / jnp.maximum(csum, 1.0))[:, None]
+                acc = {f: a * inv for f, a in acc.items()}
+            new_fields = access.apply_push(hot_l, acc)
+            out = dict(hot_l)
+            out.update(new_fields)
+            return out
+
+        return _hot_sparse

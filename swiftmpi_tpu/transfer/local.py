@@ -131,6 +131,39 @@ class LocalTransfer(Transfer):
             sums[f] = acc
         return uniq, sums, csum
 
+    def _prim_sparse_allreduce(self, state, flat, fgrads, access, mean,
+                               fcounts):
+        """Eager numpy sparse-allreduce twin: on the one-"shard" oracle
+        world the split-and-exchange degenerates to merging duplicate
+        indices (``np.unique`` + ``np.add.at``) and applying the touched
+        rows — the exactness reference the device collective's merge is
+        diffed against in tests/test_sparse_allreduce.py."""
+        flat = np.asarray(flat, np.int64)
+        capacity = next(iter(state.values())).shape[0]
+        valid = (flat >= 0) & (flat < capacity)
+        uniq = np.unique(flat[valid])
+        pos = np.searchsorted(uniq, flat[valid])
+        counts = (np.asarray(fcounts, np.float32)
+                  if fcounts is not None
+                  else np.ones(flat.shape, np.float32))
+        csum = np.zeros((len(uniq),), np.float32)
+        np.add.at(csum, pos, counts[valid])
+        combined = {}
+        for f, g in fgrads.items():
+            g = np.asarray(g, np.float32)
+            acc = np.zeros((len(uniq), g.shape[1]), np.float32)
+            np.add.at(acc, pos, g[valid])
+            if mean:
+                acc /= np.maximum(csum, 1.0)[:, None]
+            combined[f] = acc
+        current = {f: np.asarray(state[f])[uniq]
+                   for f in access.touched_fields(fgrads)}
+        updated = access.apply_push(current, combined)
+        out = {f: np.asarray(state[f]).copy() for f in state}
+        for f in updated:
+            out[f][uniq] = np.asarray(updated[f])
+        return out
+
     def _prim_ef_drain(self, state, uniq, sums, capacity, quant):
         """Eager EF drain: residual in, quantize the SUM, bank the new
         error — same order of operations as api.ef_quantize_window,

@@ -42,6 +42,21 @@ from swiftmpi_tpu.transfer.sketch import OFFSET_BYTES, sketch_base_bytes
 #: execute a format this table doesn't know.
 WIRE_FORMATS = ("dense", "sparse", "bitmap", "sparse_q", "sparse_sketch")
 
+#: the collective ladder for the dense/hot reconcile planes (ISSUE 19):
+#: ``psum`` (hybrid hot head, full replicated buffer), ``psum_scatter``
+#: (window dense rung, capacity-shaped tiles) and ``sparse_allreduce``
+#: (transfer/sparse_allreduce.py — touched (index, value) rows through
+#: Ok-Topk's split-and-exchange).  Every ``TrafficPlan.collective`` the
+#: compiler can emit appears here.
+COLLECTIVES = ("psum", "psum_scatter", "sparse_allreduce")
+
+#: legal values of the ``collective`` knob (``[cluster] collective:``):
+#: ``psum`` pins the dense collectives (bit-identical legacy wire),
+#: ``sparse_allreduce`` pins the sparse collective wherever a plan has
+#: one, ``auto`` prices the crossover per plan from the live hot-touch
+#: density signal.
+COLLECTIVE_MODES = ("psum", "auto", "sparse_allreduce")
+
 
 @dataclass(frozen=True)
 class WireFormatSpec:
@@ -215,9 +230,11 @@ def compile_window_plan(transfer, rows: int, capacity: int,
     dense_ratio = transfer.wire_dense_ratio(family)
     expected_unique = transfer.window_expected_unique
     guard = transfer.wire_quant_guard
+    mode = _collective_mode(transfer)
     key = (transfer.name, family, int(rows), int(capacity),
            int(row_bytes), quant_row_bytes, quant, sketch, dense_ratio,
-           expected_unique, guard, bool(with_counts))
+           expected_unique, guard, bool(with_counts),
+           mode, transfer.hot_touched_fraction, transfer.sparse_ar_ratio)
     plan = _PLAN_CACHE.get(key)
     if plan is not None:
         return plan, True
@@ -236,14 +253,92 @@ def compile_window_plan(transfer, rows: int, capacity: int,
         taps += ("keys",)
     if spec.ef:
         taps += ("ef", "numerics")
+    if decision == "dense":
+        collective, coll_prices = _dense_rung_collective(
+            transfer, mode, prices, int(capacity), int(row_bytes))
+        prices = dict(prices, **coll_prices)
+    else:
+        collective = route.collective
     plan = TrafficPlan(
         family=family or "window", backend=transfer.name,
         placement=route.placement, dedup=dedup, wire_format=decision,
         quant=quant, ef=spec.ef,
-        collective="psum_scatter" if decision == "dense"
-        else route.collective,
+        collective=collective,
         taps=taps, rows=int(rows), capacity=int(capacity),
         row_bytes=int(row_bytes), quant_row_bytes=quant_row_bytes,
+        priced=tuple(sorted(prices.items())))
+    if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+        _PLAN_CACHE.clear()
+    _PLAN_CACHE[key] = plan
+    return plan, False
+
+
+def _collective_mode(transfer) -> str:
+    """The transfer's ``collective`` knob value, validated against
+    :data:`COLLECTIVE_MODES`.  ``psum`` (the class default) keeps every
+    plan on its legacy dense collective — bit-identical wire."""
+    mode = getattr(transfer, "collective_mode", "psum")
+    if mode not in COLLECTIVE_MODES:
+        raise ValueError(
+            f"transfer.plan: unknown collective mode {mode!r} "
+            f"(expected one of {COLLECTIVE_MODES})")
+    return mode
+
+
+def _dense_rung_collective(transfer, mode: str, prices, capacity: int,
+                           row_bytes: int):
+    """Collective for a window that DENSIFIED: the legacy capacity-
+    shaped ``psum_scatter``, or ``sparse_allreduce`` when the knob pins
+    it / the touched-fraction crossover prices the sparse exchange
+    below the dense tiles.  The density signal for the flat dense rung
+    is the pricer's own effective-unique estimate (``prices["sparse"]``
+    already IS the sparse (index, value) volume over ``eff`` rows) —
+    the collective can rescue a window densified by an aggressively
+    tuned per-family dense ratio, at its own ``sparse_ar_ratio``
+    guard.  Returns ``(collective, extra_prices)``."""
+    if mode == "psum":
+        return "psum_scatter", {}
+    if mode == "sparse_allreduce":
+        return "sparse_allreduce", {}
+    from swiftmpi_tpu.parameter.key_index import price_hot_collectives
+    eff_fraction = prices["sparse"] / (4.0 + row_bytes) / max(capacity, 1)
+    decision, coll_prices = price_hot_collectives(
+        capacity, row_bytes, eff_fraction,
+        sparse_ar_ratio=transfer.sparse_ar_ratio)
+    return ("sparse_allreduce" if decision == "sparse_allreduce"
+            else "psum_scatter"), coll_prices
+
+
+def compile_hot_plan(transfer, n_hot: int, width_bytes: int,
+                     ) -> Tuple[TrafficPlan, bool]:
+    """Compile (or fetch) the hot-plane reconcile plan for the hybrid
+    backend's replicated head: ONE decision — ``collective`` in
+    ``{psum, sparse_allreduce}`` — priced by the touched-fraction
+    crossover (``parameter.key_index.price_hot_collectives``) from the
+    live density signal ``transfer.hot_touched_fraction`` (seeded from
+    the vocab histogram, retuned online via the Controller's
+    ``collective`` knob — moving it lands a NEW cache key, so the next
+    window re-prices with no invalidation protocol, exactly like the
+    wire-format knobs).  Returns ``(plan, cache_hit)``."""
+    mode = _collective_mode(transfer)
+    fraction = transfer.hot_touched_fraction
+    ratio = transfer.sparse_ar_ratio
+    key = (transfer.name, "hot", int(n_hot), int(width_bytes),
+           mode, fraction, ratio)
+    plan = _PLAN_CACHE.get(key)
+    if plan is not None:
+        return plan, True
+    from swiftmpi_tpu.parameter.key_index import price_hot_collectives
+    decision, prices = price_hot_collectives(
+        int(n_hot), int(width_bytes), fraction, sparse_ar_ratio=ratio)
+    if mode != "auto":
+        decision = mode
+    plan = TrafficPlan(
+        family="hot", backend=transfer.name, placement="hot",
+        dedup="pre_deduped", wire_format="dense", quant="off", ef=False,
+        collective=decision, taps=("decision",),
+        rows=int(round((fraction or 0.0) * n_hot)), capacity=int(n_hot),
+        row_bytes=int(width_bytes), quant_row_bytes=None,
         priced=tuple(sorted(prices.items())))
     if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
         _PLAN_CACHE.clear()

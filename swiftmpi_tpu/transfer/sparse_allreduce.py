@@ -1,0 +1,141 @@
+"""Ok-Topk-style sparse allreduce collective for the dense/hot planes.
+
+ROADMAP item 4 deferred "near-optimal sparse allreduce (Ok-Topk,
+PAPERS.md) as an alternative collective for the dense/hot planes" —
+this module is that collective.  The hybrid backend's hot-plane
+reconcile and the tpu window path's ``dense`` rung both reconcile a
+replicated/capacity-shaped buffer with ONE dense reduction per push
+(``psum`` / ``psum_scatter``), paying O(capacity·d) wire bytes even
+when only a fraction of the rows were touched in the window.  Ok-Topk's
+split-and-exchange shape fixes the wire model: each shard contributes
+its **touched-row (index, value) set**, a balanced reduce-scatter over
+row-hash buckets merges duplicate indices with scatter-add, and a
+sparse allgather rebroadcasts the reduced rows.
+
+The pieces here are deliberately small and backend-free:
+
+* :func:`merge_rows` — the scatter-add merge kernel (duplicate indices
+  summed into their row), the reduce half every backend primitive
+  shares and the thing the numpy merge oracle in
+  tests/test_sparse_allreduce.py pins.
+* :func:`bucket_layout` / :func:`bucket_permute` /
+  :func:`bucket_unpermute` — the balanced row-hash bucketing.  Row
+  ``r``'s bucket owner is ``r % n_shards`` (round-robin): hot slots are
+  frequency-RANKED, so contiguous blocks would pile the whole Zipf head
+  onto shard 0 — the modular hash spreads ranks evenly, which is what
+  makes the reduce-scatter balanced.
+* :func:`sparse_ar_bytes` / :func:`dense_psum_bytes` — the shared wire
+  byte models.  The pricer (``parameter.key_index.
+  price_hot_collectives``), the ledger booking (api.py's interpreter)
+  and the budget gate all read these two functions, so the crossover
+  evidence and the booked bytes can never drift apart.
+
+Shapes stay static (XLA): the exchanged buffers are capacity-shaped
+like the tpu backend's ``(n, C)`` request buckets, and — exactly like
+that backend's routed ledger — the wire ledger books the SEMANTIC
+sparse payload (touched rows × (index + value bytes)), not the padded
+buffer, because that is what a variable-length wire implementation
+ships.  SparCML (arXiv:1802.08021) supplies the density threshold the
+crossover prices by; the plan compiler (transfer/plan.py) turns the
+decision into a ``TrafficPlan.collective`` row the api.py interpreter
+executes via ``_prim_sparse_allreduce`` / the hybrid hot-plane
+primitive — backends never compare collective names (the PLAN-DISPATCH
+lint rule covers the collective strings too as of this PR).
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax.numpy as jnp
+
+#: one int32 row id per touched row on the sparse wire
+ROW_ID_BYTES = 4
+
+#: collective decisions the hot-plane pricer can return; mirrored by
+#: ``transfer.plan.COLLECTIVES`` (which adds the window dense rung's
+#: ``psum_scatter``).
+HOT_COLLECTIVES = ("psum", "sparse_allreduce")
+
+
+def sparse_ar_bytes(touched_rows: float, width_bytes: int) -> float:
+    """Modeled wire volume of one sparse allreduce reconcile:
+    ``touched`` (index, value) rows through the split-and-exchange.
+    Booked per exchange like the dense psum's single
+    ``capacity * width`` booking — the ring/bidirectional factor is
+    identical for both collectives, so it cancels out of the crossover
+    and is left out of both models."""
+    return float(touched_rows) * (ROW_ID_BYTES + float(width_bytes))
+
+
+def dense_psum_bytes(capacity: int, width_bytes: int) -> float:
+    """Modeled wire volume of the dense reconcile it replaces: the full
+    replicated/capacity-shaped buffer, no index stream."""
+    return float(capacity) * float(width_bytes)
+
+
+def bucket_layout(n_rows: int, n_shards: int) -> Tuple[int, int]:
+    """``(cap_bucket, n_padded)`` for the balanced row-hash bucketing of
+    ``n_rows`` rows over ``n_shards`` reduce-scatter buckets: each shard
+    owns ``cap_bucket = ceil(n_rows / n_shards)`` rows and the padded
+    row space is ``n_shards * cap_bucket`` (pad rows are never touched,
+    contribute exact zeros, and are dropped by the unpermute)."""
+    n_shards = max(int(n_shards), 1)
+    cap_bucket = -(-int(n_rows) // n_shards) if n_rows else 0
+    return cap_bucket, n_shards * cap_bucket
+
+
+def bucket_permute(dense, n_shards: int):
+    """Reorder a ``(n_padded, ...)`` row-major buffer into bucket-major
+    order ``[shard0's rows | shard1's rows | ...]`` where row ``r``
+    belongs to shard ``r % n_shards`` at bucket-local index
+    ``r // n_shards``.  A pure reshape/transpose — after it, a tiled
+    ``psum_scatter`` over the leading axis IS the balanced reduce-
+    scatter over row-hash buckets."""
+    n_pad = dense.shape[0]
+    cap_bucket = n_pad // int(n_shards)
+    rest = dense.shape[1:]
+    return jnp.transpose(
+        dense.reshape((cap_bucket, int(n_shards)) + rest),
+        (1, 0) + tuple(range(2, dense.ndim + 1))
+    ).reshape((n_pad,) + rest)
+
+
+def bucket_unpermute(bucketed, n_shards: int):
+    """Inverse of :func:`bucket_permute`: bucket-major (the allgather's
+    concatenation of per-shard reduced buckets) back to row-major."""
+    n_pad = bucketed.shape[0]
+    cap_bucket = n_pad // int(n_shards)
+    rest = bucketed.shape[1:]
+    return jnp.transpose(
+        bucketed.reshape((int(n_shards), cap_bucket) + rest),
+        (1, 0) + tuple(range(2, bucketed.ndim + 1))
+    ).reshape((n_pad,) + rest)
+
+
+def merge_rows(slots, values, capacity: int):
+    """Scatter-add merge of duplicate row indices — the reduce half of
+    the sparse allreduce: every contribution ``values[i]`` lands in row
+    ``slots[i]`` of a ``(capacity, width)`` accumulator, duplicates
+    summed, ``slot < 0`` (padding / non-representative dedup rows) and
+    ``slot >= capacity`` contributions dropped.  The numpy oracle in
+    tests/test_sparse_allreduce.py pins this against ``np.add.at``."""
+    slots = jnp.asarray(slots, jnp.int32)
+    values = jnp.asarray(values)
+    valid = (slots >= 0) & (slots < capacity)
+    safe = jnp.where(valid, slots, capacity)
+    acc = jnp.zeros((capacity,) + values.shape[1:], values.dtype)
+    mask = valid.reshape((-1,) + (1,) * (values.ndim - 1))
+    return acc.at[safe].add(values * mask.astype(values.dtype),
+                            mode="drop")
+
+
+def merge_counts(slots, counts, capacity: int):
+    """Width-0 twin of :func:`merge_rows` for the contribution-count
+    plane (``mean`` normalization divides by these post-merge)."""
+    slots = jnp.asarray(slots, jnp.int32)
+    counts = jnp.asarray(counts, jnp.float32)
+    valid = (slots >= 0) & (slots < capacity)
+    safe = jnp.where(valid, slots, capacity)
+    return jnp.zeros((capacity,), jnp.float32).at[safe].add(
+        counts * valid, mode="drop")

@@ -472,6 +472,21 @@ class TpuTransfer(Transfer):
         # api.Transfer._interpret_window_flat before this primitive runs
         return fn(state, flat, fgrads, counts_in)
 
+    def _prim_sparse_allreduce(self, state, flat, fgrads, access, mean,
+                               fcounts):
+        """Sparse-allreduce primitive for the sharded table: the dense
+        rung's tiled ``psum_scatter`` already IS the balanced
+        reduce-scatter — each shard's summed slice lands directly on
+        its owner, and a SHARDED target needs no allgather leg at all
+        (Ok-Topk's rebroadcast only exists for replicated state, the
+        hybrid hot head).  The compute is therefore identical to the
+        dense collective and the flip is bit-identical on this backend;
+        what changes is the WIRE MODEL — the interpreter books the
+        touched-row (index, value) payload instead of the full
+        capacity-shaped buffer (see transfer/sparse_allreduce)."""
+        return self._push_window_dense(state, flat, fgrads, access,
+                                       mean, fcounts)
+
     def _build_push_window_dense(self, state, access, grad_fields, mean):
         capacity = next(iter(state.values())).shape[0]
         bspec = self._batch_spec()

@@ -252,7 +252,11 @@ class XlaTransfer(Transfer):
     # the base `_prim_window_dedup` (single-device representative
     # trick) + `push_span` ARE this backend's primitives — the traced
     # single-device twin the parity tests diff the tpu/hybrid windows
-    # against.
+    # against.  The same holds for `_prim_sparse_allreduce`: the base
+    # class's single-program scatter-add merge + full-table apply
+    # (transfer/sparse_allreduce.merge_rows) is exactly what Ok-Topk's
+    # reduce-scatter/allgather degenerates to on one program, so this
+    # backend inherits it unchanged.
 
     def _push_sparse(self, state, slots, grads, access, mean=False):
         capacity = next(iter(state.values())).shape[0]

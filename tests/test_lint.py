@@ -287,6 +287,22 @@ def test_telemetry_checks_obs_inc_wrapper(tmp_path):
     assert "transfer/not_a_ledger_key" in new[0].message
 
 
+def test_telemetry_covers_collective_series(tmp_path):
+    """ISSUE 19 satellite: the collective-decision mirror
+    (`transfer/collective{kind=}`) and the sparse-allreduce byte delta
+    (`transfer/hot_psum_bytes_saved`) are catalog-declared; a typo'd
+    collective key trips like any other ledger key."""
+    new = lint_src(tmp_path, "pkg/transfer/fancy.py", """
+    class FancyTransfer:
+        def reconcile(self):
+            self._obs_inc("collective", 1, kind="sparse_ar")
+            self._obs_inc("hot_psum_bytes_saved", 4096)
+            self._obs_inc("hot_psum_bytes_savd", 4096)
+    """)
+    assert [f.rule for f in new] == ["TELEMETRY-CATALOG"]
+    assert "transfer/hot_psum_bytes_savd" in new[0].message
+
+
 def test_telemetry_covers_collector_module(tmp_path):
     """ISSUE 12 satellite: the fleet collector's registry mirror is NOT
     exempt from the catalog — its fleet/* gauges must be declared like
@@ -592,6 +608,48 @@ def test_plan_dispatch_trips_on_pricing_call_in_backend(tmp_path):
     """)
     assert [f.rule for f in new] == ["PLAN-DISPATCH"]
     assert "decide_wire_format" in new[0].message
+
+
+def test_plan_dispatch_trips_on_collective_branch_in_backend(tmp_path):
+    """Collective selection is the same dispatch in another plan-table
+    column: a backend comparing against `sparse_allreduce` (or picking
+    between the dense collectives by name) trips like a wire-format
+    branch."""
+    new = lint_src(tmp_path, "pkg/transfer/custom.py", """
+    def reconcile(self, state, coll):
+        if coll == "sparse_allreduce":
+            return state
+        if coll in ("psum_scatter",):
+            return state
+        return state
+    """)
+    assert [f.rule for f in new] == ["PLAN-DISPATCH", "PLAN-DISPATCH"]
+    assert "collective 'sparse_allreduce'" in new[0].message
+
+
+def test_plan_dispatch_trips_on_hot_pricing_call_in_backend(tmp_path):
+    new = lint_src(tmp_path, "pkg/transfer/rdma.py", """
+    def reconcile(self, n_hot, wb):
+        return self.compile_hot_plan(n_hot, wb)
+    """)
+    assert [f.rule for f in new] == ["PLAN-DISPATCH"]
+    assert "compile_hot_plan" in new[0].message
+
+
+def test_plan_dispatch_collective_passes_in_interpreter_and_codec(
+        tmp_path):
+    """api.py/plan.py own the collective dispatch, and the
+    sparse_allreduce codec module implements it — none of them trip."""
+    src = """
+    def interp(self, transfer, plan):
+        if plan.collective == "sparse_allreduce":
+            return self.price_hot_collectives(8, 36, 0.1)
+    """
+    for rel in ("pkg/transfer/api.py", "pkg/transfer/plan.py",
+                "pkg/transfer/sparse_allreduce.py",
+                "pkg/control/tuner.py"):
+        assert "PLAN-DISPATCH" not in rules_of(
+            lint_src(tmp_path, rel, src)), rel
 
 
 def test_plan_dispatch_exempts_interpreter_codec_and_non_transfer(tmp_path):

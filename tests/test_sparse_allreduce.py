@@ -1,0 +1,412 @@
+"""Sparse allreduce collective tests (ISSUE 19 acceptance).
+
+The contract under test, per docs/ARCHITECTURE.md "Sparse allreduce
+collective":
+
+* ``merge_rows``/``merge_counts`` — the scatter-add merge kernel —
+  match a from-scratch ``np.add.at`` oracle, padding and out-of-range
+  contributions dropped; the balanced row-hash bucketing round-trips.
+* ``collective: psum`` pinned is bit-identical to the class default on
+  every backend (the escape hatch really is a no-op).
+* The hybrid hot plane under ``sparse_allreduce`` reaches the same
+  state as the dense psum reconcile (float-order noise only), books
+  the SEMANTIC sparse payload, and the tpu window path's dense-rung
+  flip is bit-identical (psum_scatter already lands slices on their
+  owners — delegation, not a new exchange).
+* The EF telescope survives the collective flip: residual planes are
+  bit-equal between the psum and sparse_allreduce arms.
+* The ``price_hot_collectives`` crossover and the plan-cache
+  reprice-on-knob-move behave exactly like the wire-format pricer.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.cluster import SHARD_AXIS, ps_mesh
+from swiftmpi_tpu.parameter import KeyIndex, SparseTable, w2v_access
+from swiftmpi_tpu.parameter.key_index import (HotColdPartition,
+                                              price_hot_collectives)
+from swiftmpi_tpu.parameter.sparse_table import ef_name
+from swiftmpi_tpu.transfer.hybrid import HybridTransfer
+from swiftmpi_tpu.transfer.local import LocalTransfer
+from swiftmpi_tpu.transfer.plan import (clear_plan_cache,
+                                        compile_hot_plan)
+from swiftmpi_tpu.transfer.sparse_allreduce import (ROW_ID_BYTES,
+                                                    bucket_layout,
+                                                    bucket_permute,
+                                                    bucket_unpermute,
+                                                    dense_psum_bytes,
+                                                    merge_counts,
+                                                    merge_rows,
+                                                    sparse_ar_bytes)
+from swiftmpi_tpu.transfer.tpu import TpuTransfer
+from swiftmpi_tpu.transfer.xla import XlaTransfer
+
+DIM = 8
+
+
+@pytest.fixture(autouse=True)
+def fresh_plan_cache():
+    clear_plan_cache()
+    yield
+    clear_plan_cache()
+
+
+def make_table(mesh=None, num_shards=8, cap=128, seed=0):
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    ki = KeyIndex(num_shards, cap)
+    table = SparseTable(access, ki, mesh=mesh,
+                        axis=SHARD_AXIS if mesh else None, seed=seed)
+    return table, ki, access
+
+
+def window_batch(ki, rng, W=4, B=64, key_hi=700):
+    keys = rng.integers(0, key_hi, size=W * B).astype(np.uint64)
+    slots = np.asarray(ki.lookup(keys), np.int32).reshape(W, B)
+    slots[:, ::7] = -1
+    grads = {f: rng.normal(size=(W, B, DIM)).astype(np.float32)
+             for f in ("h", "v")}
+    counts = rng.integers(1, 4, size=(W, B)).astype(np.float32)
+    counts[slots < 0] = 0
+    return slots, grads, counts
+
+
+def zipf_counts(v, s=1.0, total=1_000_000):
+    ranks = np.arange(1, v + 1, dtype=np.float64)
+    p = ranks ** -s
+    return np.maximum((total * p / p.sum()).astype(np.int64), 1)
+
+
+def make_hybrid_table(mesh, n_keys=400, num_shards=8, cap=64, seed=0):
+    rng = np.random.default_rng(seed)
+    keys = rng.choice(100_000, size=n_keys,
+                      replace=False).astype(np.uint64)
+    counts = zipf_counts(n_keys)[rng.permutation(n_keys)]
+    part = HotColdPartition.from_counts(keys, counts, batch_rows=64)
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    ki = KeyIndex(num_shards, cap, partition=part)
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS)
+    ki.lookup(keys)                     # materialize the tail
+    return table, keys, access, counts / counts.sum()
+
+
+def hybrid_window(keys, ki, rng, W=4, B=64, p=None):
+    """A (W, B) window over the hybrid table's key set; pass ``p``
+    (the Zipf probabilities) to draw by frequency — the shape the
+    touched-fraction crossover prices."""
+    kk = keys[rng.choice(len(keys), size=W * B, p=p)]
+    slots = np.asarray(ki.lookup(kk), np.int32).reshape(W, B)
+    slots[:, ::7] = -1
+    grads = {f: rng.normal(size=(W, B, DIM)).astype(np.float32)
+             for f in ("h", "v")}
+    counts = rng.integers(1, 4, size=(W, B)).astype(np.float32)
+    counts[slots < 0] = 0
+    return slots, grads, counts
+
+
+def backend(name, mesh):
+    if name == "local":
+        return LocalTransfer()
+    if name == "xla":
+        return XlaTransfer()
+    if name == "tpu":
+        return TpuTransfer(mesh)
+    return HybridTransfer(mesh)
+
+
+def device_state(name, table):
+    if name in ("tpu", "hybrid"):
+        return table.state
+    return {f: jnp.asarray(np.asarray(v)) for f, v in table.state.items()}
+
+
+# -- merge kernel vs numpy oracle -----------------------------------------
+
+def test_merge_rows_matches_numpy_scatter_add():
+    """Duplicate indices summed, padding (-1) and >= capacity rows
+    dropped — exactly ``np.add.at`` over the valid contributions."""
+    rng = np.random.default_rng(0)
+    cap, n = 16, 200
+    slots = rng.integers(-2, cap + 3, size=n).astype(np.int32)
+    vals = rng.normal(size=(n, DIM)).astype(np.float32)
+    want = np.zeros((cap, DIM), np.float32)
+    valid = (slots >= 0) & (slots < cap)
+    np.add.at(want, slots[valid], vals[valid])
+    got = np.asarray(merge_rows(slots, vals, cap))
+    np.testing.assert_allclose(got, want, rtol=1e-6, atol=1e-6)
+    # the width-0 counts twin agrees with its own oracle
+    cts = rng.integers(0, 4, size=n).astype(np.float32)
+    want_c = np.zeros((cap,), np.float32)
+    np.add.at(want_c, slots[valid], cts[valid])
+    np.testing.assert_allclose(np.asarray(merge_counts(slots, cts, cap)),
+                               want_c, rtol=1e-6, atol=1e-6)
+
+
+def test_bucket_permute_roundtrip_and_layout():
+    """Row r lands in bucket r % n at local index r // n, the
+    unpermute is the exact inverse, and the layout pads to a multiple
+    of the shard count."""
+    assert bucket_layout(64, 8) == (8, 64)
+    assert bucket_layout(65, 8) == (9, 72)      # ceil-div pad
+    assert bucket_layout(0, 8) == (0, 0)
+    n = 4
+    cap_bucket, n_pad = bucket_layout(10, n)
+    rng = np.random.default_rng(1)
+    dense = rng.normal(size=(n_pad, DIM)).astype(np.float32)
+    bucketed = np.asarray(bucket_permute(jnp.asarray(dense), n))
+    for r in range(n_pad):
+        owner, idx = r % n, r // n
+        np.testing.assert_array_equal(
+            bucketed[owner * cap_bucket + idx], dense[r], err_msg=r)
+    back = np.asarray(bucket_unpermute(jnp.asarray(bucketed), n))
+    np.testing.assert_array_equal(back, dense)
+
+
+def test_byte_models_goldens():
+    assert dense_psum_bytes(1024, 36) == 1024 * 36
+    assert sparse_ar_bytes(50, 36) == 50 * (ROW_ID_BYTES + 36)
+
+
+# -- collective: psum pinned is a no-op on every backend ------------------
+
+@pytest.mark.parametrize("name", ["local", "xla", "tpu", "hybrid"])
+def test_psum_pinned_bit_identical_all_backends(name, devices8):
+    """The escape hatch: pinning ``collective: psum`` must leave the
+    applied update bit-identical to the class default on every backend
+    — and book the decision on the psum side of the ledger."""
+    mesh = ps_mesh()
+    rng = np.random.default_rng(11)
+    t_def, ki, access = make_table(mesh if name in ("tpu", "hybrid")
+                                   else None)
+    t_pin, _, _ = make_table(mesh if name in ("tpu", "hybrid") else None)
+    slots, grads, counts = window_batch(ki, rng)
+    off = backend(name, mesh)
+    pin = backend(name, mesh)
+    pin.collective_mode = "psum"
+    pin.count_traffic = True
+    got_def = off.push_window(device_state(name, t_def), slots, grads,
+                              access, mean=True, counts=counts)
+    got_pin = pin.push_window(device_state(name, t_pin), slots, grads,
+                              access, mean=True, counts=counts)
+    for f in access.fields:
+        assert np.array_equal(np.asarray(got_def[f]),
+                              np.asarray(got_pin[f])), (name, f)
+    tr = pin.traffic()
+    assert tr["collective_sparse_ar"] == 0, (name, tr)
+    assert tr["hot_psum_bytes_saved"] == 0, (name, tr)
+
+
+def test_tpu_dense_rung_sparse_ar_flip_bit_identical(devices8):
+    """On the sharded tpu backend the dense rung's psum_scatter already
+    lands each slice on its owner — the sparse_allreduce plan row
+    delegates to the same exchange, so the flip is bit-identical while
+    the ledger re-books the SEMANTIC sparse payload."""
+    mesh = ps_mesh()
+    table_a, ki, access = make_table(mesh, cap=8)   # densifies at cap 64
+    table_b, _, _ = make_table(mesh, cap=8)
+    rng = np.random.default_rng(2)
+    slots, grads, counts = window_batch(ki, rng, key_hi=24)
+    dense_t = TpuTransfer(mesh)
+    dense_t.count_traffic = True
+    sparse_t = TpuTransfer(mesh)
+    sparse_t.count_traffic = True
+    sparse_t.collective_mode = "sparse_allreduce"
+    got_d = dense_t.push_window(table_a.state, slots, grads, access,
+                                mean=True, counts=counts)
+    got_s = sparse_t.push_window(table_b.state, slots, grads, access,
+                                 mean=True, counts=counts)
+    for f in access.fields:
+        assert np.array_equal(np.asarray(got_d[f]),
+                              np.asarray(got_s[f])), f
+    tr_d, tr_s = dense_t.traffic(), sparse_t.traffic()
+    assert tr_d["window_dense"] == 1 and tr_s["window_dense"] == 1
+    assert tr_d["collective_psum"] == 1 and \
+        tr_d["collective_sparse_ar"] == 0, tr_d
+    assert tr_s["collective_sparse_ar"] == 1 and \
+        tr_s["collective_psum"] == 0, tr_s
+    # sparse arm booked touched * (id + row) instead of cap * row; the
+    # window touches most of the tiny table so "saved" may be negative
+    # — but the two arms must book DIFFERENT wire volumes
+    assert tr_s["wire_bytes"] != tr_d["wire_bytes"], (tr_d, tr_s)
+    assert tr_s["hot_psum_bytes_saved"] != 0, tr_s
+
+
+# -- hybrid hot plane: psum vs sparse allreduce ---------------------------
+
+def test_hybrid_hot_plane_parity_and_ledger(devices8):
+    """The Ok-Topk split-and-exchange reaches the same hot plane as the
+    dense psum (float-order noise only) over multiple windows, and the
+    ledger swaps capacity-shaped psum_bytes for the touched-row sparse
+    payload, booking the delta under hot_psum_bytes_saved."""
+    mesh = ps_mesh()
+    arms = {}
+    for mode in ("psum", "sparse_allreduce"):
+        table, keys, access, p = make_hybrid_table(mesh)
+        rng = np.random.default_rng(5)
+        t = HybridTransfer(mesh)
+        t.count_traffic = True
+        t.collective_mode = mode
+        t.hot_touched_fraction = 0.1
+        state = table.state
+        for _ in range(3):
+            # small windows vs the head (the bench cell's shape): the
+            # per-shard touched sets stay well under the replicated head
+            slots, grads, counts = hybrid_window(keys, table.key_index,
+                                                 rng, W=4, B=16, p=p)
+            state = t.push_window(state, slots, grads, access,
+                                  mean=True, counts=counts)
+        arms[mode] = ({f: np.asarray(v) for f, v in state.items()},
+                      t.traffic(), table.n_hot)
+    st_p, tr_p, n_hot = arms["psum"]
+    st_s, tr_s, _ = arms["sparse_allreduce"]
+    for f in st_p:
+        np.testing.assert_allclose(st_s[f], st_p[f], rtol=1e-5,
+                                   atol=1e-6, err_msg=f)
+    # decision mix: every window books its collective on the ledger
+    assert tr_p["collective_psum"] > 0 and \
+        tr_p["collective_sparse_ar"] == 0, tr_p
+    assert tr_s["collective_sparse_ar"] > 0 and \
+        tr_s["collective_psum"] == 0, tr_s
+    # psum books the full replicated head; sparse books touched rows
+    # (hot_rows ledger swaps the same way), so the bytes drop and the
+    # delta lands in hot_psum_bytes_saved
+    assert 0 < tr_s["psum_bytes"] < tr_p["psum_bytes"], (tr_p, tr_s)
+    assert tr_s["hot_psum_bytes_saved"] > 0, tr_s
+    assert tr_p["hot_psum_bytes_saved"] == 0, tr_p
+    # ISSUE 19 shape: >= 2x hot-plane byte reduction at Zipf head density
+    assert tr_p["psum_bytes"] >= 2 * tr_s["psum_bytes"], (tr_p, tr_s)
+
+
+def test_hybrid_auto_crossover_picks_by_density(devices8):
+    """auto mode prices the crossover from the live density signal: a
+    sparse touched-fraction picks the sparse exchange, a dense one
+    keeps the psum — no pin required."""
+    mesh = ps_mesh()
+    for frac, want_sparse in ((0.05, True), (0.95, False)):
+        table, keys, access, p = make_hybrid_table(mesh)
+        rng = np.random.default_rng(7)
+        slots, grads, counts = hybrid_window(keys, table.key_index,
+                                               rng, p=p)
+        t = HybridTransfer(mesh)
+        t.count_traffic = True
+        t.collective_mode = "auto"
+        t.hot_touched_fraction = frac
+        t.push_window(table.state, slots, grads, access, mean=True,
+                      counts=counts)
+        tr = t.traffic()
+        got_sparse = tr["collective_sparse_ar"] > 0
+        assert got_sparse == want_sparse, (frac, tr)
+
+
+def test_hybrid_forwards_collective_knobs_to_tail(devices8):
+    h = HybridTransfer(ps_mesh())
+    assert h.collective_mode == "psum"
+    h.collective_mode = "auto"
+    h.hot_touched_fraction = 0.25
+    h.sparse_ar_ratio = 3.0
+    assert h.tail.collective_mode == "auto"
+    assert h.tail.hot_touched_fraction == 0.25
+    assert h.tail.sparse_ar_ratio == 3.0
+
+
+# -- EF telescope through the merged path ---------------------------------
+
+def test_ef_planes_survive_collective_flip(devices8):
+    """Error feedback lives on the tail wire (quantize post-merge); the
+    hot-plane collective flip must leave the banked residual planes
+    bit-identical between arms — the telescope neither loses nor
+    double-applies mass when the collective changes."""
+    mesh = ps_mesh()
+    arms = {}
+    for mode in ("psum", "sparse_allreduce"):
+        table, keys, access, p = make_hybrid_table(mesh)
+        table.ensure_ef(("h", "v"))
+        rng = np.random.default_rng(13)
+        t = HybridTransfer(mesh)
+        t.wire_quant = "int8"
+        t.window_expected_unique = 16.0     # keep the tail wire sparse_q
+        t.collective_mode = mode
+        t.hot_touched_fraction = 0.1
+        state = table.state
+        for _ in range(3):
+            slots, grads, counts = hybrid_window(keys, table.key_index,
+                                                 rng, p=p)
+            state = t.push_window(state, slots, grads, access,
+                                  mean=True, counts=counts)
+        arms[mode] = {f: np.asarray(v) for f, v in state.items()}
+    st_p, st_s = arms["psum"], arms["sparse_allreduce"]
+    # residuals are live (quantization actually erred somewhere) ...
+    assert any(st_p[ef_name(f)].any() for f in ("h", "v"))
+    # ... and bit-identical across arms: the flip never touches the EF
+    for f in ("h", "v"):
+        assert np.array_equal(st_s[ef_name(f)], st_p[ef_name(f)]), f
+    # the value planes agree to float-order noise
+    for f in ("h", "v"):
+        np.testing.assert_allclose(st_s[f], st_p[f], rtol=1e-5,
+                                   atol=1e-6, err_msg=f)
+
+
+# -- crossover goldens ----------------------------------------------------
+
+def test_price_hot_collectives_goldens():
+    """Exact byte quotes at capacity 1024, 36-byte rows: a 5%-touched
+    head rides the sparse exchange, a 90%-touched head keeps the psum,
+    and with no density signal the dense psum wins unconditionally."""
+    dense = 1024 * 36.0
+    d, p = price_hot_collectives(1024, 36, 0.05)
+    assert d == "sparse_allreduce"
+    assert p == {"psum": dense,
+                 "sparse_allreduce": 0.05 * 1024 * (4 + 36.0)}
+    d, p = price_hot_collectives(1024, 36, 0.9)
+    assert d == "psum"
+    assert p["sparse_allreduce"] == pytest.approx(0.9 * 1024 * 40.0)
+    # SparCML threshold: densify while sparse * ratio >= dense — the
+    # exact crossover fraction (0.45 at ratio 2, 40B rows) stays dense
+    assert price_hot_collectives(1024, 36, 0.45)[0] == "psum"
+    assert price_hot_collectives(1024, 36, 0.449)[0] == "sparse_allreduce"
+    # ratio knob moves the crossover
+    assert price_hot_collectives(1024, 36, 0.45,
+                                 sparse_ar_ratio=1.0)[0] == \
+        "sparse_allreduce"
+    # no evidence -> psum, and only the psum price is quoted
+    assert price_hot_collectives(1024, 36, None) == \
+        ("psum", {"psum": dense})
+
+
+# -- plan cache: hit, and live reprice on the Controller's knob move ------
+
+def test_hot_plan_cache_hit_and_reprice_on_density_move():
+    """Same shape + same knobs is a cache hit; the Controller moving
+    the density signal (transfer.hot_touched_fraction) lands a NEW
+    cache key, so the next window re-prices — and can flip the
+    decision across the crossover — with no invalidation protocol."""
+    t = LocalTransfer()
+    t.collective_mode = "auto"
+    t.hot_touched_fraction = 0.05
+    plan, hit = compile_hot_plan(t, 1024, 36)
+    assert not hit and plan.collective == "sparse_allreduce"
+    assert dict(plan.priced)["psum"] == 1024 * 36.0
+    plan2, hit2 = compile_hot_plan(t, 1024, 36)
+    assert hit2 and plan2 is plan
+    # the density move: same shape, new signal -> recompile + flip
+    t.hot_touched_fraction = 0.9
+    plan3, hit3 = compile_hot_plan(t, 1024, 36)
+    assert not hit3 and plan3.collective == "psum"
+    # moving BACK is a hit again (the old key is still cached)
+    t.hot_touched_fraction = 0.05
+    assert compile_hot_plan(t, 1024, 36)[1] is True
+
+
+def test_hot_plan_pinned_modes_override_pricer():
+    t = LocalTransfer()
+    t.collective_mode = "sparse_allreduce"
+    t.hot_touched_fraction = None       # no evidence, pin wins anyway
+    plan, _ = compile_hot_plan(t, 512, 36)
+    assert plan.collective == "sparse_allreduce"
+    t2 = LocalTransfer()
+    t2.collective_mode = "psum"
+    t2.hot_touched_fraction = 0.01      # sparse would win on evidence
+    plan2, _ = compile_hot_plan(t2, 512, 36)
+    assert plan2.collective == "psum"
+    assert plan2.family == "hot" and plan2.capacity == 512

@@ -572,6 +572,56 @@ def test_budget_gate_decision_mix_floor():
     assert ctb.decision_mix_violations(off) == []
 
 
+def test_traffic_summary_folds_collective_labels():
+    """The kind-labeled collective decision counter folds into the
+    ledger key names (collective_psum / collective_sparse_ar) per
+    backend, next to the window_fmt folding it mirrors."""
+    _scripts_on_path()
+    import telemetry_report
+    doc = _fmt_doc()
+    doc["steps"][0]["counters"][
+        "transfer/collective{backend=hybrid,kind=sparse_ar}"] = 2.0
+    doc["steps"][1]["counters"][
+        "transfer/collective{backend=hybrid,kind=psum}"] = 1.0
+    doc["steps"][1]["counters"][
+        "transfer/hot_psum_bytes_saved{backend=hybrid}"] = 4096.0
+    t = telemetry_report.traffic_summary(doc)
+    hyb = t["transfer"]["hybrid"]
+    assert hyb["collective_sparse_ar"] == 2.0
+    assert hyb["collective_psum"] == 1.0
+    assert "collective" not in hyb          # no overwritten shared key
+    assert hyb["hot_psum_bytes_saved"] == 4096.0
+
+
+def test_budget_gate_collective_mix_floor():
+    """A cell that armed the collective ladder (auto or pinned) and
+    booked decisions yet never picked sparse_allreduce fails the gate;
+    any sparse_ar share passes, and collective=psum (or absent) is
+    exempt — the ladder was never armed."""
+    _scripts_on_path()
+    import check_traffic_budget as ctb
+    dead = {"w2v_1m_sparsear": {"collective": "auto",
+                                "collective_psum": 12.0,
+                                "collective_sparse_ar": 0}}
+    assert ctb.collective_mix_violations(dead) \
+        == [("w2v_1m_sparsear", "auto", 12.0)]
+    live = {"w2v_1m_sparsear": {"collective": "auto",
+                                "collective_psum": 4.0,
+                                "collective_sparse_ar": 8.0}}
+    assert ctb.collective_mix_violations(live) == []
+    off = {"w2v_1m_hybrid": {"collective": "psum",
+                             "collective_psum": 12.0},
+           "w2v_1m_window": {"window_fmt_sparse": 40.0}}
+    assert ctb.collective_mix_violations(off) == []
+    # hot_psum_bytes_per_step is a gated lower-is-better traffic metric
+    assert "hot_psum_bytes_per_step" in ctb.TRAFFIC_METRICS
+    grown = {"c": {"hot_psum_bytes_per_step": 8000.0}}
+    base = {"c": {"hot_psum_bytes_per_step": 2000.0}}
+    reg = ctb.compare(base, grown, 0.1)
+    assert [(r[0], r[1]) for r in reg] == [("c",
+                                            "hot_psum_bytes_per_step")]
+
+
 def test_budget_gate_aggregates_fmt_cells(tmp_path):
     """load_telemetry_cells surfaces the folded window_fmt_* totals as
     cell detail so the decision-mix floor sees live-run JSONL too."""

@@ -328,12 +328,17 @@ def test_stencil_grads_match_numpy_oracle(devices8):
     np.testing.assert_allclose(got_v, want_v, atol=2e-6, rtol=1e-3)
 
 
+@pytest.mark.slow
 def test_stencil_step_matches_gather_step(devices8):
     """One full donated step (pull + grads + span push) on the stencil
     wire format vs the already-oracle-pinned gather rendering on the
     expanded batch, same key: post-step states must agree to fp32
     reassociation tolerance — including a padded tail batch, whose
-    masked rows must contribute nothing on either side."""
+    masked rows must contribute nothing on either side.
+
+    Slow lane (~6.5s: two step compiles x two batch shapes): tier-1
+    keeps test_stencil_train_matches_gather_train, which proves the
+    same stencil==gather equivalence end-to-end through train()."""
     sents = corpus(seed=3)
     m_st = make_model()
     m_ga = make_model(stencil=0)

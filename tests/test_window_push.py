@@ -361,10 +361,15 @@ def w2v_model(**overrides):
     return Word2Vec(config=cfg)
 
 
+@pytest.mark.slow
 def test_w2v_push_window_training_parity(devices8):
     """push_window=2 over the fused scan trains to the same loss
     trajectory as the per-step path (within the bounded-staleness band —
-    the same 25% envelope the async/staleness suites use)."""
+    the same 25% envelope the async/staleness suites use).
+
+    Slow lane (~7s: two full e2e trains): tier-1 keeps the sharper
+    transfer-level window oracles above (coalesced window == sum of
+    per-step pushes, bit-exact) and the dense-logits guard below."""
     from swiftmpi_tpu.data.text import synthetic_corpus
 
     corpus = synthetic_corpus(90, vocab_size=60, length=12, seed=8)
