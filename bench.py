@@ -579,7 +579,8 @@ W2V_1M_VOCAB = 1_000_000
 def build_w2v_1m_model(device, stencil=False, hybrid=False,
                        window_steps=1, pipeline=0, control=None,
                        wire_quant=None, wire_sketch=False,
-                       collective=None, zipf_s=None, minibatch=None):
+                       collective=None, zipf_s=None, minibatch=None,
+                       pull_cache=None, pull_quant=None):
     """The 1M-vocab cell's model (BASELINE config #3 shape: demo.conf
     hyperparameters over a ~1M-word Zipf vocabulary / 1.3M-row table).
     ONE builder shared by the bench cell and the profiler ablation
@@ -641,7 +642,15 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
 
     ``minibatch``: override [worker] minibatch (drives BOTH the hot-
     head calibration's batch_rows hint and the seeded touched-fraction
-    draws; the pre-staged bench batches ignore it)."""
+    draws; the pre-staged bench batches ignore it).
+
+    ``pull_cache`` / ``pull_quant``: arm the delta-pull plane (ISSUE
+    20) — a worker-side versioned row cache of ``pull_cache`` lines
+    (lossless: a version-exact hit is bit-identical, only the ledger
+    changes) and/or the quantized pull wire ([cluster] pull_quant:
+    int8|bf16, a lossy FORWARD-READ perturbation priced against the
+    full-f32 rung).  The BENCH_ONLY=scale_dpull cell's knobs; ``None``
+    keeps the legacy full-width pull."""
     import jax
     import numpy as np
     from swiftmpi_tpu.cluster.cluster import Cluster
@@ -669,7 +678,11 @@ def build_w2v_1m_model(device, stencil=False, hybrid=False,
                        if wire_quant else {}),
                     **({"wire_sketch": 1} if wire_sketch else {}),
                     **({"collective": str(collective)}
-                       if collective else {})},
+                       if collective else {}),
+                    **({"pull_cache": int(pull_cache)}
+                       if pull_cache else {}),
+                    **({"pull_quant": str(pull_quant)}
+                       if pull_quant else {})},
         "word2vec": {"len_vec": 100, "window": 4, "negative": 20,
                      "sample": -1, "learning_rate": 0.05,
                      # BENCH_SCALE_SHARED=1: the batch-shared negative
@@ -1473,6 +1486,127 @@ def _bench_w2v_1m_sparsear(device, timed_calls):
         out["hot_psum_reduction_x"] = round(
             out["psum_hot_psum_bytes_per_step"]
             / out["sparse_ar_hot_psum_bytes_per_step"], 2)
+    best = min(arms.values())
+    out.update({"words_per_sec": Bc * 1e3 / best,
+                "step_ms": round(best, 3), "span": S, "capacity": cap,
+                "transfer": "hybrid",
+                "rendering": getattr(model, "resolved_rendering", None)})
+    return out
+
+
+def _bench_w2v_1m_dpull(device, timed_calls):
+    """In-cell off-vs-armed A/B of the delta-pull plane (ISSUE 20) at
+    the Zipf(1.0) validation shape.  Both arms build through the SAME
+    builder (``build_w2v_1m_model(hybrid=True, window_steps=2,
+    zipf_s=1.0)``) so the hot head, table capacity and compiled batch
+    shapes are identical; only the pull knobs differ (absent = the
+    legacy full-f32 pull ledger vs ``[cluster] pull_cache`` +
+    ``pull_quant``).  The window matters: inside one W=2 window every
+    step pulls against the FROZEN window-start state, so a row repeated
+    across the window's steps hits the versioned cache (pushes land at
+    window end and bump versions — cross-window repeats of pushed rows
+    correctly miss), and the Zipf(1.0) frequency-drawn token stream
+    supplies the repeats.  Hybrid hot-replica reads stay 0 bytes and
+    never enter the cache; the quantized pull rung compresses the tail
+    misses (int8: ~4x under d=100 f32 rows, a lossy forward-read
+    perturbation that never touches server state).  Parity is measured
+    from identical-seed inits and identical batches: the fused-call
+    loss must agree within |a-b| <= 1e-5 + 1e-3*|a|.  The gate reads
+    pull_bytes_per_step (lower-is-better) plus the pull decision mix —
+    an armed arm with zero encoded picks or zero cache hits fails
+    check_traffic_budget outright (pull_mix_violations)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    PARITY_ENVELOPE = 1e-3
+    V = W2V_1M_VOCAB
+    win = int(os.environ.get("BENCH_DPULL_WINDOW", 2))
+    Bc = int(os.environ.get("BENCH_DPULL_BATCH", 1024))
+    lines = int(os.environ.get("BENCH_PULL_CACHE", 1 << 18))
+    pq = os.environ.get("BENCH_PULL_QUANT", "int8")
+    out = {"vocab": V, "zipf_s": 1.0, "batch": Bc, "push_window": win,
+           "pull_cache": lines, "pull_quant": pq,
+           "dtype": os.environ.get("BENCH_DTYPE", "float32")}
+    batch_args = None
+    losses, arms = {}, {}
+    cap = S = None
+    for arm, armed in (("off", False), ("dpull", True)):
+        model, _ = build_w2v_1m_model(
+            device, hybrid=True, window_steps=win, zipf_s=1.0,
+            minibatch=10000,
+            pull_cache=lines if armed else None,
+            pull_quant=pq if armed else None)
+        model.transfer.count_traffic = True
+        tr0 = model.transfer.traffic()
+        with jax.default_device(device):
+            step = model._build_multi_step(INNER_STEPS)
+            W = model.window
+            S, cap = Bc + 2 * W, model.table.capacity
+            if batch_args is None:
+                # Zipf(1.0)-weighted token stream, reused verbatim by
+                # the second arm: cache hits need the validation
+                # traffic to follow the vocab law, not the uniform
+                # synthesis of the throughput cells
+                ranks = np.arange(1, V + 1, dtype=np.float64)
+                pz = ranks ** -1.0
+                pz /= pz.sum()
+                zr = np.random.default_rng(123)
+                tokens = jnp.asarray(
+                    zr.choice(V, size=(INNER_STEPS, S), p=pz), jnp.int32)
+                sent_id = jnp.broadcast_to(
+                    jnp.arange(S, dtype=jnp.int32) // SENT_LEN,
+                    (INNER_STEPS, S))
+                center_pos = jnp.broadcast_to(
+                    W + jnp.arange(Bc, dtype=jnp.int32),
+                    (INNER_STEPS, Bc))
+                half = jnp.asarray(
+                    zr.integers(1, W + 1, size=(INNER_STEPS, Bc)),
+                    jnp.int32)
+                batch_args = (tokens, sent_id, center_pos, half)
+            args = tuple(jax.device_put(x, device) for x in
+                         (model._slot_of_vocab, model._alias_prob,
+                          model._alias_idx) + batch_args)
+
+            def fresh_state():
+                return {f: jax.device_put(jnp.array(v), device)
+                        for f, v in model.table.state.items()}
+
+            _, es, _ = step(fresh_state(), *args, jax.random.key(7))
+            losses[arm] = float(es)
+            # the parity call ran on a throwaway state; the timed run
+            # threads ONE monotonic state, so start its cache cold
+            model.transfer.pull_shadow_flush()
+            _, dt, _ = _timed_steps(step, fresh_state(), args,
+                                    timed_calls, jax.random.key(0))
+        arms[arm] = dt / (timed_calls * INNER_STEPS) * 1e3
+        tr = model.transfer.traffic_delta(tr0)
+        # parity call + warmup + timed calls all book on the ledger
+        steps = (1 + WARMUP_CALLS + timed_calls) * INNER_STEPS
+        out[f"{arm}_step_ms"] = round(arms[arm], 3)
+        out[f"{arm}_pull_bytes_per_step"] = round(
+            tr.get("pull_bytes", 0) / steps, 1)
+        out[f"{arm}_pull_rows_per_step"] = round(
+            tr.get("pull_rows", 0) / steps, 1)
+        if arm == "dpull":
+            for k in ("pull_cache_hits", "pull_delta_rows",
+                      "pull_bytes_saved", "pull_hot_rows",
+                      "pull_fmt_full", "pull_fmt_bf16", "pull_fmt_q"):
+                out[k] = tr.get(k, 0)
+            out["hot_head_rows"] = model.table.n_hot
+    # the gated candidate number is the ARMED arm's pull wire; the off
+    # arm rides along as the in-cell baseline and the headline
+    # reduction is the acceptance ratio (>= 2x at this shape)
+    out["pull_bytes_per_step"] = out["dpull_pull_bytes_per_step"]
+    if out["dpull_pull_bytes_per_step"]:
+        out["pull_reduction_x"] = round(
+            out["off_pull_bytes_per_step"]
+            / out["dpull_pull_bytes_per_step"], 2)
+    a, b = losses["off"], losses["dpull"]
+    out["loss_off"] = round(a, 6)
+    out["loss_dpull"] = round(b, 6)
+    out["parity_ok"] = bool(
+        abs(a - b) <= 1e-5 + PARITY_ENVELOPE * abs(a))
     best = min(arms.values())
     out.update({"words_per_sec": Bc * 1e3 / best,
                 "step_ms": round(best, 3), "span": S, "capacity": cap,
@@ -2397,6 +2531,22 @@ def child_main(which: str) -> None:
         # mix, the >= 2x reduction headline and the hot-plane/tail
         # parity verdicts
         out["w2v_1m_sparsear"] = _bench_w2v_1m_sparsear(
+            device, max(timed // 2, 1))
+        print("BENCH_CHILD " + json.dumps(out), flush=True)
+        _cache_own_child_result(out, device)
+        return
+    if os.environ.get("BENCH_ONLY") == "scale_dpull":
+        # delta-pull plane A/B at the Zipf(1.0) validation shape: the
+        # legacy full-f32 pull ledger vs [cluster] pull_cache +
+        # pull_quant (BENCH_PULL_CACHE / BENCH_PULL_QUANT, defaults
+        # 2^18 lines / int8), both arms warmed through the SAME
+        # builder over the W=2 windowed hybrid shape — intra-window
+        # pulls see the frozen window-start versions, so Zipf repeats
+        # hit the cache while pushed rows correctly miss across
+        # windows.  Records the gated pull_bytes_per_step, the pull
+        # decision mix, the >= 2x reduction headline and the fused-
+        # call loss-parity verdict
+        out["w2v_1m_dpull"] = _bench_w2v_1m_dpull(
             device, max(timed // 2, 1))
         print("BENCH_CHILD " + json.dumps(out), flush=True)
         _cache_own_child_result(out, device)

@@ -75,6 +75,10 @@ DETAIL_METRICS = ("window_sparse", "window_dense", "window_fmt_dense",
                   "pipeline", "speedup_vs_off", "qps", "p50_ms",
                   "hit_ratio", "streams", "snapshots",
                   "staleness_bound_steps", "pull_hot_rows",
+                  "pull_cache_hits", "pull_delta_rows",
+                  "pull_bytes_saved", "pull_fmt_full", "pull_fmt_bf16",
+                  "pull_fmt_q", "pull_quant", "pull_cache",
+                  "pull_reduction_x",
                   "control_applied", "control_evaluations",
                   "steps_to_reconverge", "recompiles", "hot_k",
                   "straggler_rank", "members_dead", "unnoticed_deaths",
@@ -149,7 +153,13 @@ def load_telemetry_cells(path: str) -> dict:
     for decision in ("window_sparse", "window_dense", "window_fmt_dense",
                      "window_fmt_sparse", "window_fmt_q",
                      "window_fmt_bitmap", "window_fmt_sketch",
-                     "plan_compiles", "plan_cache_hits"):
+                     "plan_compiles", "plan_cache_hits",
+                     # delta-pull plane (ISSUE 20): decision mix + cache
+                     # effectiveness ride as detail next to the
+                     # pull_bytes_per_step gate metric
+                     "pull_fmt_full", "pull_fmt_bf16", "pull_fmt_q",
+                     "pull_cache_hits", "pull_delta_rows",
+                     "pull_bytes_saved"):
         total = sum(m.get(decision, 0.0) for m in t["transfer"].values())
         if total:
             cell[decision] = total
@@ -382,6 +392,40 @@ def decision_mix_violations(cells: dict) -> list:
     return bad
 
 
+def pull_mix_violations(cells: dict) -> list:
+    """The armed-but-dead guard for the delta-pull plane (ISSUE 20),
+    same pattern as the wire-compression and collective mixes: a cell
+    that claims a pull knob is on yet shows zero evidence the feature
+    ever fired is a gate failure, not a tuning preference.  Two forms:
+
+    * ``pull_quant`` armed (not ``off``) with pull decisions booked but
+      zero encoded picks — the pricing guard never let the quantized
+      rung win, so the knob silently no-ops;
+    * ``pull_cache`` armed (truthy line count) with pull decisions
+      booked but zero cache hits — on any workload with repeated keys
+      (every cell we gate runs a Zipf stream) a dead cache means the
+      version plane or the watermark protocol is broken.
+    """
+    bad = []
+    fmt_keys = ("pull_fmt_full", "pull_fmt_bf16", "pull_fmt_q")
+    for cell, m in sorted(cells.items()):
+        total = sum(float(m.get(k, 0.0)) for k in fmt_keys)
+        quant = m.get("pull_quant")
+        if quant not in (None, "off") and total > 0:
+            encoded = float(m.get("pull_fmt_bf16", 0.0)) \
+                + float(m.get("pull_fmt_q", 0.0))
+            if encoded <= 0:
+                bad.append((cell, f"pull_quant={quant}",
+                            f"{total:g} pull decisions but zero "
+                            "bf16/sparse_q picks"))
+        if m.get("pull_cache") and total > 0 \
+                and float(m.get("pull_cache_hits", 0.0)) <= 0:
+            bad.append((cell, f"pull_cache={m['pull_cache']}",
+                        f"{total:g} pull decisions but zero cache "
+                        "hits"))
+    return bad
+
+
 def collective_mix_violations(cells: dict) -> list:
     """Cells that armed the hot-plane collective ladder (``collective``
     not ``psum``) and booked collective decisions, yet never once chose
@@ -598,6 +642,14 @@ def main(argv=None) -> int:
         for cell, quant, total in mix:
             print(f"  {cell}: wire_quant={quant} with {total:g} window "
                   "decisions but zero sparse_q/bitmap picks")
+        return 1
+
+    pmix = pull_mix_violations(
+        {c: m for c, m in cand.items() if not only or c in only})
+    if pmix:
+        print("PULL DECISION MIX FAILURE:")
+        for cell, knob, why in pmix:
+            print(f"  {cell}: {knob} armed but dead — {why}")
         return 1
 
     coll = collective_mix_violations(

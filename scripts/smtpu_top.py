@@ -4,7 +4,8 @@
 Points a :class:`~swiftmpi_tpu.obs.collector.FleetCollector` at a fleet
 directory (the ``launch.py -fleet-dir`` target) and renders one row per
 rank: health, step progress and rate, phase p50/p95, wire traffic and
-decision mix, restart count, the last traced wire window (WIN column,
+decision mix, the delta-pull cache (PULL column, hit%/bytes-saved),
+restart count, the last traced wire window (WIN column,
 ``id/age`` from obs/trace.py records in the fleet dir), and a
 STRAGGLER flag from the collector's cross-rank attribution.  Refreshes in place until interrupted; the
 ``--once`` mode renders a single frame and exits — that is what tests
@@ -95,6 +96,25 @@ def _member_fmt_mix(member: dict) -> dict:
     return mix or legacy
 
 
+def _member_pull(member: dict) -> dict:
+    """Delta-pull plane (ISSUE 20): cumulative pull-cache counters
+    across one member's records — cacheable rows (pull_rows minus the
+    hybrid hot reads, which are 0 bytes and never cached), hits, and
+    value bytes elided by the watermark protocol."""
+    names = {"transfer/pull_rows": "rows",
+             "transfer/pull_hot_rows": "hot",
+             "transfer/pull_cache_hits": "hits",
+             "transfer/pull_bytes_saved": "saved"}
+    tot = {"rows": 0, "hot": 0, "hits": 0, "saved": 0}
+    for s in member["_streams"]:
+        for rec in s.records:
+            for key, delta in (rec.get("counters") or {}).items():
+                k = names.get(parse_series_key(key)[0])
+                if k:
+                    tot[k] += int(delta)
+    return tot
+
+
 def _member_retraces(member: dict) -> int:
     """Total ``compile/retraces`` across one member's records — the
     retrace-storm column (obs/costs.py); 0 when costs are off OR the
@@ -139,6 +159,7 @@ def frame(fc: FleetCollector) -> dict:
             "phases": _member_phases(m),
             "wire_bytes": summary["wire_bytes"].get(key, 0.0),
             "fmt_mix": _member_fmt_mix(m),
+            "pull": _member_pull(m),
             "retraces": _member_retraces(m),
             # wire tracer (obs/trace.py): last traced window id and its
             # age at the member's final heartbeat — a rank whose WIN age
@@ -177,8 +198,9 @@ def render(fr: dict) -> str:
         f"({s['fleet_step_ms_skew_pct']:.1f}%)  "
         f"wire_imbalance={s['fleet_wire_bytes_imbalance']:.3f}",
         f"{'RANK':<6}{'PID':>8}{'HEALTH':>9}{'STEP':>7}{'ST/S':>8}"
-        f"{'P50MS':>8}{'P95MS':>8}{'WIRE':>12}{'GNORM':>9}{'HB':>5}"
-        f"{'RST':>4}{'RTRC':>5}{'EP':>4}{'WIN':>10}  FMT-MIX / FLAGS",
+        f"{'P50MS':>8}{'P95MS':>8}{'WIRE':>12}{'PULL':>12}{'GNORM':>9}"
+        f"{'HB':>5}{'RST':>4}{'RTRC':>5}{'EP':>4}{'WIN':>10}"
+        "  FMT-MIX / FLAGS",
     ]
     for r in fr["members"]:
         mix = ",".join(f"{k}:{v}" for k, v in sorted(r["fmt_mix"].items()))
@@ -197,11 +219,21 @@ def render(fr: dict) -> str:
             win = f"{r['last_window']}/{r['last_window_age_s']:.0f}s"
         else:
             win = "-"
+        # PULL column: cache hit ratio over cacheable (non-hot) rows
+        # plus bytes elided — "-" when the delta-pull plane is unarmed
+        pull = r.get("pull") or {}
+        cacheable = max(pull.get("rows", 0) - pull.get("hot", 0), 0)
+        if pull.get("hits") or pull.get("saved"):
+            pl = (f"{100.0 * pull['hits'] / max(cacheable, 1):.0f}%/"
+                  f"{pull['saved']:,.0f}")
+        else:
+            pl = "-"
         lines.append(
             f"{r['rank']:<6}{r['pid'] or 0:>8}{r['health']:>9}"
             f"{r['step'] if r['step'] is not None else '-':>7}"
             f"{r['steps_per_s']:>8.2f}{r['step_ms_p50']:>8.1f}"
             f"{r['step_ms_p95']:>8.1f}{r['wire_bytes']:>12,.0f}"
+            f"{pl:>12}"
             f"{gnorm}"
             f"{r['heartbeats']:>5}{r['restarts']:>4}"
             f"{r.get('retraces', 0):>5}"

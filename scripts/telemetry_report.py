@@ -489,6 +489,13 @@ def traffic_summary(doc: dict) -> dict:
                 k = "collective_" + labels.get("kind", "?")
                 bd = transfer.setdefault(backend, {})
                 bd[k] = bd.get(k, 0.0) + total
+            elif name == "transfer/pull_fmt":
+                # pull-family decision mix: fmt= label ->
+                # pull_fmt_full / pull_fmt_bf16 / pull_fmt_q (the
+                # ledger key names the budget gate's pull guard reads)
+                k = "pull_fmt_" + labels.get("fmt", "?")
+                bd = transfer.setdefault(backend, {})
+                bd[k] = bd.get(k, 0.0) + total
             else:
                 transfer.setdefault(backend, {})[
                     name[len("transfer/"):]] = total
@@ -506,6 +513,86 @@ def traffic_summary(doc: dict) -> dict:
         if stall is not None:
             out["stall_ms_per_step"] = stall / steps
     return out
+
+
+def pull_summary(doc: dict) -> dict:
+    """Delta-pull plane section (ISSUE 20): per-backend hit ratio and
+    pull decision mix from the cumulative ledger, plus a bytes-saved
+    timeline bucketed over the run (per-step
+    ``transfer/pull_bytes_saved`` / ``pull_cache_hits`` deltas summed
+    across backends).  Hit ratio denominates on the cacheable rows —
+    ``pull_rows - pull_hot_rows`` — because hybrid hot-replica reads
+    are already 0 bytes and never enter the cache."""
+    traffic = traffic_summary(doc)
+    backends = {}
+    for b, m in (traffic.get("transfer") or {}).items():
+        if not any(k.startswith("pull") for k in m):
+            continue
+        rows = m.get("pull_rows", 0.0)
+        hot = m.get("pull_hot_rows", 0.0)
+        hits = m.get("pull_cache_hits", 0.0)
+        cacheable = max(rows - hot, 0.0)
+        backends[b] = {
+            "pull_rows": rows, "pull_hot_rows": hot,
+            "pull_cache_hits": hits,
+            "pull_delta_rows": m.get("pull_delta_rows", 0.0),
+            "pull_bytes": m.get("pull_bytes", 0.0),
+            "pull_bytes_saved": m.get("pull_bytes_saved", 0.0),
+            "hit_ratio": hits / cacheable if cacheable else 0.0,
+            "fmt": {k[len("pull_fmt_"):]: v for k, v in m.items()
+                    if k.startswith("pull_fmt_")},
+        }
+    deltas = []
+    for rec in doc["steps"]:
+        saved = hits = 0.0
+        moved = False
+        for key, delta in (rec.get("counters") or {}).items():
+            name, _ = parse_series_key(key)
+            if name == "transfer/pull_bytes_saved":
+                saved += delta
+                moved = True
+            elif name == "transfer/pull_cache_hits":
+                hits += delta
+                moved = True
+        if moved and "step" in rec:
+            deltas.append((int(rec["step"]), saved, hits))
+    timeline = []
+    if deltas:
+        per = max(1, (len(deltas) + 11) // 12)    # <= 12 buckets
+        for i in range(0, len(deltas), per):
+            chunk = deltas[i:i + per]
+            timeline.append({
+                "first": chunk[0][0], "last": chunk[-1][0],
+                "bytes_saved": sum(c[1] for c in chunk),
+                "hits": sum(c[2] for c in chunk)})
+    return {"backends": backends, "timeline": timeline,
+            "steps": traffic.get("steps", 0)}
+
+
+def _print_pull(pull: dict) -> None:
+    print()
+    print(f"delta-pull plane over {pull['steps']} step(s):")
+    if not pull["backends"]:
+        print("  (no pull counters — traffic counting off or no pulls)")
+        return
+    for b, m in sorted(pull["backends"].items()):
+        fmt = ", ".join(f"{k}={v:g}" for k, v in sorted(m["fmt"].items())
+                        if v)
+        print(f"  backend={b}: hit_ratio={m['hit_ratio']:.3f} "
+              f"({m['pull_cache_hits']:,.0f} hits / "
+              f"{m['pull_rows']:,.0f} rows, "
+              f"{m['pull_hot_rows']:,.0f} hot@0B)")
+        print(f"    pull_bytes={m['pull_bytes']:,.0f} "
+              f"saved={m['pull_bytes_saved']:,.0f} "
+              f"delta_rows={m['pull_delta_rows']:,.0f}"
+              + (f"  decisions: {fmt}" if fmt else ""))
+    if pull["timeline"]:
+        print("  bytes-saved timeline:")
+        for t in pull["timeline"]:
+            span = (f"step {t['first']}" if t["first"] == t["last"]
+                    else f"steps {t['first']}-{t['last']}")
+            print(f"    {span}: {t['bytes_saved']:,.0f} B saved, "
+                  f"{t['hits']:,.0f} hit(s)")
 
 
 def report(doc: dict, phases_only: bool = False,
@@ -1149,6 +1236,10 @@ def main(argv=None) -> int:
                     help="only the numerics-health section: numerics/* "
                     "series stats, nonfinite totals and the anomaly "
                     "timeline (smtpu-numerics/1 events)")
+    ap.add_argument("--pull", dest="pull_only", action="store_true",
+                    help="only the delta-pull plane section: per-"
+                    "backend cache hit ratio, pull decision mix and "
+                    "the bytes-saved timeline (transfer/pull_* series)")
     ap.add_argument("--compile", dest="compile_only",
                     action="store_true",
                     help="only the compile-catalog section: per-fn "
@@ -1221,6 +1312,19 @@ def main(argv=None) -> int:
             print(f"run={m.get('run')} ident={m.get('ident')} "
                   f"schema={m.get('schema')}")
             _print_numerics(num)
+        return 0
+    if args.pull_only:
+        doc = load(args.path)
+        pull = pull_summary(doc)
+        if args.json:
+            json.dump({"meta": doc["meta"], "pull": pull},
+                      sys.stdout, indent=2)
+            print()
+        else:
+            m = doc["meta"]
+            print(f"run={m.get('run')} ident={m.get('ident')} "
+                  f"schema={m.get('schema')}")
+            _print_pull(pull)
         return 0
     if args.compile_only:
         doc = load(args.path)

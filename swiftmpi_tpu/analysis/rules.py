@@ -915,10 +915,17 @@ class KnobDoc(Rule):
 # ---------------------------------------------------------------------------
 # PLAN-DISPATCH
 
-#: the wire-format ladder (mirrors transfer.plan.WIRE_FORMATS; literal
-#: so the linter never imports jax)
+#: the wire-format ladder (mirrors transfer.plan.WIRE_FORMATS plus the
+#: pull family's transfer.plan.PULL_FORMATS; literal so the linter
+#: never imports jax).  The pull rung "bf16" is deliberately ABSENT:
+#: bare "bf16" is also a dtype string and a quant-knob value, and
+#: comparing a knob against it (word2vec config parsing, quant codecs)
+#: is not format dispatch — "full_f32"/"sparse_q" are the distinctive
+#: members that mark a pull-format branch, same reasoning as the bare
+#: "psum" exclusion below.
 _WIRE_FORMAT_NAMES = frozenset(
-    ("dense", "sparse", "bitmap", "sparse_q", "sparse_sketch"))
+    ("dense", "sparse", "bitmap", "sparse_q", "sparse_sketch",
+     "full_f32"))
 
 #: the collective ladder (mirrors transfer.plan.COLLECTIVES minus the
 #: bare "psum", which is also a jax.lax primitive name and would false-
@@ -927,18 +934,23 @@ _WIRE_FORMAT_NAMES = frozenset(
 _COLLECTIVE_NAMES = frozenset(("sparse_allreduce", "psum_scatter"))
 
 #: attribute/function names whose CALL is the wire-format question
+#: (push-window, hot-collective and pull families alike)
 _PLAN_QUESTIONS = frozenset(
     ("decide_wire_format", "price_window_formats", "window_wire_format",
-     "compile_window_plan", "price_hot_collectives", "compile_hot_plan"))
+     "compile_window_plan", "price_hot_collectives", "compile_hot_plan",
+     "compile_pull_plan", "price_pull_formats", "pull_route"))
 
 #: transfer-layer modules allowed to interpret plans: the interpreter
 #: itself, the plan compiler, and the codec modules its tables point at
 #: (a codec IMPLEMENTS formats — encode/decode/byte-model — which is
 #: the opposite of a backend dispatching on them; delta.py is the
 #: PR-17 row-delta codec, sketch.py the sparse_sketch codec)
+#: (pull_cache.py is the delta-pull shadow — a cache keyed on row
+#: versions, not a backend; it implements the hit/miss byte model the
+#: pull plan prices, so it sits with the codecs)
 _PLAN_INTERPRETER_FILES = frozenset(
     ("api.py", "plan.py", "sketch.py", "delta.py",
-     "sparse_allreduce.py"))
+     "sparse_allreduce.py", "pull_cache.py"))
 
 
 class PlanDispatch(Rule):

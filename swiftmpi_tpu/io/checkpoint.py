@@ -28,8 +28,9 @@ import numpy as np
 
 from swiftmpi_tpu import obs
 from swiftmpi_tpu.cluster.bootstrap import host_array, is_writer
-from swiftmpi_tpu.parameter.sparse_table import (SparseTable, base_field,
-                                                 hot_name, is_ef_field)
+from swiftmpi_tpu.parameter.sparse_table import (ROWVER_KEY, SparseTable,
+                                                 base_field, hot_name,
+                                                 is_ef_field)
 
 Formatter = Callable[[Dict[str, np.ndarray]], str]
 Parser = Callable[[str], Dict[str, np.ndarray]]
@@ -517,9 +518,13 @@ def _load_checkpoint(table: SparseTable, path: str,
                 continue
             name = zname[len("field__"):]
             arr = z[zname]
-            if is_ef_field(name):
-                # EF residual planes are f32-native and not access
-                # fields — no FieldSpec, no dtype cast
+            if is_ef_field(name) or name == ROWVER_KEY:
+                # EF residual planes (f32) and the @rowver version
+                # plane (int32) are not access fields — no FieldSpec,
+                # no dtype cast.  Restoring @rowver as saved keeps
+                # versions counting up across restarts, so a resumed
+                # worker's cold cache can never collide with a re-used
+                # stamp (pull_cache.py invalidation contract).
                 state[name] = _replace(table, name, arr)
                 continue
             # @hot arrays restore next to their base field with the same
@@ -531,7 +536,15 @@ def _load_checkpoint(table: SparseTable, path: str,
                 # bfloat16); restore the table's storage dtype exactly
                 arr = arr.astype(fs.dtype)
             state[name] = _replace(table, name, arr)
+        had_rowver = ROWVER_KEY in table.state
         table.state = state
         table.key_index.restore(z["keys"], z["slots"])
+        if had_rowver and ROWVER_KEY not in state:
+            # pre-delta-pull checkpoint into a pull_cache-armed table:
+            # re-arm a zero plane (version 0 = "never applied") rather
+            # than silently dropping the cache for the rest of the run.
+            # Safe — the resume path flushes every worker shadow, so
+            # the reset stamps cannot false-hit.
+            table.ensure_row_versions()
         return {k[len("extra__"):]: z[k] for k in z.files
                 if k.startswith("extra__")}

@@ -272,6 +272,26 @@ class Word2Vec:
         if self.wire_quant not in ("off", "int8", "bf16"):
             raise ValueError("[cluster] wire_quant must be off, int8 or "
                              f"bf16, got {self.wire_quant!r}")
+        # [cluster] pull_quant: off|int8|bf16 — wire quantization for
+        # the PULL family (transfer/plan.py price_pull_formats).  The
+        # dequantized read perturbs the forward pass only — server
+        # state is never written through a quantizer, so no EF plane is
+        # involved and the PR-10 trajectory envelope applies.  "off"
+        # (default) keeps pulls bit-identical to the f32 wire.
+        self.pull_quant = g("cluster", "pull_quant", "off").to_string()
+        if self.pull_quant not in ("off", "int8", "bf16"):
+            raise ValueError("[cluster] pull_quant must be off, int8 or "
+                             f"bf16, got {self.pull_quant!r}")
+        # [cluster] pull_cache: N > 0 arms the worker-side versioned
+        # pull cache with N direct-mapped lines (transfer/pull_cache.py)
+        # and the table's @rowver stamp plane.  Version-exact hits ship
+        # zero value bytes (watermark + hit bitmap only); the ledger's
+        # pull_bytes drops accordingly.  0 (default) keeps the table
+        # state pytree and the pull ledger bit-identical.
+        self.pull_cache = g("cluster", "pull_cache", 0).to_int32()
+        if self.pull_cache < 0:
+            raise ValueError("[cluster] pull_cache must be >= 0, got "
+                             f"{self.pull_cache!r}")
         # [cluster] wire_sketch: 0|1 — admit the counting-sketch index
         # rung (sparse_sketch: bucketed uint16 counts + uint8 in-bucket
         # offsets instead of i32 indices) to the window wire-format
@@ -466,6 +486,19 @@ class Word2Vec:
                     "[cluster] collective: %s has no effect at "
                     "push_window: 1 (the per-step hot psum is not "
                     "plan-compiled); ignoring", self.collective_mode)
+        if self.pull_quant != "off":
+            # unlike the push-side knobs, pulls happen every step at
+            # any window size — no push_window gate
+            self.transfer.pull_quant = self.pull_quant
+            log.info("[cluster] pull_quant: %s armed", self.pull_quant)
+        if self.pull_cache:
+            self.transfer.pull_cache = int(self.pull_cache)
+            # the @rowver plane the watermark protocol reads — created
+            # BEFORE any step compiles so the state pytree shape is
+            # stable for the fused scan and checkpoints
+            self.table.ensure_row_versions()
+            log.info("[cluster] pull_cache: %d lines armed",
+                     self.pull_cache)
         prob, alias = build_unigram_alias(self.vocab.counts)
         self._alias_prob = jnp.asarray(prob)
         self._alias_idx = jnp.asarray(alias)
@@ -2077,6 +2110,11 @@ class Word2Vec:
         # cached jitted step baked in the old capacity (the push
         # scatter bounds), so force a rebuild
         self._step = None
+        # a restore can rewind the @rowver plane; a warm pull cache
+        # could then false-hit on a re-used version stamp.  A resumed
+        # worker always restarts cold (pull_cache.py invalidation
+        # contract; the chaos test pins this).
+        self.transfer.pull_shadow_flush()
         if self.vocab is not None:
             slots = self.table.key_index.lookup(self.vocab.keys)
             self._slot_of_vocab = jnp.asarray(slots, jnp.int32)
@@ -2501,19 +2539,26 @@ class Word2Vec:
         runaway drops ``wire_quant`` to lossless at the control plane's
         safe point — the quantizer is banking error faster than the
         residual drains, and kept on int8 the model walks away from the
-        lossless trajectory.  Returns the previous setting (for the
-        decision event) or None when already lossless."""
-        old = self.wire_quant
-        if old == "off":
+        lossless trajectory.  ``pull_quant`` is demoted on the same
+        trigger (the read-side quantizer feeds the same forward pass;
+        OPERATIONS.md documents this as the pull plane's escape hatch —
+        the lossless pull cache stays armed).  Returns the previous
+        setting (for the decision event) or None when already
+        lossless."""
+        old_w, old_p = self.wire_quant, self.pull_quant
+        if old_w == "off" and old_p == "off":
             return None
         log.warning(
             "numerics: sustained EF residual runaway on %s — demoting "
-            "wire_quant %s -> off", anomaly.get("series"), old)
+            "wire_quant %s -> off, pull_quant %s -> off",
+            anomaly.get("series"), old_w, old_p)
         self.wire_quant = "off"
+        self.pull_quant = "off"
         if hasattr(self.transfer, "wire_quant"):
             self.transfer.wire_quant = "off"
+        self.transfer.pull_quant = "off"
         self._rebuild_step()
-        return old
+        return old_w if old_w != "off" else f"pull:{old_p}"
 
     def embedding_index(self, field: str = "v"):
         """Cosine-similarity index over the LIVE table (no dump round

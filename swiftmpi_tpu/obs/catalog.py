@@ -40,6 +40,9 @@ TRANSFER_KEYS = frozenset({
     "plan_compiles", "plan_cache_hits",         # TrafficPlan compiler
     "coalesced_rows_in", "coalesced_rows_out",
     "pull_bytes", "pull_rows", "pull_hot_rows",
+    "pull_cache_hits", "pull_delta_rows",        # delta-pull cache plane
+    "pull_bytes_saved",
+    "pull_fmt",                                  # pull decisions, fmt=
     "routed_rows", "overflow_dropped",          # tpu routing ledger
     "hot_rows", "psum_bytes",                   # hybrid hot plane
     "membership_changes",                       # elastic epoch adoptions

@@ -94,6 +94,24 @@ def is_ef_field(name: str) -> bool:
     return name.endswith(EF_SUFFIX)
 
 
+#: name of the per-row version plane in a table state dict: one
+#: ``(capacity, 1)`` int32 array stamping every TAIL row with the
+#: per-shard-monotonic version of its last apply.  The delta-pull plane
+#: (transfer/pull_cache.py) compares these stamps against the worker's
+#: watermark to decide which pulled rows actually need bytes on the
+#: wire.  Tail-shaped, row-sharded; NOT an access field — pushes bump
+#: it as part of their apply, pulls gather it alongside the value rows
+#: when the cache is armed, and it otherwise rides the state pytree
+#: like the ``@ef`` planes do.  Hot rows carry no versions: the hybrid
+#: replica is reconciled by a dense psum every window and pull hits on
+#: it are already booked at 0 bytes.
+ROWVER_KEY = "@rowver"
+
+
+def has_row_versions(state) -> bool:
+    return ROWVER_KEY in state
+
+
 class SparseTable:
     def __init__(self, access: AccessMethod, key_index: KeyIndex,
                  mesh: Optional[Mesh] = None, axis: str = MODEL_AXIS,
@@ -186,6 +204,24 @@ class SparseTable:
         """Names of the armed residual planes (``[] when EF is off``)."""
         return [f for f in self.state if is_ef_field(f)]
 
+    def ensure_row_versions(self) -> None:
+        """Arm the per-row version plane: one zero-initialized
+        ``(capacity, 1)`` int32 tail-shaped array under
+        :data:`ROWVER_KEY`, row-sharded like the fields it stamps.
+        Idempotent — an existing plane (e.g. restored from a
+        checkpoint) is left alone, so versions keep counting up across
+        restarts and a resumed worker's cold cache can never collide
+        with a stale stamp.  Version 0 means "never applied"; every
+        push path bumps touched rows to ``max(local shard) + 1``, which
+        is monotonic per shard with no host-side counter."""
+        if ROWVER_KEY in self.state:
+            return
+        z = jnp.zeros((self.key_index.capacity, 1), jnp.int32)
+        sharding = self.row_sharding()
+        if sharding is not None:
+            z = jax.device_put(z, sharding)
+        self.state[ROWVER_KEY] = z
+
     # -- growth ------------------------------------------------------------
     def grow(self, new_capacity_per_shard: Optional[int] = None) -> None:
         """Re-lay-out the table at a larger per-shard capacity (default
@@ -258,6 +294,21 @@ class SparseTable:
             if sharding is not None:
                 arr = jax.device_put(arr, sharding)
             new_state[f] = arr
+        # the row-version plane re-strides with its rows exactly like
+        # the EF planes; fresh slots start at version 0 ("never
+        # applied").  Workers flush their pull caches on any capacity
+        # change (the shadow keys on capacity), so carried stamps can
+        # never false-hit against pre-growth cache entries even though
+        # the row ids they stamp just moved.
+        if ROWVER_KEY in self.state:
+            v = self.state[ROWVER_KEY]
+            arr = jnp.zeros((new_cap, v.shape[1]), v.dtype)
+            if len(items):
+                arr = arr.at[jnp.asarray(new_rows)].set(
+                    v[jnp.asarray(old_rows)])
+            if sharding is not None:
+                arr = jax.device_put(arr, sharding)
+            new_state[ROWVER_KEY] = arr
         self.state = new_state
 
     # -- online re-partition ----------------------------------------------
@@ -343,6 +394,19 @@ class SparseTable:
         for f, v in self.state.items():
             if is_ef_field(f):
                 new_state[f] = v
+        # row-version plane: tail rows keep their stamps (their ids are
+        # stable under repartition), but a demoted key's tail slot just
+        # had the live hot row written over it — bump those rows past
+        # the global max so any cached copy of the dormant pre-promotion
+        # value is invalidated.
+        if ROWVER_KEY in self.state:
+            ver = self.state[ROWVER_KEY]
+            if plan.demote_dst.shape[0]:
+                newv = jnp.max(ver) + jnp.int32(1)
+                ver = ver.at[jnp.asarray(plan.demote_dst)].set(newv)
+                if sharding is not None:
+                    ver = jax.device_put(ver, sharding)
+            new_state[ROWVER_KEY] = ver
         self.state = new_state
         return plan
 

@@ -54,8 +54,28 @@ import numpy as np
 
 from swiftmpi_tpu import obs
 from swiftmpi_tpu.parameter.access import AccessMethod
-from swiftmpi_tpu.parameter.sparse_table import TableState
+from swiftmpi_tpu.parameter.sparse_table import ROWVER_KEY, TableState
 from swiftmpi_tpu.utils.config import ConfigParser
+
+
+def bump_row_versions(out, state, safe_rows):
+    """Device twin of the row-version bump (delta-pull plane): stamp
+    the touched rows of the ``@rowver`` plane past the array's current
+    max — per-shard monotonic with no host counter, since inside a
+    ``shard_map`` the max runs over the local shard slice.  ``out`` is
+    the post-apply state dict being built; ``safe_rows`` may carry
+    out-of-bounds padding (``== capacity``), which drops.  A no-op
+    (and trace-identical) when the plane is absent — the static dict
+    check keeps ``pull_cache: off`` programs untouched.  Every push
+    apply path MUST route its touched rows through here (or the local
+    oracle's numpy twin): the PullCache's version-exact hit contract
+    depends on it."""
+    if ROWVER_KEY not in state:
+        return out
+    ver = state[ROWVER_KEY]
+    newv = jnp.max(ver) + jnp.int32(1)
+    out[ROWVER_KEY] = ver.at[safe_rows].set(newv, mode="drop")
+    return out
 
 
 def grad_row_bytes(grads, with_index: bool = True,
@@ -224,6 +244,25 @@ def pull_row_bytes(state, fields) -> int:
     return total
 
 
+def quant_pull_row_bytes(state, fields, quant: str) -> int:
+    """Encoded wire bytes per pulled row under the quantized pull
+    formats: the int32 request index survives, each field ships its
+    values int8 (1 byte/element plus a 4-byte per-(row, field) scale —
+    the PR-10 delta codec's scheme, transfer/delta.py) or bf16 (2
+    bytes/element, no scale).  The pull-side twin of
+    :func:`quant_grad_row_bytes`, used by the pull pricer
+    (transfer/plan.price_pull_formats) and the ledger's encoded
+    booking — note a 1-wide int8 field prices at 4+1+4 = 9 > 8 bytes
+    and correctly loses to ``full_f32``."""
+    if quant not in ("int8", "bf16"):
+        raise ValueError(f"quant_pull_row_bytes: unknown quant {quant!r}")
+    total = 4
+    for f in fields:
+        d = int(state[f].shape[-1])
+        total += d + 4 if quant == "int8" else 2 * d
+    return total
+
+
 @jax.tree_util.register_pytree_node_class
 class PushSpec:
     """One gradient-family push: ``(slots, grads, mean)``.
@@ -300,6 +339,9 @@ class Transfer:
                 "plan_compiles": 0, "plan_cache_hits": 0,
                 "coalesced_rows_in": 0, "coalesced_rows_out": 0,
                 "pull_bytes": 0, "pull_rows": 0, "pull_hot_rows": 0,
+                "pull_cache_hits": 0, "pull_delta_rows": 0,
+                "pull_bytes_saved": 0,
+                "pull_fmt_full": 0, "pull_fmt_bf16": 0, "pull_fmt_q": 0,
                 "pending": [], "pull_pending": [],
                 "pull_hot_pending": []}
         return st
@@ -346,6 +388,27 @@ class Transfer:
         st[fmt_key] += 1
         self._obs_inc("window_fmt", 1,
                       fmt=fmt_key[len("window_fmt_"):])
+
+    #: pull-format decision -> ledger counter (the pull family's
+    #: sibling of ``_WINDOW_FMT_KEY``), mirrored as the fmt-labeled
+    #: telemetry series ``transfer/pull_fmt{backend=, fmt=}``.
+    _PULL_FMT_KEY = {"full_f32": "pull_fmt_full",
+                     "bf16": "pull_fmt_bf16",
+                     "sparse_q": "pull_fmt_q"}
+
+    def _count_pull_decision(self, decision: str) -> None:
+        """Book one pull's wire-format decision.  Host-side eager like
+        :meth:`_count_collective` — the decision is plan-static per
+        compiled pull program, so this fires once per ``pull`` CALL
+        (trace time under jit), mirroring when the plan decision itself
+        is made.  Only armed pulls reach here: with ``pull_quant`` and
+        ``pull_cache`` both off the pull never compiles a plan and the
+        ledger stays byte-for-byte the legacy one."""
+        if not getattr(self, "count_traffic", False):
+            return
+        key = self._PULL_FMT_KEY[decision]
+        self._wire_state()[key] += 1
+        self._obs_inc("pull_fmt", 1, fmt=key[len("pull_fmt_"):])
 
     #: collective decision -> ledger counter (the dense/hot reconcile's
     #: sibling of ``_WINDOW_FMT_KEY``), mirrored as the kind-labeled
@@ -485,6 +548,72 @@ class Transfer:
                 for r in pending:
                     self._accum_pull_hot(r)
 
+    def _pull_shadow_get(self):
+        """This worker's versioned :class:`~swiftmpi_tpu.transfer.
+        pull_cache.PullCache` shadow, (re)built lazily when the
+        ``pull_cache`` knob (line count) or the oracle mode moved.
+        Host-side state — it never appears in a traced program, which
+        is what keeps ``pull_cache`` a pure ledger/wire-model plane:
+        a version-exact hit's cached row is bit-identical to the fresh
+        gather, so device values need no splice."""
+        from swiftmpi_tpu.transfer.pull_cache import PullCache
+        sh = self.__dict__.get("_pull_shadow")
+        lines = int(self.pull_cache)
+        oracle = bool(self.pull_cache_oracle)
+        if sh is None or sh.lines != lines or sh.store_rows != oracle:
+            sh = self.__dict__["_pull_shadow"] = PullCache(
+                lines, store_rows=oracle)
+        return sh
+
+    def pull_shadow_flush(self) -> None:
+        """Drop every cached (slot, version) tag: the worker starts
+        cold.  Called on membership changes and by the model's
+        restore/resume path — a rewound table can re-issue version
+        stamps, after which a warm cache could false-hit (the
+        invalidation contract in transfer/pull_cache.py)."""
+        sh = self.__dict__.get("_pull_shadow")
+        if sh is not None:
+            sh.flush()
+
+    def _accum_pull_cached(self, val_bytes, full_row_bytes, capacity,
+                           fields, slots, versions, *rows) -> None:
+        """Host landing point for one watermarked pull execution: run
+        the cache shadow over ``(slots, versions)`` and book the
+        delta-pull wire model —
+
+          request   8 bytes/valid row (int32 key + int32 watermark)
+          response  ceil(valid/8) hit-bitmap bytes, plus the plan's
+                    encoded value bytes per MISS row only
+
+        against the ``full_row_bytes`` baseline the uncached wire
+        would have booked; the difference lands on
+        ``pull_bytes_saved``.  ``rows`` (oracle mode only) are the
+        fresh field arrays the shadow value-checks hits against.
+        Fires per compiled execution via ``jax.debug.callback`` —
+        (slots, versions, rows) are gathered at one program point, so
+        the shadow's stored (version, value) pairs are always mutually
+        consistent even if the runtime reorders callbacks."""
+        sh = self._pull_shadow_get()
+        slots = np.asarray(slots).ravel()
+        rowmap = dict(zip(fields, rows)) if rows else None
+        hit = sh.lookup(slots, versions, int(capacity), rows=rowmap)
+        n_valid = int((slots >= 0).sum())
+        n_hit = int(hit.sum())
+        n_miss = n_valid - n_hit
+        booked = 8 * n_valid + (n_valid + 7) // 8 + n_miss * int(val_bytes)
+        saved = max(0, n_valid * int(full_row_bytes) - booked)
+        st = self._wire_state()
+        st["pull_bytes"] += booked
+        st["pull_rows"] += n_valid
+        st["pull_cache_hits"] += n_hit
+        st["pull_delta_rows"] += n_miss
+        st["pull_bytes_saved"] += saved
+        self._obs_inc("pull_bytes", booked)
+        self._obs_inc("pull_rows", n_valid)
+        self._obs_inc("pull_cache_hits", n_hit)
+        self._obs_inc("pull_delta_rows", n_miss)
+        self._obs_inc("pull_bytes_saved", saved)
+
     def _accum_coalesce(self, decision, rows_in, rows_out) -> None:
         st = self._wire_state()
         st["coalesced_rows_in"] += int(rows_in)
@@ -608,6 +737,9 @@ class Transfer:
         self._membership_epoch = epoch
         self._live_ranks = tuple(int(r) for r in live_ranks)
         self._obs_inc("membership_changes", 1)
+        # shard ownership moved: cached (slot, version) tags describe
+        # rows that may now live elsewhere — start cold
+        self.pull_shadow_flush()
         self._membership_changed()
 
     def _membership_changed(self) -> None:
@@ -636,6 +768,34 @@ class Transfer:
     #: aggressively.  Host-side like the dense ratio — takes effect on
     #: the next decision.
     wire_quant_guard = 1.25
+
+    #: value quantization for the pull wire (``transfer.plan.
+    #: PULL_QUANT_MODES``): ``"off"`` (default — pulls ship ``full_f32``
+    #: and stay bit-identical to the legacy wire) | ``"int8"`` (the
+    #: ``sparse_q`` rung, PR-10 codec scheme) | ``"bf16"``.  Set from
+    #: ``[cluster] pull_quant``.  Quantized pulls perturb the FORWARD
+    #: READ, not the server state, so parity holds to the PR-10
+    #: trajectory envelope rather than bit-exactness.
+    pull_quant = "off"
+
+    #: safety factor pricing the encoded pull rungs: an encoded format
+    #: wins only when its volume times this still beats ``full_f32``
+    #: (transfer.plan.price_pull_formats).  Same semantics and default
+    #: as ``wire_quant_guard``.
+    pull_quant_guard = 1.25
+
+    #: versioned pull-cache size in LINES (direct-mapped,
+    #: transfer/pull_cache.py); 0 = off.  Set from ``[cluster]
+    #: pull_cache``.  Arming requires the table's row-version plane
+    #: (``SparseTable.ensure_row_versions``) — the model arms both
+    #: together.  The cache is a host-side wire-model shadow: values
+    #: are unchanged by construction, only the pull ledger moves.
+    pull_cache = 0
+
+    #: test-only oracle mode: the shadow stores actual row values and
+    #: asserts cached == fresh on every version-exact hit — proving
+    #: every apply path bumps its rows' versions.
+    pull_cache_oracle = False
 
     #: arm the ``sparse_sketch`` wire rung (transfer/sketch.py):
     #: counting-sketch index compression between the ``bitmap`` and
@@ -716,6 +876,31 @@ class Transfer:
             tr.on_decision(self.name, plan.wire_format, plan.prices,
                            plan.rows, plan.capacity, plan.row_bytes,
                            quant=plan.quant)
+        return plan
+
+    def _pull_plan(self, rows: int, capacity: int, row_bytes: int,
+                   quant_row_bytes: Optional[int] = None):
+        """Compile (or fetch) this instance's :class:`PullPlan`
+        (transfer/plan.py's ``compile_pull_plan``) — the pull sibling
+        of :meth:`_window_plan`, with the same observation discipline:
+        compile/hit counters on the wire ledger, the format decision
+        on the ``pull_fmt`` counters, and the pricing evidence on the
+        armed wire tracer (decision key ``pull_<format>`` so pulls
+        don't collide with the window formats in the trace price
+        cache)."""
+        from swiftmpi_tpu.transfer.plan import compile_pull_plan
+        plan, hit = compile_pull_plan(self, int(rows), int(capacity),
+                                      int(row_bytes), quant_row_bytes)
+        if getattr(self, "count_traffic", False):
+            key = "plan_cache_hits" if hit else "plan_compiles"
+            self._wire_state()[key] += 1
+            self._obs_inc(key, 1)
+        self._count_pull_decision(plan.wire_format)
+        tr = obs.get_tracer()
+        if tr is not None:
+            tr.on_decision(self.name, "pull_" + plan.wire_format,
+                           plan.prices, plan.rows, plan.capacity,
+                           plan.row_bytes, quant=plan.quant)
         return plan
 
     def _hot_plan(self, n_hot: int, width_bytes: int):
@@ -807,8 +992,153 @@ class Transfer:
         subset of ``access.pull_fields`` — a caller whose slot groups
         need different fields (w2v: h for targets, v for contexts)
         splits its pulls rather than gathering every field for every
-        slot and discarding half the bytes."""
+        slot and discarding half the bytes.
+
+        This method is THE pull-family TrafficPlan interpreter (the
+        single dispatch point the PLAN-DISPATCH lint rule pins, the
+        pull sibling of :meth:`push_window`): it compiles a
+        :class:`PullPlan` (transfer/plan.py) when the ``pull_quant`` /
+        ``pull_cache`` knobs are armed and executes it over the
+        backend's ONE structural primitive — :meth:`_prim_pull`, a
+        plain masked row gather — with every ledger/cache/quant tap
+        fired from HERE.  Backends never ask the pull-format question
+        and never book the pull ledger.  With both knobs off the pull
+        books and gathers exactly the legacy wire — bit-identical by
+        construction."""
+        from swiftmpi_tpu.transfer.plan import pull_route
+        fields = tuple(fields or access.pull_fields)
+        route = pull_route(self.name)
+        if route.placement == "hot_split":
+            return self._interpret_pull_hot_split(state, slots, access,
+                                                  fields)
+        return self._interpret_pull_flat(state, slots, fields)
+
+    def _prim_pull(self, state: TableState, slots, fields) -> TableState:
+        """Backend pull primitive: masked row gather of ``fields`` at
+        ``slots`` (``-1`` padding yields zero rows), NO ledger booking
+        and no format logic — the interpreter owns both.  Structural
+        routing accounting (the tpu backend's routed-row and overflow
+        counters) stays with the primitive, like the push executors'."""
         raise NotImplementedError
+
+    def _interpret_pull_flat(self, state: TableState, slots,
+                             fields) -> TableState:
+        """Execute one pull on a ``flat`` route.  Armed, the plan's
+        format prices the wire (encoded rungs round-trip the pulled
+        values through :func:`quantize_dequantize` — the forward read
+        perturbs, the server state does not) and the versioned cache
+        shadow books the delta wire: the row-version plane rides the
+        SAME routed gather as the value rows (the watermark protocol's
+        4 bytes/row), then lands host-side via the ledger's callback
+        discipline."""
+        from swiftmpi_tpu.parameter.sparse_table import ROWVER_KEY
+        from swiftmpi_tpu.transfer.plan import pull_route
+        route = pull_route(self.name)
+        capacity = next(iter(state.values())).shape[0]
+        row_bytes = pull_row_bytes(state, fields)
+        quant = self.pull_quant
+        armed = quant != "off" or bool(self.pull_cache)
+        if route.eager:
+            slots_h = np.asarray(slots, np.int64)
+            n_valid = int((slots_h >= 0).sum())
+            if not armed:
+                self._record_pull(n_valid, row_bytes)
+                return self._prim_pull(state, slots, fields)
+            qrb = (quant_pull_row_bytes(state, fields, quant)
+                   if quant != "off" else None)
+            plan = self._pull_plan(int(slots_h.size), capacity,
+                                   row_bytes, qrb)
+            cached = plan.cached and ROWVER_KEY in state
+            if cached:
+                out = self._prim_pull(state, slots,
+                                      fields + (ROWVER_KEY,))
+                vers = np.asarray(out.pop(ROWVER_KEY)).ravel()
+                if self.count_traffic:
+                    rows = (tuple(np.asarray(out[f]) for f in fields)
+                            if self.pull_cache_oracle else ())
+                    self._accum_pull_cached(
+                        plan.wire_row_bytes - 4, row_bytes, capacity,
+                        fields, slots_h.ravel(), vers, *rows)
+            else:
+                out = self._prim_pull(state, slots, fields)
+                self._record_pull(n_valid, plan.wire_row_bytes)
+            if plan.wire_format != "full_f32":
+                for f in fields:
+                    out[f] = np.asarray(
+                        quantize_dequantize(out[f], plan.quant))
+            return out
+        slots_j = jnp.asarray(slots, jnp.int32)
+        if not armed:
+            self._record_pull(jnp.sum(slots_j >= 0), row_bytes)
+            return self._prim_pull(state, slots_j, fields)
+        qrb = (quant_pull_row_bytes(state, fields, quant)
+               if quant != "off" else None)
+        plan = self._pull_plan(int(slots_j.size), capacity, row_bytes,
+                               qrb)
+        cached = plan.cached and ROWVER_KEY in state
+        if cached:
+            out = self._prim_pull(state, slots_j,
+                                  fields + (ROWVER_KEY,))
+            vers = out.pop(ROWVER_KEY)
+            if self.count_traffic:
+                from functools import partial
+                cb = partial(self._accum_pull_cached,
+                             plan.wire_row_bytes - 4, row_bytes,
+                             capacity, fields)
+                rows = (tuple(out[f] for f in fields)
+                        if self.pull_cache_oracle else ())
+                if isinstance(slots_j, jax.core.Tracer) \
+                        or isinstance(vers, jax.core.Tracer):
+                    jax.debug.callback(cb, slots_j, vers, *rows)
+                else:
+                    cb(np.asarray(slots_j), np.asarray(vers),
+                       *(np.asarray(r) for r in rows))
+        else:
+            out = self._prim_pull(state, slots_j, fields)
+            self._record_pull(jnp.sum(slots_j >= 0),
+                              plan.wire_row_bytes)
+        if plan.wire_format != "full_f32":
+            out = {f: quantize_dequantize(out[f], plan.quant)
+                   for f in fields}
+        return out
+
+    def _interpret_pull_hot_split(self, state: TableState, slots, access,
+                                  fields) -> TableState:
+        """Execute the ``hot_split`` pull placement (hybrid): replica
+        hits resolved from the local hot head at 0 bytes exactly as the
+        legacy wire books them, tail rows re-based by ``-n_hot`` and
+        re-interpreted through the tail backend's ``pull`` — so the
+        tail's cache/quant/ledger compose exactly as they do
+        standalone, and hot reads are never quantized (the replica is
+        reconciled losslessly by the hot psum).  Uses the hybrid
+        backend's structural primitives (``_pad_batch``,
+        ``_split_state``, ``_n_hot``) — only reachable on routes
+        declaring ``placement="hot_split"``."""
+        slots = jnp.asarray(slots, jnp.int32)
+        slots, _, _, B = self._pad_batch(slots)
+        tail_state, hot_state = self._split_state(state)
+        n_hot = self._n_hot(state)
+        if n_hot == 0:
+            out = self.tail.pull(tail_state, slots, access,
+                                 fields=fields)
+            return {f: v[:B] for f, v in out.items()}
+        is_hot = (slots >= 0) & (slots < n_hot)
+        tail_slots = jnp.where(slots >= n_hot, slots - n_hot, -1)
+        out = self.tail.pull(tail_state, tail_slots, access,
+                             fields=fields)
+        n_hot_rows = jnp.sum(is_hot)
+        if self.count_traffic:
+            # replica hits ship nothing: rows counted, zero bytes —
+            # the 0-byte hot booking the cross-backend goldens pin
+            self._record_hot(n_hot_rows, 0)
+            self._record_pull(n_hot_rows, 0)
+            self._record_pull_hot(n_hot_rows)
+        safe_hot = jnp.clip(slots, 0, n_hot - 1)
+        return {
+            f: jnp.where(is_hot[..., None],
+                         jnp.take(hot_state[f], safe_hot, axis=0),
+                         out[f])[:B]
+            for f in fields}
 
     def push(self, state: TableState, slots, grads: TableState,
              access: AccessMethod, mean: bool = False) -> TableState:
@@ -998,7 +1328,9 @@ class Transfer:
         new_fields = access.apply_push(state, dense)
         out = dict(state)
         out.update(new_fields)
-        return out
+        ok = (flat >= 0) & (flat < capacity)
+        return bump_row_versions(out, state,
+                                 jnp.where(ok, flat, capacity))
 
     def _interpret_window_flat(self, state, flat, fgrads, access,
                                mean: bool, fcounts, pre_deduped=False,

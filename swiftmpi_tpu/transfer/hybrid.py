@@ -136,6 +136,51 @@ class HybridTransfer(Transfer):
         self.tail.wire_sketch = bool(v)
 
     @property
+    def pull_quant(self) -> str:
+        """Pull value-quantization mode (``off|int8|bf16``); lives on
+        the tail, whose pull plan prices the format.  Hot rows are
+        untouched — replica reads ship nothing and are never
+        quantized."""
+        return self.tail.pull_quant
+
+    @pull_quant.setter
+    def pull_quant(self, v: str):
+        self.tail.pull_quant = v
+
+    @property
+    def pull_quant_guard(self) -> float:
+        return self.tail.pull_quant_guard
+
+    @pull_quant_guard.setter
+    def pull_quant_guard(self, v: float):
+        self.tail.pull_quant_guard = float(v)
+
+    @property
+    def pull_cache(self) -> int:
+        """Versioned pull-cache line count (0 = off); lives on the
+        tail, which runs the cache shadow — hot-replica hits are
+        already 0 bytes and never enter the cache."""
+        return self.tail.pull_cache
+
+    @pull_cache.setter
+    def pull_cache(self, v: int):
+        self.tail.pull_cache = int(v)
+
+    @property
+    def pull_cache_oracle(self) -> bool:
+        return self.tail.pull_cache_oracle
+
+    @pull_cache_oracle.setter
+    def pull_cache_oracle(self, v: bool):
+        self.tail.pull_cache_oracle = bool(v)
+
+    def pull_shadow_flush(self) -> None:
+        # the tail owns the live shadow (tail pulls book the cache);
+        # flush both for symmetry with the knob forwarding above
+        self.tail.pull_shadow_flush()
+        super().pull_shadow_flush()
+
+    @property
     def collective_mode(self) -> str:
         """Hot/dense collective selection mode (``psum | auto |
         sparse_allreduce``); storage lives on the tail so the tail's
@@ -242,7 +287,10 @@ class HybridTransfer(Transfer):
                   "hot_psum_bytes_saved",
                   "plan_compiles", "plan_cache_hits",
                   "coalesced_rows_in", "coalesced_rows_out",
-                  "pull_bytes", "pull_rows", "pull_hot_rows"):
+                  "pull_bytes", "pull_rows", "pull_hot_rows",
+                  "pull_cache_hits", "pull_delta_rows",
+                  "pull_bytes_saved",
+                  "pull_fmt_full", "pull_fmt_bf16", "pull_fmt_q"):
             out[k] = t.get(k, 0) + w.get(k, 0)
         if self.metrics is not None:
             self.metrics.set("transfer_hot_rows", out["hot_rows"])
@@ -280,36 +328,12 @@ class HybridTransfer(Transfer):
         return slots, grads, counts, B
 
     # -- pull --------------------------------------------------------------
-    def pull(self, state, slots, access, fields=None):
-        fields = tuple(fields or access.pull_fields)
-        slots = jnp.asarray(slots, jnp.int32)
-        slots, _, _, B = self._pad_batch(slots)
-        tail_state, hot_state = self._split_state(state)
-        n_hot = self._n_hot(state)
-        if n_hot == 0:
-            out = self.tail.pull(tail_state, slots, access, fields)
-            return {f: v[:B] for f, v in out.items()}
-        is_hot = (slots >= 0) & (slots < n_hot)
-        tail_slots = jnp.where(slots >= n_hot, slots - n_hot, -1)
-        out = self.tail.pull(tail_state, tail_slots, access, fields)
-        if self.count_traffic:
-            n_hot_rows = jnp.sum(is_hot)
-            self._record_hot(n_hot_rows, 0)
-            # hot pulls are local replica hits: rows counted, zero bytes
-            # (tail rows/bytes land on the tail backend's own ledger and
-            # merge in traffic()).  The explicit pull_hot_rows series
-            # disambiguates the asymmetry — pull_rows includes these
-            # rows while pull_bytes books them at 0, so byte-per-row or
-            # miss-ratio math must subtract pull_hot_rows first
-            self._record_pull(n_hot_rows, 0)
-            self._record_pull_hot(n_hot_rows)
-        # hot rows are a LOCAL gather on the replicated head — the tail
-        # pull returned exact zeros at these positions (slot -1 padding)
-        hot_idx = jnp.clip(slots, 0, n_hot - 1)
-        for f in fields:
-            hot_rows = jnp.take(hot_state[f], hot_idx, axis=0)
-            out[f] = jnp.where(is_hot[..., None], hot_rows, out[f])[:B]
-        return out
+    # No override: the base-class pull interpreter (api.Transfer.pull)
+    # drives this backend through its ``hot_split`` placement stage
+    # (``_interpret_pull_hot_split``), composing `_pad_batch`,
+    # `_split_state` and the tail backend's own pull — replica hits
+    # resolve locally at 0 bytes, tail rows book (and cache/quantize)
+    # on the tail's ledger and merge in traffic().
 
     # -- push --------------------------------------------------------------
     def push(self, state, slots, grads, access, mean=False, counts=None):

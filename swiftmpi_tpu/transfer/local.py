@@ -13,11 +13,22 @@ import numpy as np
 
 from swiftmpi_tpu import obs
 from swiftmpi_tpu.parameter.access import AccessMethod
-from swiftmpi_tpu.parameter.sparse_table import ef_name
+from swiftmpi_tpu.parameter.sparse_table import ROWVER_KEY, ef_name
 from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
                                        numerics_quant_err,
-                                       pull_row_bytes,
                                        quantize_dequantize)
+
+
+def _bump_versions(out, rows) -> None:
+    """Stamp ``rows`` of the row-version plane (present iff the
+    delta-pull cache is armed) past the current max — the eager numpy
+    twin of the device backends' per-shard ``max + 1`` bump.  Any apply
+    that changes a row MUST pass through here (or a device twin): the
+    PullCache's version-exact hit contract depends on it."""
+    if ROWVER_KEY not in out:
+        return
+    ver = out[ROWVER_KEY]
+    ver[np.asarray(rows, np.int64)] = np.int32(ver.max() + 1)
 
 
 class LocalTransfer(Transfer):
@@ -37,11 +48,11 @@ class LocalTransfer(Transfer):
         self.membership_log.append(
             (self._membership_epoch, self._live_ranks))
 
-    def pull(self, state, slots, access, fields=None):
+    def _prim_pull(self, state, slots, fields):
+        # structural gather only — the ledger/format/cache logic lives
+        # in the base-class pull interpreter (api.Transfer.pull)
         slots = np.asarray(slots, np.int64)
         valid = slots >= 0
-        fields = tuple(fields or access.pull_fields)
-        self._record_pull(int(valid.sum()), pull_row_bytes(state, fields))
         out = {}
         for f in fields:
             arr = np.asarray(state[f])
@@ -71,6 +82,7 @@ class LocalTransfer(Transfer):
         out = {f: np.asarray(state[f]).copy() for f in state}
         for f in updated:
             out[f][uniq] = np.asarray(updated[f])
+        _bump_versions(out, uniq)
         return out
 
     def push_span(self, state, slots, grads, counts, access, mean=False,
@@ -106,6 +118,7 @@ class LocalTransfer(Transfer):
         out = {f: np.asarray(state[f]).copy() for f in state}
         for f in updated:
             out[f][uniq] = np.asarray(updated[f])
+        _bump_versions(out, uniq)
         return out
 
     # -- window-plan primitives --------------------------------------------
@@ -162,6 +175,7 @@ class LocalTransfer(Transfer):
         out = {f: np.asarray(state[f]).copy() for f in state}
         for f in updated:
             out[f][uniq] = np.asarray(updated[f])
+        _bump_versions(out, uniq)
         return out
 
     def _prim_ef_drain(self, state, uniq, sums, capacity, quant):

@@ -57,6 +57,21 @@ COLLECTIVES = ("psum", "psum_scatter", "sparse_allreduce")
 #: density signal.
 COLLECTIVE_MODES = ("psum", "auto", "sparse_allreduce")
 
+#: the pull-wire ladder (ISSUE 20): ``full_f32`` ships rows at their
+#: stored dtype (the legacy wire, 4-byte key + field bytes per row),
+#: ``bf16`` halves the value payload, ``sparse_q`` ships int8 rows
+#: with a per-row f32 scale — the PR-10 delta codec's scheme
+#: (transfer/delta.py), applied to the server→worker direction.  Every
+#: decision :func:`price_pull_formats` can return appears here; the
+#: pull interpreter refuses a format this tuple doesn't know.
+PULL_FORMATS = ("full_f32", "bf16", "sparse_q")
+
+#: legal values of the ``[cluster] pull_quant`` knob; ``bf16``/``int8``
+#: ARM the matching encoded rung, they don't pin it — the pricer still
+#: has to clear the quantization-error guard before a pull leaves
+#: ``full_f32``.
+PULL_QUANT_MODES = ("off", "bf16", "int8")
+
 
 @dataclass(frozen=True)
 class WireFormatSpec:
@@ -339,6 +354,141 @@ def compile_hot_plan(transfer, n_hot: int, width_bytes: int,
         collective=decision, taps=("decision",),
         rows=int(round((fraction or 0.0) * n_hot)), capacity=int(n_hot),
         row_bytes=int(width_bytes), quant_row_bytes=None,
+        priced=tuple(sorted(prices.items())))
+    if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
+        _PLAN_CACHE.clear()
+    _PLAN_CACHE[key] = plan
+    return plan, False
+
+
+# -- the pull family (ISSUE 20) -------------------------------------------
+
+@dataclass(frozen=True)
+class PullRoute:
+    """Per-backend structural facts for the PULL interpreter
+    (``Transfer.pull`` in transfer/api.py), the mirror of
+    :class:`WindowRoute` for the server→worker direction.
+
+    ``eager``: the pull primitive is host/numpy (the local oracle) —
+    the interpreter books the ledger and runs the cache shadow inline
+    instead of through a traced callback.
+    ``placement``: ``flat`` (one gather over the whole slot space) or
+    ``hot_split`` (hybrid: replicated-head hits resolved locally at 0
+    bytes, tail rows re-based by ``-n_hot`` and re-interpreted on the
+    tail backend — so the tail's cache/quant/ledger compose exactly as
+    they do standalone).
+    """
+
+    eager: bool = False
+    placement: str = "flat"
+
+
+#: backend name -> pull route.  THE table a new backend is added to.
+PULL_ROUTES: Dict[str, PullRoute] = {
+    "local": PullRoute(eager=True),
+    "xla": PullRoute(),
+    "tpu": PullRoute(),
+    "hybrid": PullRoute(placement="hot_split"),
+}
+
+
+def pull_route(backend: str) -> PullRoute:
+    try:
+        return PULL_ROUTES[backend]
+    except KeyError:
+        raise KeyError(f"transfer.plan: backend {backend!r} has no "
+                       "pull route (add it to PULL_ROUTES)") from None
+
+
+@dataclass(frozen=True)
+class PullPlan:
+    """One compiled pull plan: the wire format the response rows ship
+    in, whether the versioned cache is consulted, and the pricing
+    evidence.  Frozen — a plan is a value; re-pricing lands a new plan
+    under a new cache key, so knob moves need no invalidation
+    protocol (same contract as :class:`TrafficPlan`)."""
+
+    backend: str
+    placement: str
+    wire_format: str
+    quant: str                    # off | int8 | bf16 (value encoding)
+    cached: bool                  # versioned PullCache consulted
+    rows: int
+    capacity: int
+    row_bytes: int                # full_f32 row bytes (4-byte key incl.)
+    wire_row_bytes: int           # chosen format's row bytes
+    priced: Tuple[Tuple[str, float], ...]
+
+    @property
+    def prices(self) -> Dict[str, float]:
+        return dict(self.priced)
+
+
+def price_pull_formats(rows: int, row_bytes: int,
+                       quant: str = "off",
+                       quant_row_bytes: Optional[int] = None,
+                       quant_guard: float = 1.25):
+    """The pull-format decision WITH its evidence: ``(decision,
+    prices)`` over :data:`PULL_FORMATS`, the server→worker mirror of
+    ``parameter.key_index.price_window_formats``.  The byte models:
+
+      full_f32  ``rows * row_bytes``            (4-byte key + stored rows)
+      bf16      ``rows * quant_row_bytes``      (key + 2 bytes/element)
+      sparse_q  ``rows * quant_row_bytes``      (key + 1 byte/element
+                                                 + 4-byte scale/field)
+
+    With ``quant == "off"`` only ``full_f32`` is priced — the decision
+    set itself records that no encoded rung was in play, and off-knob
+    pulls stay bit-identical by construction.  An encoded rung wins
+    only past the **quantization-error guard**: ``q_vol * quant_guard
+    <= full_vol`` (default 1.25 — never perturb the forward read for a
+    marginal byte win; a 1-wide int8 field prices at 9 > 8 bytes and
+    correctly loses)."""
+    full_vol = float(rows) * float(row_bytes)
+    prices = {"full_f32": full_vol}
+    if quant == "off" or quant_row_bytes is None:
+        return "full_f32", prices
+    fmt = "bf16" if quant == "bf16" else "sparse_q"
+    q_vol = float(rows) * float(quant_row_bytes)
+    prices[fmt] = q_vol
+    if q_vol * quant_guard <= full_vol:
+        return fmt, prices
+    return "full_f32", prices
+
+
+def compile_pull_plan(transfer, rows: int, capacity: int,
+                      row_bytes: int,
+                      quant_row_bytes: Optional[int],
+                      ) -> Tuple[PullPlan, bool]:
+    """Compile (or fetch) the :class:`PullPlan` for one pull shape on
+    ``transfer``; returns ``(plan, cache_hit)``.  The key carries every
+    pricing input — the live ``pull_quant`` / ``pull_quant_guard`` /
+    ``pull_cache`` knobs included — so a Controller apply re-prices on
+    the very next pull, exactly like the window plans."""
+    quant = transfer.pull_quant if quant_row_bytes is not None else "off"
+    if quant not in PULL_QUANT_MODES:
+        raise ValueError(
+            f"transfer.plan: unknown pull_quant mode {quant!r} "
+            f"(expected one of {PULL_QUANT_MODES})")
+    guard = transfer.pull_quant_guard
+    cached = bool(transfer.pull_cache)
+    key = (transfer.name, "pull", int(rows), int(capacity),
+           int(row_bytes), quant_row_bytes, quant, guard, cached)
+    plan = _PLAN_CACHE.get(key)
+    if plan is not None:
+        return plan, True
+    decision, prices = price_pull_formats(
+        int(rows), int(row_bytes), quant=quant,
+        quant_row_bytes=quant_row_bytes, quant_guard=guard)
+    route = pull_route(transfer.name)
+    wire_rb = (int(row_bytes) if decision == "full_f32"
+               else int(quant_row_bytes))
+    plan = PullPlan(
+        backend=transfer.name, placement=route.placement,
+        wire_format=decision, quant=(quant if decision != "full_f32"
+                                     else "off"),
+        cached=cached, rows=int(rows), capacity=int(capacity),
+        row_bytes=int(row_bytes), wire_row_bytes=wire_rb,
         priced=tuple(sorted(prices.items())))
     if len(_PLAN_CACHE) >= _PLAN_CACHE_MAX:
         _PLAN_CACHE.clear()

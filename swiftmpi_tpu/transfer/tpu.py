@@ -39,8 +39,8 @@ from swiftmpi_tpu.cluster.mesh import DATA_AXIS, SHARD_AXIS
 from swiftmpi_tpu.obs import costs as obs_costs
 from swiftmpi_tpu.ops import (calibration, pallas_gather, pallas_ring,
                               pallas_scatter)
-from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
-                                       pull_row_bytes)
+from swiftmpi_tpu.parameter.sparse_table import ROWVER_KEY
+from swiftmpi_tpu.transfer.api import Transfer, grad_row_bytes
 
 
 def _shard_gather(arr: jax.Array, flat_idx: jax.Array) -> jax.Array:
@@ -259,19 +259,22 @@ class TpuTransfer(Transfer):
         return sig
 
     # -- pull --------------------------------------------------------------
-    def pull(self, state, slots, access, fields=None):
-        fields = tuple(fields or access.pull_fields)
+    def _prim_pull(self, state, slots, fields):
+        """Structural routed gather — wire-format / cache / byte-ledger
+        decisions live in the base-class pull interpreter
+        (api.Transfer.pull).  The routed-row and overflow counters stay
+        with the primitive: they are properties of THIS backend's bucket
+        routing, not of the wire format."""
+        fields = tuple(fields)
         slots = jnp.asarray(slots, jnp.int32)
         if self.count_traffic:
-            valid = jnp.sum(slots >= 0)
-            self._record_routed(valid)
-            self._record_pull(valid, pull_row_bytes(state, fields))
+            self._record_routed(jnp.sum(slots >= 0))
         sig = self._signature(state, slots) + (fields,)
         fn = self._pull_cache.get(sig)
         if fn is None:
             fn = self._pull_cache.setdefault(
                 sig, obs_costs.track("tpu_pull", jax.jit(
-                    self._build_pull(state, access, fields))))
+                    self._build_pull(state, fields))))
         if self.bucket_capacity is None:
             return fn(state, slots)
         out, ovf = fn(state, slots)
@@ -284,8 +287,7 @@ class TpuTransfer(Transfer):
         return P((self.dp_axis, self.axis)) if self.dp_axis \
             else P(self.axis)
 
-    def _build_pull(self, state, access, fields=None):
-        fields = tuple(fields or access.pull_fields)
+    def _build_pull(self, state, fields):
         capacity = next(iter(state.values())).shape[0]
         cap_per_shard = capacity // self.n
         bspec = self._batch_spec()
@@ -535,6 +537,21 @@ class TpuTransfer(Transfer):
                 new_fields = access.apply_push(state_l, dense)
             out = dict(state_l)
             out.update(new_fields)
+            if ROWVER_KEY in state_l:
+                # delta-pull version stamp: global-slot occupancy
+                # reduce-scattered onto its owning shard tile (the same
+                # wire the grads ride), psum'd over the data axis so
+                # replicas stamp the identical union of touched rows
+                touched = jnp.zeros((capacity,), jnp.int32).at[safe].add(
+                    valid.astype(jnp.int32), mode="drop")
+                touched = jax.lax.psum_scatter(
+                    touched, self.axis, scatter_dimension=0, tiled=True)
+                if self.dp_axis:
+                    touched = jax.lax.psum(touched, self.dp_axis)
+                ver = state_l[ROWVER_KEY]
+                newv = jnp.max(ver) + jnp.int32(1)
+                out[ROWVER_KEY] = jnp.where(
+                    (touched > 0)[:, None], newv, ver)
             return out
 
         return _push_dense
@@ -658,6 +675,22 @@ class TpuTransfer(Transfer):
                 new_fields = access.apply_push(state_l, dense)
             out = dict(state_l)
             out.update(new_fields)
+            if ROWVER_KEY in state_l:
+                # delta-pull version stamp: bump every row touched by
+                # THIS apply past the shard's current max (per-shard
+                # monotonic — sparse_table.py).  The plane is replicated
+                # across data groups, so the bump must cover the UNION
+                # of touched rows: an occupancy plane psum'd over the
+                # data axis, exactly like the grads themselves.
+                touched = jnp.zeros((cap_per_shard,), jnp.int32).at[
+                    safe_rows].add(ok.reshape(-1).astype(jnp.int32),
+                                   mode="drop")
+                if self.dp_axis:
+                    touched = jax.lax.psum(touched, self.dp_axis)
+                ver = state_l[ROWVER_KEY]
+                newv = jnp.max(ver) + jnp.int32(1)
+                out[ROWVER_KEY] = jnp.where(
+                    (touched > 0)[:, None], newv, ver)
             if not counted:
                 return out
             axes = (self.dp_axis, self.axis) if self.dp_axis \

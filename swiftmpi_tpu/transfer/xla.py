@@ -25,8 +25,8 @@ import jax
 import jax.numpy as jnp
 
 from swiftmpi_tpu.ops import calibration, pallas_gather, pallas_scatter
-from swiftmpi_tpu.transfer.api import (Transfer, grad_row_bytes,
-                                       pull_row_bytes)
+from swiftmpi_tpu.transfer.api import (Transfer, bump_row_versions,
+                                       grad_row_bytes)
 
 # replica-spread scatter: cap the R-fold temporary at ~256MB so the
 # measured-win gate can never OOM a large table's push
@@ -85,11 +85,11 @@ class XlaTransfer(Transfer):
         self.window_expected_unique = None
 
     # -- pull (global_pull_access.h:28-43 equivalent) ----------------------
-    def pull(self, state, slots, access, fields=None):
+    def _prim_pull(self, state, slots, fields):
+        # structural gather only — the ledger/format/cache logic lives
+        # in the base-class pull interpreter (api.Transfer.pull)
         slots = jnp.asarray(slots, jnp.int32)
         valid = slots >= 0
-        fields = tuple(fields or access.pull_fields)
-        self._record_pull(jnp.sum(valid), pull_row_bytes(state, fields))
         return {f: _masked_gather(state[f], slots, valid)
                 for f in fields}
 
@@ -170,7 +170,7 @@ class XlaTransfer(Transfer):
         new_fields = access.apply_push(state, dense_grads)
         out = dict(state)
         out.update(new_fields)
-        return out
+        return bump_row_versions(out, state, safe)
 
     # -- span push (stencil rendering; see models/word2vec.py) -------------
     def push_span(self, state, slots, grads, counts, access, mean=False,
@@ -244,7 +244,7 @@ class XlaTransfer(Transfer):
             # scatter's collision machinery.
             out[f] = state[f].at[tgt].set(
                 updated[f], mode="drop", unique_indices=True)
-        return out
+        return bump_row_versions(out, state, tgt)
 
     # -- window-coalesced push ---------------------------------------------
     # No override: the base-class TrafficPlan interpreter
@@ -315,4 +315,4 @@ class XlaTransfer(Transfer):
             out[f] = state[f].at[rep_slots].set(
                 updated[f], mode="drop", indices_are_sorted=True,
                 unique_indices=True)
-        return out
+        return bump_row_versions(out, state, rep_slots)

@@ -1,0 +1,532 @@
+"""Delta-pull plane: versioned pull cache, quantized pull formats,
+and the TrafficPlan-compiled pull wire (ISSUE 20).
+
+Safety contract pinned here:
+
+* knobs off => pulls are BIT-identical to the legacy wire and the
+  ledger books exactly the legacy bytes, on all four backends;
+* the cross-backend pull ledger is a golden: local == xla == tpu
+  exactly on every pull_* counter under the same slot/version stream,
+  and the hybrid hot head books its replica hits at 0 bytes;
+* a stale cache row is NEVER served: any apply bumps the row version
+  (the store_rows oracle proves it value-for-value), grow flushes the
+  shadow, repartition bumps demoted rows, resume restarts cold.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from swiftmpi_tpu.cluster import SHARD_AXIS, ps_mesh
+from swiftmpi_tpu.parameter import KeyIndex, SparseTable, w2v_access
+from swiftmpi_tpu.parameter.key_index import HotColdPartition
+from swiftmpi_tpu.parameter.sparse_table import ROWVER_KEY, has_row_versions
+from swiftmpi_tpu.transfer.hybrid import HybridTransfer
+from swiftmpi_tpu.transfer.local import LocalTransfer
+from swiftmpi_tpu.transfer.plan import price_pull_formats, pull_route
+from swiftmpi_tpu.transfer.pull_cache import PullCache
+from swiftmpi_tpu.transfer.tpu import TpuTransfer
+from swiftmpi_tpu.transfer.xla import XlaTransfer
+from swiftmpi_tpu.utils import ConfigParser
+
+DIM = 8
+#: full_f32 row: 4B key + two DIM-wide f32 fields
+FULL_RB = 4 + 2 * DIM * 4
+#: int8 row: 4B key + 2 * (DIM bytes + 4B scale)
+Q_RB = 4 + 2 * (DIM + 4)
+
+PULL_KEYS = ("pull_bytes", "pull_rows", "pull_hot_rows",
+             "pull_cache_hits", "pull_delta_rows", "pull_bytes_saved",
+             "pull_fmt_full", "pull_fmt_bf16", "pull_fmt_q")
+
+
+def make_table(mesh=None, cap=32, seed=0):
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    ki = KeyIndex(num_shards=8, capacity_per_shard=cap)
+    table = SparseTable(access, ki, mesh=mesh,
+                        axis=SHARD_AXIS if mesh else "model", seed=seed)
+    return table, ki, access
+
+
+def zipf_counts(v, s=1.0, total=1_000_000):
+    ranks = np.arange(1, v + 1, dtype=np.float64)
+    p = ranks ** -s
+    return np.maximum((total * p / p.sum()).astype(np.int64), 1)
+
+
+def make_hybrid_table(mesh, n_keys=400, seed=0):
+    rng = np.random.default_rng(seed)
+    keys = rng.choice(100_000, size=n_keys, replace=False).astype(np.uint64)
+    counts = zipf_counts(n_keys)[rng.permutation(n_keys)]
+    part = HotColdPartition.from_counts(keys, counts, batch_rows=64)
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    ki = KeyIndex(8, 64, partition=part)
+    table = SparseTable(access, ki, mesh=mesh, axis=SHARD_AXIS)
+    ki.lookup(keys)                     # materialize the tail
+    return table, keys, access
+
+
+def arm(t, lines=1024, quant="int8"):
+    # lines >= capacity in these tests: slot % lines is injective, so
+    # warm-pull hit counts are exact (no direct-mapped conflict noise)
+    t.count_traffic = True
+    t.pull_cache = lines
+    t.pull_quant = quant
+    return t
+
+
+def booked_bytes(n_valid, n_miss, val_bytes):
+    """The watermark protocol's exact wire model (transfer/api.py
+    _accum_pull_cached): 8B request/valid row + hit bitmap + encoded
+    value bytes per miss row."""
+    return 8 * n_valid + (n_valid + 7) // 8 + n_miss * val_bytes
+
+
+# -- PullCache unit behavior ----------------------------------------------
+
+def test_pull_cache_direct_mapped_hits_and_invalidation():
+    sh = PullCache(lines=4)
+    slots = np.array([0, 1, 2, -1], np.int64)
+    vers = np.array([0, 0, 0, 0], np.int64)
+    hit = sh.lookup(slots, vers, capacity=16)
+    assert not hit.any() and sh.misses == 3 and sh.hits == 0
+    # warm re-pull at unchanged versions: every valid row hits
+    hit = sh.lookup(slots, vers, capacity=16)
+    np.testing.assert_array_equal(hit, [True, True, True, False])
+    # a version bump (any apply) invalidates exactly its row
+    vers2 = np.array([0, 5, 0, 0], np.int64)
+    hit = sh.lookup(slots, vers2, capacity=16)
+    np.testing.assert_array_equal(hit, [True, False, True, False])
+    # ...and the miss refilled the line: the new stamp now hits
+    assert sh.lookup(slots, vers2, capacity=16).sum() == 3
+
+
+def test_pull_cache_duplicates_decided_pre_request():
+    sh = PullCache(lines=8)
+    slots = np.array([5, 5], np.int64)
+    vers = np.zeros(2, np.int64)
+    # both occurrences of an uncached slot miss together (the ledger's
+    # per-occurrence booking), then both hit together
+    assert sh.lookup(slots, vers, capacity=16).sum() == 0
+    assert sh.lookup(slots, vers, capacity=16).sum() == 2
+
+
+def test_pull_cache_capacity_change_flushes():
+    sh = PullCache(lines=8)
+    slots = np.array([3], np.int64)
+    vers = np.zeros(1, np.int64)
+    sh.lookup(slots, vers, capacity=16)
+    assert sh.flushes == 0                 # first use is not a flush
+    hit = sh.lookup(slots, vers, capacity=32)   # grow re-strided slots
+    assert sh.flushes == 1 and not hit.any()
+
+
+def test_pull_cache_conflict_eviction_is_deterministic():
+    sh = PullCache(lines=4)
+    vers = np.zeros(1, np.int64)
+    sh.lookup(np.array([0], np.int64), vers, capacity=16)
+    # slot 4 maps to the same line: last writer wins, slot 0 evicted
+    sh.lookup(np.array([4], np.int64), vers, capacity=16)
+    assert not sh.lookup(np.array([0], np.int64), vers, capacity=16).any()
+
+
+def test_pull_cache_oracle_requires_rows():
+    sh = PullCache(lines=4, store_rows=True)
+    with pytest.raises(ValueError, match="fresh rows"):
+        sh.lookup(np.array([0], np.int64), np.zeros(1, np.int64),
+                  capacity=16)
+
+
+# -- pull pricing units ----------------------------------------------------
+
+def test_pull_pricing_guard_units():
+    # 1-wide int8 field prices 9 > 8 bytes and correctly loses
+    fmt, prices = price_pull_formats(10, 8, quant="int8",
+                                     quant_row_bytes=9)
+    assert fmt == "full_f32" and prices == {"full_f32": 80.0,
+                                            "sparse_q": 90.0}
+    # the DIM=8 two-field shape: int8 wins past the 1.25 guard
+    fmt, _ = price_pull_formats(10, FULL_RB, quant="int8",
+                                quant_row_bytes=Q_RB)
+    assert fmt == "sparse_q"
+    # ...but a harsher guard keeps the lossless wire
+    fmt, _ = price_pull_formats(10, FULL_RB, quant="int8",
+                                quant_row_bytes=Q_RB, quant_guard=3.0)
+    assert fmt == "full_f32"
+    # bf16 rung: 4 + 2*2*DIM = 36 bytes, wins at the default guard
+    fmt, prices = price_pull_formats(10, FULL_RB, quant="bf16",
+                                     quant_row_bytes=4 + 4 * DIM)
+    assert fmt == "bf16" and prices["bf16"] == 360.0
+    # quant off: only full_f32 is ever priced
+    fmt, prices = price_pull_formats(10, FULL_RB)
+    assert fmt == "full_f32" and list(prices) == ["full_f32"]
+    with pytest.raises(KeyError, match="PULL_ROUTES"):
+        pull_route("not-a-backend")
+
+
+# -- knobs off: bit-identity on all four backends --------------------------
+
+@pytest.mark.parametrize("backend_name", ["local", "xla", "tpu", "hybrid"])
+def test_pull_cache_off_bit_identity(devices8, backend_name):
+    """With pull_quant/pull_cache off, a pull from a @rowver-armed
+    table is BIT-identical to one from an unarmed table, books exactly
+    the legacy bytes, and never compiles a pull plan."""
+    mesh = ps_mesh()
+    if backend_name == "hybrid":
+        armed_t, keys, access = make_hybrid_table(mesh, seed=3)
+        plain_t, _, _ = make_hybrid_table(mesh, seed=3)
+        rng = np.random.default_rng(5)
+        slots = np.asarray(
+            armed_t.key_index.lookup(keys[rng.integers(0, 400, 64)]),
+            np.int32)
+    else:
+        armed_t, ki_a, access = make_table(mesh=mesh, seed=3)
+        plain_t, ki_p, _ = make_table(mesh=mesh, seed=3)
+        rng = np.random.default_rng(5)
+        kk = rng.integers(0, 10_000, size=64).astype(np.uint64)
+        slots = np.asarray(ki_a.lookup(kk), np.int32)
+        np.testing.assert_array_equal(slots, ki_p.lookup(kk))
+    slots[::7] = -1
+    armed_t.ensure_row_versions()
+    assert has_row_versions(armed_t.state)
+    assert not has_row_versions(plain_t.state)
+
+    t = {"local": LocalTransfer, "xla": XlaTransfer,
+         "tpu": lambda: TpuTransfer(mesh),
+         "hybrid": lambda: HybridTransfer(mesh)}[backend_name]()
+    t.count_traffic = True
+    tr0 = t.traffic()
+    st_a = ({f: np.asarray(v) for f, v in armed_t.state.items()}
+            if backend_name == "local" else armed_t.state)
+    st_p = ({f: np.asarray(v) for f, v in plain_t.state.items()}
+            if backend_name == "local" else plain_t.state)
+    got = t.pull(st_a, slots, access)
+    want = t.pull(st_p, slots, access)
+    assert ROWVER_KEY not in got
+    for f in access.pull_fields:
+        np.testing.assert_array_equal(np.asarray(got[f]),
+                                      np.asarray(want[f]), err_msg=f)
+    tr = t.traffic_delta(tr0)
+    n_valid = int((slots >= 0).sum())
+    # legacy booking: full rows only, no plan, no cache, no fmt counters
+    assert tr["pull_rows"] == 2 * n_valid
+    hot = tr["pull_hot_rows"]
+    assert tr["pull_bytes"] == (2 * n_valid - hot) * FULL_RB
+    for k in ("pull_cache_hits", "pull_delta_rows", "pull_bytes_saved",
+              "pull_fmt_full", "pull_fmt_bf16", "pull_fmt_q"):
+        assert tr[k] == 0, (k, tr)
+
+
+# -- cross-backend pull-ledger parity golden -------------------------------
+
+def test_cross_backend_pull_ledger_parity(devices8):
+    """Armed (cache + int8), the same slot/version stream books the
+    IDENTICAL pull ledger on local, xla and tpu: cold pull, warm pull
+    (all hits), push, re-pull (pushed rows honestly miss)."""
+    mesh = ps_mesh()
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    rng = np.random.default_rng(11)
+    kk = rng.integers(0, 10_000, size=48).astype(np.uint64)
+    draw = kk[rng.integers(0, 48, size=64)]      # repeats on purpose
+    tables, slot_sets = {}, {}
+    for name in ("local", "xla", "tpu"):
+        table, ki, _ = make_table(mesh=mesh, seed=0)
+        table.ensure_row_versions()
+        slots = np.asarray(ki.lookup(draw), np.int32)
+        slots[::7] = -1
+        tables[name], slot_sets[name] = table, slots
+    np.testing.assert_array_equal(slot_sets["local"], slot_sets["xla"])
+    np.testing.assert_array_equal(slot_sets["local"], slot_sets["tpu"])
+    slots = slot_sets["local"]
+    n_valid = int((slots >= 0).sum())
+    push_slots = slots[:16]
+    grads = {f: rng.normal(size=(16, DIM)).astype(np.float32)
+             for f in access.grad_fields}
+    pushed = set(push_slots[push_slots >= 0].tolist())
+    n_repull_miss = int(sum(1 for s in slots if s in pushed))
+    assert 0 < n_repull_miss < n_valid
+
+    deltas, firsts = {}, {}
+    for name, t in (("local", LocalTransfer()), ("xla", XlaTransfer()),
+                    ("tpu", TpuTransfer(mesh))):
+        arm(t)
+        st = ({f: np.asarray(v) for f, v in tables[name].state.items()}
+              if name == "local" else tables[name].state)
+        tr0 = t.traffic()
+        out1 = t.pull(st, slots, access)
+        tr1 = t.traffic_delta(tr0)
+        t.pull(st, slots, access)                 # warm: all hits
+        tr2 = t.traffic_delta(tr0)
+        st = t.push(st, push_slots, grads, access)
+        t.pull(st, slots, access)                 # pushed rows miss
+        tr3 = t.traffic_delta(tr0)
+        # cold pull: every occurrence misses, booked at the int8 wire
+        assert tr1["pull_bytes"] == booked_bytes(n_valid, n_valid,
+                                                 Q_RB - 4), name
+        assert tr1["pull_cache_hits"] == 0 and tr1["pull_fmt_q"] == 1
+        # warm pull: zero value bytes moved — watermark + bitmap only
+        assert tr2["pull_cache_hits"] == n_valid, name
+        assert tr2["pull_bytes"] - tr1["pull_bytes"] == \
+            booked_bytes(n_valid, 0, Q_RB - 4), name
+        assert tr2["pull_bytes_saved"] > tr1["pull_bytes_saved"]
+        # re-pull after the push: exactly the pushed occurrences miss
+        assert tr3["pull_delta_rows"] - tr2["pull_delta_rows"] == \
+            n_repull_miss, name
+        deltas[name] = {k: tr3[k] for k in PULL_KEYS}
+        firsts[name] = out1
+    assert deltas["local"] == deltas["xla"] == deltas["tpu"], deltas
+    # same state, same plan: the quantized first pulls are bit-equal
+    for f in access.pull_fields:
+        np.testing.assert_array_equal(
+            np.asarray(firsts["local"][f]), np.asarray(firsts["xla"][f]))
+        np.testing.assert_array_equal(
+            np.asarray(firsts["local"][f]), np.asarray(firsts["tpu"][f]))
+
+
+def test_hybrid_hot_rows_zero_bytes_never_quantized(devices8):
+    """The hybrid hot head: replica hits book 0 bytes (rows counted
+    under pull_hot_rows), are never cached and never quantized; tail
+    rows compose the cache + int8 wire exactly as standalone."""
+    mesh = ps_mesh()
+    table, keys, access = make_hybrid_table(mesh)
+    table.ensure_row_versions()
+    n_hot = table.n_hot
+    assert n_hot > 0
+    rng = np.random.default_rng(7)
+    slots = np.asarray(
+        table.key_index.lookup(keys[rng.integers(0, 400, 96)]), np.int32)
+    slots[::9] = -1
+    hot_occ = int(((slots >= 0) & (slots < n_hot)).sum())
+    tail_occ = int((slots >= n_hot).sum())
+    assert hot_occ > 0 and tail_occ > 0
+
+    t = arm(HybridTransfer(mesh))
+    tr0 = t.traffic()
+    out = t.pull(table.state, slots, access)
+    tr1 = t.traffic_delta(tr0)
+    t.pull(table.state, slots, access)
+    tr2 = t.traffic_delta(tr0)
+    assert tr1["pull_rows"] == hot_occ + tail_occ
+    assert tr1["pull_hot_rows"] == hot_occ
+    # 0-byte hot booking: the wire carries only the tail's delta pull
+    assert tr1["pull_bytes"] == booked_bytes(tail_occ, tail_occ,
+                                             Q_RB - 4), tr1
+    # warm tail hits; hot rows never enter the cache
+    assert tr2["pull_cache_hits"] == tail_occ
+    # hot reads are exact replica rows (no quantizer on the hot path),
+    # while the int8 tail wire perturbs at least one tail row
+    uni = {f: table.unified_rows_host(f) for f in access.pull_fields}
+    hot_mask = (slots >= 0) & (slots < n_hot)
+    tail_mask = slots >= n_hot
+    for f in access.pull_fields:
+        got = np.asarray(out[f])
+        np.testing.assert_array_equal(got[hot_mask],
+                                      uni[f][slots[hot_mask]], err_msg=f)
+        assert not np.array_equal(got[tail_mask],
+                                  uni[f][slots[tail_mask]])
+
+
+# -- version-invalidation oracle -------------------------------------------
+
+def test_version_invalidation_oracle(devices8):
+    """store_rows oracle: honest re-pulls value-verify every hit; a row
+    mutated WITHOUT a version bump is caught the moment the stale line
+    would be served."""
+    table, ki, access = make_table()
+    table.ensure_row_versions()
+    kk = np.arange(1, 49, dtype=np.uint64)
+    slots = np.asarray(ki.lookup(kk), np.int32)
+    slots[::7] = -1
+    t = arm(XlaTransfer(), lines=256, quant="off")
+    t.pull_cache_oracle = True
+    st = table.state
+    tr0 = t.traffic()
+    t.pull(st, slots, access)
+    t.pull(st, slots, access)          # all hits, all value-verified
+    n_valid = int((slots >= 0).sum())
+    assert t.traffic_delta(tr0)["pull_cache_hits"] == n_valid
+    # an apply bumps its rows: the re-pull misses them, refills, and
+    # the following warm pull verifies the NEW values — no staleness
+    rng = np.random.default_rng(2)
+    push_slots = slots[:12]
+    grads = {f: rng.normal(size=(12, DIM)).astype(np.float32)
+             for f in access.grad_fields}
+    st = t.push(st, push_slots, grads, access)
+    t.pull(st, slots, access)
+    t.pull(st, slots, access)
+    # a forgotten bump: mutate a pulled row, leave @rowver alone
+    victim = int(slots[slots >= 0][-1])
+    bad = dict(st)
+    bad["h"] = jnp.asarray(bad["h"]).at[victim].add(1.0)
+    with pytest.raises(AssertionError, match="did not bump"):
+        t.pull(bad, slots, access)
+
+
+def test_rowver_survives_grow_and_cache_flushes(devices8):
+    """grow() re-strides tail rows WITH their version stamps (fresh
+    slots at version 0), and the capacity change flushes the worker
+    shadow so pre-growth lines can never alias the moved rows."""
+    table, ki, access = make_table()
+    table.ensure_row_versions()
+    kk = np.arange(1, 41, dtype=np.uint64)
+    slots = np.asarray(ki.lookup(kk), np.int32)
+    rng = np.random.default_rng(4)
+    grads = {f: rng.normal(size=(40, DIM)).astype(np.float32)
+             for f in access.grad_fields}
+    t = arm(XlaTransfer(), quant="off")
+    table.state = t.push(table.state, slots, grads, access)
+    vers0 = np.asarray(table.state[ROWVER_KEY]).ravel()
+    assert (vers0[slots] == 1).all()
+
+    tr0 = t.traffic()
+    t.pull(table.state, slots, access)
+    t.pull(table.state, slots, access)
+    assert t.traffic_delta(tr0)["pull_cache_hits"] == 40
+
+    table.grow()
+    new_slots = np.asarray(ki.lookup(kk, create=False), np.int32)
+    assert table.key_index.capacity == 2 * len(vers0)
+    vers1 = np.asarray(table.state[ROWVER_KEY]).ravel()
+    assert (vers1[new_slots] == 1).all()
+    assert int((vers1 > 0).sum()) == len(set(slots.tolist()))
+
+    tr1 = t.traffic()
+    t.pull(table.state, new_slots, access)
+    assert t._pull_shadow.flushes == 1          # capacity keyed flush
+    assert t.traffic_delta(tr1)["pull_cache_hits"] == 0
+
+
+def test_rowver_repartition_bumps_demoted_rows(devices8):
+    """Demotion writes the live hot row over a dormant tail slot: its
+    version must jump past the global max so any cached copy of the
+    pre-promotion value is invalidated (tail ids stay stable, so no
+    full flush is needed)."""
+    keys = np.arange(1, 33, dtype=np.uint64)
+    access = w2v_access(learning_rate=0.3, len_vec=DIM)
+    part = HotColdPartition(keys[:4])
+    ki = KeyIndex(8, 8, partition=part)
+    table = SparseTable(access, ki, mesh=None, axis="model")
+    ki.lookup(keys)
+    table.ensure_row_versions()
+    plan = table.repartition(None)
+    assert plan.demote_dst.shape[0] == 4
+    vers = np.asarray(table.state[ROWVER_KEY]).ravel()
+    assert (vers[np.asarray(plan.demote_dst)] == 1).all()
+    assert int((vers > 0).sum()) == 4
+
+
+# -- quantized pull wire ---------------------------------------------------
+
+def test_pull_quant_envelope_and_encoded_booking(devices8):
+    """int8 pulls perturb the forward read within the codec's per-row
+    bound (half a quantization step) and book the ENCODED wire; the
+    server rows are never written through the quantizer."""
+    table, ki, access = make_table()
+    kk = np.arange(1, 49, dtype=np.uint64)
+    slots = np.asarray(ki.lookup(kk), np.int32)
+    slots[::7] = -1
+    t = XlaTransfer()
+    t.count_traffic = True
+    t.pull_quant = "int8"
+    before = {f: np.asarray(v).copy() for f, v in table.state.items()}
+    tr0 = t.traffic()
+    out = t.pull(table.state, slots, access)
+    tr = t.traffic_delta(tr0)
+    n_valid = int((slots >= 0).sum())
+    assert tr["pull_bytes"] == n_valid * Q_RB
+    assert tr["pull_rows"] == n_valid and tr["pull_fmt_q"] == 1
+    assert tr["pull_cache_hits"] == 0 and tr["pull_bytes_saved"] == 0
+    safe = np.clip(slots, 0, ki.capacity - 1)
+    for f in access.pull_fields:
+        fresh = before[f][safe] * (slots >= 0)[:, None]
+        step = np.max(np.abs(fresh), axis=-1, keepdims=True) / 127.0
+        assert np.all(np.abs(np.asarray(out[f]) - fresh)
+                      <= 0.5 * step + 1e-6), f
+        # at least one element actually moved through the codec
+        assert not np.array_equal(np.asarray(out[f]), fresh)
+        np.testing.assert_array_equal(np.asarray(table.state[f]),
+                                      before[f])
+
+
+# -- model integration + chaos ---------------------------------------------
+
+def w2v_model(**overrides):
+    from swiftmpi_tpu.models.word2vec import Word2Vec
+
+    cfg = ConfigParser().update({
+        "cluster": {"transfer": "xla"},
+        "word2vec": {"len_vec": 16, "window": 2, "negative": 5,
+                     "sample": -1, "learning_rate": 0.05,
+                     "min_sentence_length": 2},
+        "server": {"initial_learning_rate": 0.3},
+        "worker": {"minibatch": 512},
+    })
+    for sec, kv in overrides.items():
+        for k, v in kv.items():
+            cfg.set(sec, k, v)
+    return Word2Vec(config=cfg)
+
+
+def test_model_knob_arming_and_default_pytree(devices8):
+    """Knobs off, the model's table pytree has no @rowver plane (the
+    fused-scan carry and checkpoints are byte-identical to the pre-PR
+    layout); armed, the plane exists before any step compiles."""
+    from swiftmpi_tpu.data.text import synthetic_corpus
+
+    corpus = synthetic_corpus(20, vocab_size=40, length=10, seed=13)
+    off = w2v_model()
+    off.build(corpus)
+    assert not has_row_versions(off.table.state)
+    assert off.transfer.pull_quant == "off" and not off.transfer.pull_cache
+
+    on = w2v_model(cluster={"transfer": "xla", "pull_cache": 64,
+                            "pull_quant": "int8"})
+    on.build(corpus)
+    assert has_row_versions(on.table.state)
+    assert on.transfer.pull_cache == 64
+    assert on.transfer.pull_quant == "int8"
+
+
+def test_chaos_resume_restarts_with_cold_cache(tmp_path, devices8):
+    """Chaos: a crash mid-stream with the delta-pull knobs armed
+    resumes from the checkpoint WITH its @rowver plane and a COLD
+    pull cache (a restore can rewind version stamps; a warm cache
+    could false-hit on a re-used stamp), then trains to finite
+    losses."""
+    from swiftmpi_tpu.data.text import CBOWBatcher, synthetic_corpus
+    from swiftmpi_tpu.io.checkpoint import npz_path
+    from swiftmpi_tpu.io.resilience import train_with_resume
+
+    corpus = synthetic_corpus(60, vocab_size=200, length=12, seed=22)
+    m = w2v_model(cluster={"transfer": "xla", "push_window": 2,
+                           "pull_cache": 256, "pull_quant": "int8"},
+                  worker={"inner_steps": 4, "minibatch": 64})
+    m.build(corpus)
+    m.transfer.count_traffic = True
+    assert has_row_versions(m.table.state)
+
+    class Flaky:
+        def __init__(self, inner):
+            self.inner = inner
+            self.epoch_i = 0
+
+        def epoch(self, batch_size):
+            self.epoch_i += 1
+            for i, b in enumerate(self.inner.epoch(batch_size)):
+                if self.epoch_i == 2 and i == 1:
+                    raise RuntimeError("injected crash mid-stream")
+                yield b
+
+    flaky = Flaky(CBOWBatcher(corpus, m.vocab, m.window))
+    ckpt = str(tmp_path / "dpull_ck")
+    losses = train_with_resume(m, niters=3, checkpoint_path=ckpt,
+                               checkpoint_every=1, max_restarts=2,
+                               batcher=flaky, batch_size=64)
+    assert len(losses) == 2 and np.isfinite(losses).all()
+    # the restore path flushed the worker shadow: cold restart, no
+    # torn reads against rewound version stamps
+    sh = m.transfer.__dict__.get("_pull_shadow")
+    assert sh is not None and sh.flushes >= 1
+    with np.load(npz_path(ckpt)) as z:
+        assert any(ROWVER_KEY in name for name in z.files)
+    assert has_row_versions(m.table.state)
